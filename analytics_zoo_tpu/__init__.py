@@ -1,0 +1,24 @@
+"""analytics_zoo_tpu — a TPU-native analytics/AI framework.
+
+A ground-up JAX/XLA/pallas/pjit rebuild of the capabilities of Analytics Zoo
+(reference: seeker1943/analytics-zoo): Keras-style model APIs, distributed training
+over device meshes, sharded data pipelines, inference + streaming serving, built-in
+model zoo (recommendation / time-series / text / vision), AutoML, and observability.
+
+Where the reference scales via Spark executors + BigDL's block-manager allreduce,
+this framework scales via ``jax.sharding.Mesh`` + XLA collectives over ICI/DCN, with
+data/tensor/sequence/pipeline/expert parallelism as first-class mesh axes.
+"""
+
+__version__ = "0.1.0"
+
+from . import common, data, engine, nn
+from .common import (MeshConfig, RuntimeConfig, TrainConfig, get_zoo_context,
+                     init_zoo_context)
+from .nn import Input, Model, Sequential
+
+__all__ = [
+    "Input", "MeshConfig", "Model", "RuntimeConfig", "Sequential", "TrainConfig",
+    "common", "data", "engine", "get_zoo_context", "init_zoo_context", "nn",
+    "__version__",
+]

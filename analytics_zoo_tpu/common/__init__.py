@@ -1,0 +1,18 @@
+"""Core runtime: context/mesh bootstrap, config, summaries, triggers."""
+
+from .config import (MeshConfig, PrecisionConfig, RuntimeConfig, TrainConfig,
+                     apply_env_overrides)
+from .context import (ZooContext, build_mesh, get_zoo_context, init_zoo_context,
+                      reset_zoo_context)
+from .summary import (EventWriter, TrainSummary, ValidationSummary, read_scalars,
+                      timing)
+from .triggers import (EveryEpoch, MaxEpoch, MaxIteration, MaxScore, MinLoss,
+                       SeveralIteration, Trigger, TrainerState)
+
+__all__ = [
+    "EventWriter", "EveryEpoch", "MaxEpoch", "MaxIteration", "MaxScore",
+    "MeshConfig", "MinLoss", "PrecisionConfig", "RuntimeConfig", "SeveralIteration",
+    "TrainConfig", "TrainSummary", "Trigger", "TrainerState", "ValidationSummary",
+    "ZooContext", "apply_env_overrides", "build_mesh", "get_zoo_context",
+    "init_zoo_context", "read_scalars", "reset_zoo_context", "timing",
+]

@@ -1,0 +1,152 @@
+"""Typed configuration system for the framework.
+
+Replaces the reference's layered config sprawl (SparkConf + env vars + Java system
+properties + serving YAML — see /root/reference/pyzoo/zoo/common/nncontext.py:263-342,
+zoo/.../keras/models/Topology.scala:966-971) with one dataclass-based config tree with
+environment-variable overrides.
+
+Every subsystem takes a typed config object; ``from_env`` applies ``ZOO_TPU_*``
+environment overrides so ops can tune without code changes (capability parity with the
+reference's ``ZOO_NUM_MKLTHREADS`` / ``OMP_NUM_THREADS`` env knobs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional, Sequence, Tuple
+
+_ENV_PREFIX = "ZOO_TPU_"
+
+
+def _coerce(value: str, typ: Any) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    if typ is str:
+        return value
+    # tuples/lists/optionals: go through JSON
+    try:
+        return json.loads(value)
+    except (json.JSONDecodeError, ValueError):
+        return value
+
+
+@dataclass
+class MeshConfig:
+    """Logical device-mesh layout.
+
+    Axis sizes of ``0``/``None`` mean "fill with remaining devices". Axis names are
+    fixed framework-wide: ``dp`` (data), ``fsdp`` (param/optimizer sharding inside a
+    data replica), ``tp`` (tensor), ``sp`` (sequence/context), ``pp`` (pipeline),
+    ``ep`` (expert). The reference only had data parallelism (SURVEY.md §2.2);
+    here every axis is first-class.
+    """
+
+    dp: int = 0          # 0 => fill with remaining devices
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ("dp", "fsdp", "tp", "sp", "pp", "ep")
+
+    def sizes(self, n_devices: int) -> Tuple[int, ...]:
+        fixed = [self.fsdp, self.tp, self.sp, self.pp, self.ep]
+        known = 1
+        for s in fixed:
+            known *= max(1, s)
+        dp = self.dp
+        if dp in (0, None):
+            if n_devices % known != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {known}")
+            dp = n_devices // known
+        total = dp * known
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {dp}x{fixed} = {total} does not match {n_devices} devices")
+        return (dp,) + tuple(max(1, s) for s in fixed)
+
+
+@dataclass
+class PrecisionConfig:
+    """Mixed-precision policy. Params in ``param_dtype``, compute in ``compute_dtype``.
+
+    On TPU set ``compute_dtype='bfloat16'`` (e.g. ``ZOO_TPU_PRECISION_COMPUTE_DTYPE``)
+    to keep matmuls on the MXU at full rate; float32 params keep optimizer updates
+    stable. Default is float32 so CPU/differential runs are exact.
+    """
+
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"
+    output_dtype: str = "float32"
+
+
+@dataclass
+class RuntimeConfig:
+    """Top-level runtime config (the ``init_nncontext`` replacement's knobs).
+
+    Mirrors the *capabilities* of /root/reference/pyzoo/zoo/common/nncontext.py:180-243.
+    """
+
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    precision: PrecisionConfig = field(default_factory=PrecisionConfig)
+    platform: Optional[str] = None          # None = let JAX pick; "cpu"/"tpu" force
+    num_virtual_devices: int = 0            # >0: force host-platform device count (tests)
+    coordinator_address: Optional[str] = None  # multi-host: jax.distributed.initialize
+    num_processes: int = 1
+    process_id: int = 0
+    log_dir: Optional[str] = None
+    seed: int = 0
+
+
+@dataclass
+class TrainConfig:
+    """Training-engine knobs (maps InternalDistriOptimizer params,
+    Topology.scala:1086-1269)."""
+
+    batch_size: int = 256                   # GLOBAL batch; must divide by dp axis size
+    max_epochs: int = 1
+    gradient_clip_norm: Optional[float] = None
+    gradient_clip_value: Optional[Tuple[float, float]] = None
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_n_iters: Optional[int] = None  # None => every epoch
+    retry_times: int = 5                    # bigdl.failure.retryTimes parity
+    log_every_n_steps: int = 50
+    donate_state: bool = True               # donate params/opt-state buffers to the step
+
+
+def apply_env_overrides(cfg: Any, prefix: str = _ENV_PREFIX) -> Any:
+    """Return a copy of dataclass ``cfg`` with ``ZOO_TPU_<FIELD>`` env overrides applied.
+
+    Nested dataclasses use ``ZOO_TPU_<OUTER>_<FIELD>`` (e.g. ``ZOO_TPU_MESH_TP=2``).
+    """
+    if not dataclasses.is_dataclass(cfg):
+        return cfg
+    updates = {}
+    for f in dataclasses.fields(cfg):
+        val = getattr(cfg, f.name)
+        if dataclasses.is_dataclass(val):
+            updates[f.name] = apply_env_overrides(val, prefix + f.name.upper() + "_")
+        else:
+            env_key = prefix + f.name.upper()
+            if env_key in os.environ:
+                updates[f.name] = _coerce(os.environ[env_key], f.type if isinstance(f.type, type) else type(val))
+    return dataclasses.replace(cfg, **updates)
+
+
+def config_to_dict(cfg: Any) -> Any:
+    if dataclasses.is_dataclass(cfg):
+        return {f.name: config_to_dict(getattr(cfg, f.name)) for f in dataclasses.fields(cfg)}
+    if isinstance(cfg, (list, tuple)):
+        return [config_to_dict(v) for v in cfg]
+    return cfg

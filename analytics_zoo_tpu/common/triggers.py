@@ -1,0 +1,105 @@
+"""Training triggers — when to stop, checkpoint, or validate.
+
+Parity: BigDL ``Trigger`` + zoo's ``ZooTrigger`` extensions
+(/root/reference/zoo/src/main/scala/com/intel/analytics/zoo/common/ZooTrigger.scala;
+used for end-of-training and checkpoint cadence at Topology.scala:1344-1359).
+
+Triggers are pure predicates over a :class:`TrainState` snapshot, so they stay out
+of the compiled step function (no data-dependent control flow under ``jit``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class TrainerState:
+    """Host-side loop counters handed to triggers."""
+
+    epoch: int = 0            # completed epochs
+    iteration: int = 0        # completed global steps
+    records_processed: int = 0
+    last_loss: float = float("inf")
+    last_score: float = float("-inf")
+
+
+class Trigger:
+    def __call__(self, state: TrainerState) -> bool:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def __and__(self, other: "Trigger") -> "Trigger":
+        return _And(self, other)
+
+    def __or__(self, other: "Trigger") -> "Trigger":
+        return _Or(self, other)
+
+
+class _And(Trigger):
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def __call__(self, state):
+        return self.a(state) and self.b(state)
+
+
+class _Or(Trigger):
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def __call__(self, state):
+        return self.a(state) or self.b(state)
+
+
+class MaxEpoch(Trigger):
+    def __init__(self, max_epoch: int):
+        self.max_epoch = max_epoch
+
+    def __call__(self, state):
+        return state.epoch >= self.max_epoch
+
+
+class MaxIteration(Trigger):
+    def __init__(self, max_iteration: int):
+        self.max_iteration = max_iteration
+
+    def __call__(self, state):
+        return state.iteration >= self.max_iteration
+
+
+class EveryEpoch(Trigger):
+    """Fires at each epoch boundary (checkpoint/validation cadence)."""
+
+    def __init__(self):
+        self._last_epoch = -1
+
+    def __call__(self, state):
+        if state.epoch != self._last_epoch:
+            self._last_epoch = state.epoch
+            return True
+        return False
+
+
+class SeveralIteration(Trigger):
+    def __init__(self, interval: int):
+        assert interval > 0
+        self.interval = interval
+
+    def __call__(self, state):
+        return state.iteration > 0 and state.iteration % self.interval == 0
+
+
+class MinLoss(Trigger):
+    def __init__(self, min_loss: float):
+        self.min_loss = min_loss
+
+    def __call__(self, state):
+        return state.last_loss <= self.min_loss
+
+
+class MaxScore(Trigger):
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def __call__(self, state):
+        return state.last_score >= self.max_score

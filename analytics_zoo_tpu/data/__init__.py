@@ -1,0 +1,5 @@
+"""Data layer: FeatureSet cache tiers, XShards, image/text pipelines."""
+
+from .featureset import FeatureSet, MemoryType, device_prefetch
+
+__all__ = ["FeatureSet", "MemoryType", "device_prefetch"]

@@ -1,0 +1,203 @@
+"""FeatureSet — the training-data abstraction with cache tiers + epoch slicing.
+
+Parity: /root/reference/zoo/src/main/scala/com/intel/analytics/zoo/feature/
+FeatureSet.scala (AbstractFeatureSet :53-103, CachedDistributedFeatureSet :230,
+DiskFeatureSet :546, memory-type dispatch :652-676). The reference caches RDDs in
+DRAM / Optane PMEM / disk with epoch slicing; here the tiers are:
+
+* ``DRAM``            — host RAM ndarrays (default)
+* ``DISK_AND_DRAM(n)``— ``np.memmap``-backed arrays sliced into ``n`` epoch slices,
+                        only one slice resident per sub-epoch (DiskFeatureSet parity)
+* ``PMEM``            — alias of DISK_AND_DRAM(1) over a memmap on a pmem/NVMe mount
+                        (PersistentMemoryAllocator capability, java/.../pmem/)
+
+Multi-host sharding: each process owns ``data[process_index::process_count]``
+(replaces Spark partition placement). Batches are GLOBAL — the loader yields each
+host's shard of every global batch; the training engine lays them onto the ``dp``
+mesh axis with ``jax.make_array_from_process_local_data``.
+
+Deterministic shuffle: per-epoch permutation from ``seed + epoch`` so every host
+computes the same global permutation without communication.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import tempfile
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+ArrayTree = Any  # nested tuple/dict/list of np.ndarray, all with equal leading dim
+
+
+class MemoryType:
+    DRAM = "DRAM"
+    PMEM = "PMEM"
+    DIRECT = "DIRECT"
+
+    @staticmethod
+    def DISK_AND_DRAM(num_slice: int) -> str:
+        return f"DISK_AND_DRAM_{num_slice}"
+
+
+def _tree_map(fn, tree):
+    if isinstance(tree, dict):
+        return {k: _tree_map(fn, v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_tree_map(fn, v) for v in tree)
+    return fn(tree)
+
+
+def _tree_leaves(tree):
+    if isinstance(tree, dict):
+        out = []
+        for v in tree.values():
+            out.extend(_tree_leaves(v))
+        return out
+    if isinstance(tree, (list, tuple)):
+        out = []
+        for v in tree:
+            out.extend(_tree_leaves(v))
+        return out
+    return [tree]
+
+
+class FeatureSet:
+    """An immutable, shardable dataset of array trees."""
+
+    def __init__(self, data: ArrayTree, memory_type: str = MemoryType.DRAM,
+                 cache_dir: Optional[str] = None, process_index: int = 0,
+                 process_count: int = 1, seed: int = 0):
+        self.memory_type = memory_type
+        self.process_index = process_index
+        self.process_count = process_count
+        self.seed = seed
+        leaves = _tree_leaves(data)
+        if not leaves:
+            raise ValueError("empty FeatureSet")
+        n = leaves[0].shape[0]
+        for l in leaves:
+            if l.shape[0] != n:
+                raise ValueError("all arrays must share the leading dimension")
+        self._n_total = n
+        self._mm_count = 0
+        if memory_type.startswith("DISK_AND_DRAM") or memory_type == MemoryType.PMEM:
+            self.num_slices = (int(memory_type.rsplit("_", 1)[1])
+                               if memory_type.startswith("DISK_AND_DRAM") else 1)
+            self._cache_dir = cache_dir or tempfile.mkdtemp(prefix="zoo_featureset_")
+            self.data = _tree_map(self._to_memmap, data)
+        else:
+            self.num_slices = 1
+            self.data = data
+
+    # -------------------------------------------------------------- constructors
+    @classmethod
+    def from_numpy(cls, x, y=None, **kw) -> "FeatureSet":
+        """Build from feature array(s) + optional label array(s)
+        (FeatureSet.rdd(...) parity)."""
+        data = (x,) if y is None else (x, y)
+        return cls(data, **kw)
+
+    @classmethod
+    def from_xshards(cls, shards, **kw) -> "FeatureSet":
+        from .xshards import XShards
+
+        assert isinstance(shards, XShards)
+        return cls(shards.collect_tree(), **kw)
+
+    # ----------------------------------------------------------------- internals
+    def _to_memmap(self, arr: np.ndarray) -> np.ndarray:
+        path = os.path.join(self._cache_dir, f"arr_{self._mm_count}.npy")
+        self._mm_count += 1
+        mm = np.lib.format.open_memmap(path, mode="w+", dtype=arr.dtype, shape=arr.shape)
+        mm[:] = arr
+        mm.flush()
+        return np.lib.format.open_memmap(path, mode="r")
+
+    # ------------------------------------------------------------------- API
+    def size(self) -> int:
+        """Global sample count (AbstractFeatureSet.size parity)."""
+        return self._n_total
+
+    def __len__(self) -> int:
+        return self._n_total
+
+    def shuffle_indices(self, epoch: int) -> np.ndarray:
+        rng = np.random.default_rng(self.seed + epoch * 1_000_003)
+        return rng.permutation(self._n_total)
+
+    def num_batches(self, batch_size: int, drop_remainder: bool = True) -> int:
+        if drop_remainder:
+            return self._n_total // batch_size
+        return math.ceil(self._n_total / batch_size)
+
+    def batches(self, batch_size: int, *, epoch: int = 0, shuffle: bool = True,
+                drop_remainder: bool = True) -> Iterator[ArrayTree]:
+        """Yield this host's shard of every global batch.
+
+        ``batch_size`` is GLOBAL and must divide by ``process_count`` (the
+        reference requires batch % total_cores == 0 — tf_dataset.py:144).
+        """
+        if batch_size % self.process_count:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by {self.process_count} hosts")
+        idx = self.shuffle_indices(epoch) if shuffle else np.arange(self._n_total)
+        nb = self.num_batches(batch_size, drop_remainder)
+        for b in range(nb):
+            # Strided host assignment: for a partial trailing batch every host
+            # still yields (sizes differ by at most 1), so multi-host loops stay
+            # in lockstep instead of some hosts skipping the final batch.
+            sel = idx[b * batch_size:(b + 1) * batch_size][
+                self.process_index::self.process_count]
+            if len(sel) == 0:
+                continue
+            # sorted gather is dramatically faster on memmap tiers
+            order = np.argsort(sel, kind="stable")
+            inv = np.empty_like(order)
+            inv[order] = np.arange(len(order))
+            yield _tree_map(lambda a: np.ascontiguousarray(a[sel[order]][inv]), self.data)
+
+    def slices(self, num_slices: Optional[int] = None) -> List["FeatureSet"]:
+        """Epoch slicing: split into sub-epoch FeatureSets (DiskFeatureSet's
+        DISK_AND_DRAM numSlice semantics, FeatureSet.scala:546)."""
+        k = num_slices or self.num_slices
+        out = []
+        per = math.ceil(self._n_total / k)
+        for i in range(k):
+            sl = slice(i * per, min((i + 1) * per, self._n_total))
+            out.append(FeatureSet(
+                _tree_map(lambda a: np.asarray(a[sl]), self.data),
+                process_index=self.process_index, process_count=self.process_count,
+                seed=self.seed + 17 * (i + 1)))
+        return out
+
+    def transform(self, fn) -> "FeatureSet":
+        """Apply a preprocessing fn over the whole tree (ImageSet/TextSet transform
+        chain parity — applied eagerly host-side)."""
+        return FeatureSet(fn(self.data), process_index=self.process_index,
+                          process_count=self.process_count, seed=self.seed)
+
+
+def device_prefetch(batch_iter: Iterator[ArrayTree], sharding=None, depth: int = 2):
+    """Double-buffer host→device transfer: keep ``depth`` batches in flight.
+
+    Replaces the reference's per-executor data locality (data already lives next to
+    compute under Spark); on TPU the equivalent is overlapping the HBM upload of
+    batch N+1 with the step on batch N.
+    """
+    import jax
+
+    def put(b):
+        if sharding is None:
+            return _tree_map(jax.device_put, b)
+        return _tree_map(lambda a: jax.device_put(a, sharding), b)
+
+    buf = []
+    for b in batch_iter:
+        buf.append(put(b))
+        if len(buf) >= depth:
+            yield buf.pop(0)
+    while buf:
+        yield buf.pop(0)

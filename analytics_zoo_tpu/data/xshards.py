@@ -1,0 +1,118 @@
+"""XShards — partitioned collections of numpy/pandas data.
+
+Parity: /root/reference/pyzoo/zoo/orca/data/shard.py:23-368 (``XShards``,
+``SparkXShards``, ``RayXShards``) — partitioned pandas/numpy over Spark or Ray,
+with parquet/csv/json readers. Here a shard is simply a host-side partition list
+(the "cluster" being the process set of a multi-host TPU job); ``transform_shard``
+maps a function over partitions, and ``collect_tree``/``to_featureset`` hand the
+data to the training engine.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+class XShards:
+    """A list of partitions, each an arbitrary python object (dict of ndarrays,
+    pandas DataFrame, ...)."""
+
+    def __init__(self, partitions: Sequence[Any]):
+        self._parts: List[Any] = list(partitions)
+
+    # ------------------------------------------------------------ constructors
+    @classmethod
+    def partition(cls, data, num_partitions: int = 4) -> "XShards":
+        """Split ndarray/dict-of-ndarray into shards (orca ``XShards.partition``)."""
+        if isinstance(data, dict):
+            keys = list(data)
+            n = len(data[keys[0]])
+            splits = np.array_split(np.arange(n), num_partitions)
+            return cls([{k: np.asarray(data[k])[idx] for k in keys} for idx in splits])
+        arr = np.asarray(data)
+        return cls([np.ascontiguousarray(p) for p in np.array_split(arr, num_partitions)])
+
+    @classmethod
+    def read_csv(cls, path: str, num_partitions: int = 4, **kw) -> "XShards":
+        """CSV reader → pandas shards (orca ``read_csv`` parity)."""
+        import pandas as pd
+
+        files = sorted(_glob.glob(path)) if any(c in path for c in "*?[") else [path]
+        frames = [pd.read_csv(f, **kw) for f in files]
+        df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
+        idx = np.array_split(np.arange(len(df)), num_partitions)
+        return cls([df.iloc[i].reset_index(drop=True) for i in idx])
+
+    @classmethod
+    def read_json(cls, path: str, num_partitions: int = 4, **kw) -> "XShards":
+        import pandas as pd
+
+        files = sorted(_glob.glob(path)) if any(c in path for c in "*?[") else [path]
+        frames = [pd.read_json(f, **kw) for f in files]
+        df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
+        idx = np.array_split(np.arange(len(df)), num_partitions)
+        return cls([df.iloc[i].reset_index(drop=True) for i in idx])
+
+    @classmethod
+    def read_parquet(cls, path: str, num_partitions: int = 4, **kw) -> "XShards":
+        import pandas as pd
+
+        df = pd.read_parquet(path, **kw)
+        idx = np.array_split(np.arange(len(df)), num_partitions)
+        return cls([df.iloc[i].reset_index(drop=True) for i in idx])
+
+    # ------------------------------------------------------------------ ops
+    def transform_shard(self, fn: Callable, *args) -> "XShards":
+        """Apply ``fn`` to every partition (shard.py ``transform_shard`` parity)."""
+        return XShards([fn(p, *args) for p in self._parts])
+
+    def collect(self) -> List[Any]:
+        return list(self._parts)
+
+    def num_partitions(self) -> int:
+        return len(self._parts)
+
+    def repartition(self, num_partitions: int) -> "XShards":
+        flat = self.collect_tree()
+        return XShards.partition(flat, num_partitions)
+
+    def __len__(self) -> int:
+        first = self._parts[0]
+        if isinstance(first, dict):
+            k = next(iter(first))
+            return sum(len(p[k]) for p in self._parts)
+        return sum(len(p) for p in self._parts)
+
+    # -------------------------------------------------------------- conversion
+    def collect_tree(self):
+        """Concatenate partitions into one array tree (feeds FeatureSet)."""
+        first = self._parts[0]
+        if isinstance(first, dict):
+            return {k: np.concatenate([np.asarray(p[k]) for p in self._parts])
+                    for k in first}
+        if hasattr(first, "values") and hasattr(first, "columns"):  # DataFrame
+            import pandas as pd
+
+            return pd.concat(self._parts, ignore_index=True)
+        return np.concatenate([np.asarray(p) for p in self._parts])
+
+    def to_featureset(self, feature_cols: Optional[Sequence[str]] = None,
+                      label_cols: Optional[Sequence[str]] = None, **kw):
+        """Build a FeatureSet; for DataFrame shards select feature/label columns
+        (the NNEstimator fit(df, feature_cols, label_cols) capability)."""
+        from .featureset import FeatureSet
+
+        tree = self.collect_tree()
+        if feature_cols is not None:
+            x = np.stack([np.asarray(tree[c]) for c in feature_cols], axis=-1)
+            if label_cols:
+                y = np.stack([np.asarray(tree[c]) for c in label_cols], axis=-1)
+                if y.shape[-1] == 1:
+                    y = y[..., 0]
+                return FeatureSet((x, y), **kw)
+            return FeatureSet((x,), **kw)
+        return FeatureSet(tree if isinstance(tree, tuple) else (tree,), **kw)

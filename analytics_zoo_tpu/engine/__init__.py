@@ -1,0 +1,6 @@
+"""Training engine: Estimator, checkpointing."""
+
+from .checkpoint import latest_checkpoint, load_checkpoint, save_checkpoint
+from .estimator import Estimator
+
+__all__ = ["Estimator", "latest_checkpoint", "load_checkpoint", "save_checkpoint"]

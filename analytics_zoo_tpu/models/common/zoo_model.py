@@ -1,0 +1,94 @@
+"""ZooModel persistence — save/load of model definition + weights.
+
+Parity: /root/reference/zoo/src/main/scala/com/intel/analytics/zoo/models/common/
+ZooModel.scala:38-149 (``saveModel``/``loadModel`` of the ``.analytics-zoo``
+format). The TPU-native format is a directory bundle:
+
+    <path>/
+      config.json     # model class + constructor kwargs (rebuildable models)
+      weights.npz     # flat leaves of (params, model_state)
+      tree.json       # key paths for the leaves
+
+Built-in models register themselves in ``MODEL_REGISTRY`` so ``load_model`` can
+reconstruct the architecture, then restore weights.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+MODEL_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str):
+    def deco(cls):
+        MODEL_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def _flatten(tree) -> Tuple[Dict[str, np.ndarray], Any]:
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)
+    flat = {}
+    for path, leaf in leaves_with_paths[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        flat[key] = np.asarray(jax.device_get(leaf))
+    return flat, leaves_with_paths[1]
+
+
+def save_weights(path: str, params, model_state=None) -> None:
+    os.makedirs(path, exist_ok=True)
+    flat, _ = _flatten({"params": params, "state": model_state or {}})
+    np.savez(os.path.join(path, "weights.npz"), **flat)
+    with open(os.path.join(path, "tree.json"), "w") as f:
+        json.dump(sorted(flat.keys()), f)
+
+
+def load_weights(path: str, params_template, state_template=None):
+    """Restore weights into pytrees shaped like the templates."""
+    data = np.load(os.path.join(path, "weights.npz"))
+    tree = {"params": params_template, "state": state_template or {}}
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    new_leaves = []
+    for p, leaf in paths_and_leaves:
+        key = "/".join(str(getattr(q, "key", getattr(q, "idx", q))) for q in p)
+        if key not in data:
+            raise KeyError(f"weight {key!r} missing from {path}")
+        arr = data[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(f"{key}: saved {arr.shape} != expected {np.shape(leaf)}")
+        new_leaves.append(arr)
+    restored = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return restored["params"], restored["state"]
+
+
+def save_model_bundle(path: str, model, config: Optional[Dict] = None) -> None:
+    """Save a compiled KerasNet (weights + reconstruction config)."""
+    os.makedirs(path, exist_ok=True)
+    est = getattr(model, "estimator", None)
+    if est is None or est.train_state is None:
+        raise RuntimeError("model has no trained state; compile+fit (or build) first")
+    save_weights(path, est.train_state["params"], est.train_state["model_state"])
+    cfg = {"class": type(model).__name__, "config": config or {}}
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(cfg, f)
+
+
+def load_model_bundle(path: str, model=None):
+    """Load a bundle. If ``model`` is given, restore weights into it; otherwise
+    reconstruct from MODEL_REGISTRY (built-in zoo models)."""
+    with open(os.path.join(path, "config.json")) as f:
+        cfg = json.load(f)
+    if model is None:
+        cls = MODEL_REGISTRY.get(cfg["class"])
+        if cls is None:
+            raise ValueError(
+                f"unknown model class {cfg['class']!r}; pass model= explicitly "
+                f"(registered: {sorted(MODEL_REGISTRY)})")
+        model = cls(**cfg["config"])
+    return model, cfg

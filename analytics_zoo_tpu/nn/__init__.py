@@ -1,0 +1,12 @@
+"""Model API layer: modules, layers, losses, metrics, optimizers, topologies."""
+
+from . import activations, layers, losses, metrics, optimizers
+from .graph import GraphModule, Input, Node, SequentialModule
+from .module import Layer, set_policy
+from .topology import KerasNet, Model, Sequential
+
+__all__ = [
+    "GraphModule", "Input", "KerasNet", "Layer", "Model", "Node",
+    "Sequential", "SequentialModule", "activations", "layers", "losses",
+    "metrics", "optimizers", "set_policy",
+]

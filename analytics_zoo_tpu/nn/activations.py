@@ -1,0 +1,106 @@
+"""Activation functions (string-addressable, Keras style).
+
+Parity: /root/reference/zoo/.../pipeline/api/keras/layers/{Activation,SoftMax,...}.scala
+and the activation name resolution in KerasUtils. All are pure ``jnp`` functions that
+XLA fuses into surrounding matmuls (no separate kernels needed on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def linear(x):
+    return x
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hard_sigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def swish(x):
+    return jax.nn.swish(x)
+
+
+ACTIVATIONS: Dict[str, Callable] = {
+    "linear": linear,
+    "identity": linear,
+    "relu": relu,
+    "relu6": relu6,
+    "sigmoid": sigmoid,
+    "hard_sigmoid": hard_sigmoid,
+    "tanh": tanh,
+    "softmax": softmax,
+    "log_softmax": log_softmax,
+    "softplus": softplus,
+    "softsign": softsign,
+    "elu": elu,
+    "selu": selu,
+    "gelu": gelu,
+    "leaky_relu": leaky_relu,
+    "leakyrelu": leaky_relu,
+    "swish": swish,
+    "silu": swish,
+}
+
+
+def get_activation(act: Optional[Union[str, Callable]]) -> Callable:
+    if act is None:
+        return linear
+    if callable(act):
+        return act
+    try:
+        return ACTIVATIONS[act.lower()]
+    except KeyError:
+        raise ValueError(f"unknown activation {act!r}; known: {sorted(ACTIVATIONS)}")

@@ -1,0 +1,211 @@
+"""Functional graph API: ``Input`` nodes + DAG ``GraphModule`` + ``SequentialModule``.
+
+Parity: the reference's Keras functional API — ``val x = Input(shape); val y =
+Dense(...).inputs(x); Model(x, y)`` (/root/reference/zoo/.../pipeline/api/keras/models/
+Topology.scala:605-828 and KerasLayer.inputs). Here ``layer(node)`` connects layers.
+
+The graph is purely a *build-time* structure: at apply time it unrolls into straight-
+line JAX code, so XLA sees one flat program to fuse — no interpreter overhead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from .layers.core import InputLayer
+from .module import Layer, PyTree, Shape, split_rng
+
+
+class Node:
+    """One tensor in the DAG: produced by ``layer`` applied to ``inbound`` nodes."""
+
+    _uid = 0
+
+    def __init__(self, layer: Layer, inbound: List["Node"], shape: Shape):
+        self.layer = layer
+        self.inbound = inbound
+        self.shape = tuple(shape)
+        Node._uid += 1
+        self.uid = Node._uid
+
+    def __repr__(self):
+        return f"Node({self.layer.name}, shape={self.shape})"
+
+
+def Input(shape: Shape, name: Optional[str] = None) -> Node:
+    """Create a graph input (Input.scala parity). ``shape`` excludes batch dim."""
+    layer = InputLayer(tuple(shape), name=name)
+    return Node(layer, [], tuple(shape))
+
+
+def apply_layer(layer: Layer, node_or_nodes) -> Node:
+    if isinstance(node_or_nodes, (list, tuple)):
+        nodes = list(node_or_nodes)
+        if not all(isinstance(n, Node) for n in nodes):
+            raise TypeError("layer called on a list must receive Nodes")
+        in_shape = [n.shape for n in nodes]
+        out_shape = layer.compute_output_shape(in_shape)
+        return Node(layer, nodes, out_shape)
+    node = node_or_nodes
+    if not isinstance(node, Node):
+        raise TypeError(
+            f"{layer.name} called on {type(node)}; use layer.apply(params, state, x) "
+            "for direct application or pass a graph Node")
+    out_shape = layer.compute_output_shape(node.shape)
+    return Node(layer, [node], out_shape)
+
+
+def _topo_order(outputs: Sequence[Node]) -> List[Node]:
+    order: List[Node] = []
+    seen = set()
+
+    def visit(n: Node):
+        if n.uid in seen:
+            return
+        seen.add(n.uid)
+        for p in n.inbound:
+            visit(p)
+        order.append(n)
+
+    for o in outputs:
+        visit(o)
+    return order
+
+
+class GraphModule(Layer):
+    """DAG of layers between ``inputs`` and ``outputs`` nodes (Model topology)."""
+
+    def __init__(self, inputs: Union[Node, Sequence[Node]],
+                 outputs: Union[Node, Sequence[Node]], name: Optional[str] = None):
+        super().__init__(name=name)
+        self.input_nodes = [inputs] if isinstance(inputs, Node) else list(inputs)
+        self.output_nodes = [outputs] if isinstance(outputs, Node) else list(outputs)
+        self.single_input = isinstance(inputs, Node)
+        self.single_output = isinstance(outputs, Node)
+        self.nodes = _topo_order(self.output_nodes)
+        for n in self.nodes:
+            if isinstance(n.layer, InputLayer) and n not in self.input_nodes:
+                raise ValueError(f"graph uses Input node {n} not listed in inputs")
+        # one entry per unique layer (a layer may appear at several nodes = weight sharing)
+        self.layers: List[Layer] = []
+        seen = set()
+        for n in self.nodes:
+            if id(n.layer) not in seen and not isinstance(n.layer, InputLayer):
+                seen.add(id(n.layer))
+                self.layers.append(n.layer)
+
+    @property
+    def input_shape(self):
+        shapes = [n.shape for n in self.input_nodes]
+        return shapes[0] if self.single_input else shapes
+
+    @property
+    def output_shape(self):
+        shapes = [n.shape for n in self.output_nodes]
+        return shapes[0] if self.single_output else shapes
+
+    def build(self, rng, input_shape=None):
+        params: Dict[str, PyTree] = {}
+        state: Dict[str, PyTree] = {}
+        rngs = split_rng(rng, len(self.layers))
+        # shapes are already known per node; build each unique layer once with the
+        # shape(s) at its first occurrence
+        first_node: Dict[int, Node] = {}
+        for n in self.nodes:
+            first_node.setdefault(id(n.layer), n)
+        for r, layer in zip(rngs, self.layers):
+            node = first_node[id(layer)]
+            in_shape = (node.inbound[0].shape if len(node.inbound) == 1
+                        else [p.shape for p in node.inbound])
+            p, s = layer.build(r, in_shape)
+            if p:
+                params[layer.name] = p
+            if s:
+                state[layer.name] = s
+        return params, state
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        xs = [x] if self.single_input else list(x)
+        if len(xs) != len(self.input_nodes):
+            raise ValueError(f"expected {len(self.input_nodes)} inputs, got {len(xs)}")
+        values: Dict[int, Any] = {}
+        for node, val in zip(self.input_nodes, xs):
+            values[node.uid] = val
+        new_state = dict(state)
+        rngs = iter(split_rng(rng, len(self.nodes)))
+        for node in self.nodes:
+            if node.uid in values:
+                continue
+            layer = node.layer
+            inp = (values[node.inbound[0].uid] if len(node.inbound) == 1
+                   else [values[p.uid] for p in node.inbound])
+            p = params.get(layer.name, {})
+            s = new_state.get(layer.name, {})
+            y, s2 = layer.apply(p, s, inp, training=training, rng=next(rngs))
+            if s2 != {} or layer.name in new_state:
+                new_state[layer.name] = s2
+            values[node.uid] = y
+        outs = [values[n.uid] for n in self.output_nodes]
+        return (outs[0] if self.single_output else outs), new_state
+
+    def compute_output_shape(self, input_shape):
+        return self.output_shape
+
+
+class SequentialModule(Layer):
+    """Linear stack of layers (Sequential.scala parity, Topology.scala:828)."""
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None, name=None):
+        super().__init__(name=name)
+        self.layers: List[Layer] = list(layers) if layers else []
+
+    def add(self, layer: Layer) -> "SequentialModule":
+        self.layers.append(layer)
+        return self
+
+    @property
+    def input_shape(self):
+        for l in self.layers:
+            if l.input_shape_hint is not None:
+                return l.input_shape_hint
+        raise ValueError("Sequential: first layer needs input_shape=...")
+
+    @property
+    def output_shape(self):
+        shape = self.input_shape
+        for l in self.layers:
+            shape = l.compute_output_shape(shape)
+        return shape
+
+    def build(self, rng, input_shape=None):
+        shape = tuple(input_shape) if input_shape is not None else self.input_shape
+        params, state = {}, {}
+        rngs = split_rng(rng, len(self.layers))
+        for r, layer in zip(rngs, self.layers):
+            p, s = layer.build(r, shape)
+            if p:
+                params[layer.name] = p
+            if s:
+                state[layer.name] = s
+            shape = layer.compute_output_shape(shape)
+        return params, state
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        new_state = dict(state)
+        rngs = iter(split_rng(rng, len(self.layers)))
+        for layer in self.layers:
+            p = params.get(layer.name, {})
+            s = new_state.get(layer.name, {})
+            x, s2 = layer.apply(p, s, x, training=training, rng=next(rngs))
+            if s2 != {} or layer.name in new_state:
+                new_state[layer.name] = s2
+        return x, new_state
+
+    def compute_output_shape(self, input_shape):
+        shape = input_shape
+        for l in self.layers:
+            shape = l.compute_output_shape(shape)
+        return shape

@@ -1,0 +1,29 @@
+"""Layer library (Keras-1-style naming, /root/reference/zoo/.../keras/layers/ parity)."""
+
+from .core import (Activation, Dense, Dropout, ExpandDim, Flatten, GaussianDropout,
+                   GaussianNoise, InputLayer, Lambda, Masking, Narrow, Permute,
+                   RepeatVector, Reshape, Select, SparseDense, Squeeze)
+from .convolution import (AveragePooling1D, AveragePooling2D, Convolution1D,
+                          Convolution2D, GlobalAveragePooling1D,
+                          GlobalAveragePooling2D, GlobalMaxPooling1D,
+                          GlobalMaxPooling2D, MaxPooling1D, MaxPooling2D,
+                          UpSampling2D, ZeroPadding2D)
+from .embedding import Embedding, SparseEmbedding, WordEmbedding
+from .merge import Merge, merge
+from .normalization import BatchNormalization, LayerNormalization
+from .recurrent import (GRU, LSTM, Bidirectional, SimpleRNN, TimeDistributed)
+
+Conv1D = Convolution1D
+Conv2D = Convolution2D
+
+__all__ = [
+    "Activation", "AveragePooling1D", "AveragePooling2D", "BatchNormalization",
+    "Bidirectional", "Conv1D", "Conv2D", "Convolution1D", "Convolution2D", "Dense",
+    "Dropout", "Embedding", "ExpandDim", "Flatten", "GRU", "GaussianDropout",
+    "GaussianNoise", "GlobalAveragePooling1D", "GlobalAveragePooling2D",
+    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "InputLayer", "LSTM", "Lambda",
+    "LayerNormalization", "Masking", "MaxPooling1D", "MaxPooling2D", "Merge",
+    "Narrow", "Permute", "RepeatVector", "Reshape", "Select", "SimpleRNN",
+    "SparseDense", "SparseEmbedding", "Squeeze", "TimeDistributed", "UpSampling2D",
+    "WordEmbedding", "ZeroPadding2D", "merge",
+]

@@ -1,0 +1,237 @@
+"""Convolution + pooling layers.
+
+Parity: Convolution1D/2D/3D.scala, MaxPooling*/AveragePooling*.scala,
+GlobalMaxPooling*/GlobalAveragePooling*.scala, UpSampling2D.scala, ZeroPadding2D
+(/root/reference/zoo/.../pipeline/api/keras/layers/). Data layout is **NHWC**
+(channels-last) — the TPU-native layout XLA tiles best — rather than the reference's
+BigDL NCHW default; ``dim_ordering='th'`` inputs are transposed on entry.
+
+Convs run via ``lax.conv_general_dilated`` which XLA lowers straight onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..activations import get_activation
+from ..module import Layer, as_compute, get_initializer, param_dtype
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v, v)
+
+
+class Convolution2D(Layer):
+    """2D conv, NHWC. ``border_mode``: 'valid' | 'same' (Convolution2D.scala)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int, activation=None,
+                 border_mode: str = "valid", subsample=(1, 1), init="glorot_uniform",
+                 use_bias: bool = True, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.filters = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.strides = _pair(subsample)
+        self.padding = border_mode.upper()
+        self.activation = get_activation(activation)
+        self.init = get_initializer(init)
+        self.use_bias = use_bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        kh, kw = self.kernel_size
+        params = {"kernel": self.init(rng, (kh, kw, in_ch, self.filters), param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        kernel = jnp.asarray(params["kernel"], x.dtype)
+        y = jax.lax.conv_general_dilated(
+            x, kernel, window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        h, w, _ = input_shape
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        if self.padding == "SAME":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        return (oh, ow, self.filters)
+
+
+class Convolution1D(Layer):
+    """1D conv over (B, steps, dim) — the TextClassifier path (Convolution1D.scala)."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 border_mode: str = "valid", subsample_length: int = 1,
+                 init="glorot_uniform", use_bias: bool = True, name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.filters = int(nb_filter)
+        self.kernel_size = int(filter_length)
+        self.stride = int(subsample_length)
+        self.padding = border_mode.upper()
+        self.activation = get_activation(activation)
+        self.init = get_initializer(init)
+        self.use_bias = use_bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        params = {"kernel": self.init(rng, (self.kernel_size, in_ch, self.filters),
+                                      param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        kernel = jnp.asarray(params["kernel"], x.dtype)
+        y = jax.lax.conv_general_dilated(
+            x, kernel, window_strides=(self.stride,), padding=self.padding,
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        steps, _ = input_shape
+        if self.padding == "SAME":
+            out = -(-steps // self.stride)
+        else:
+            out = (steps - self.kernel_size) // self.stride + 1
+        return (out, self.filters)
+
+
+class _Pool2D(Layer):
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.padding = border_mode.upper()
+
+    def _reduce(self, x, init, op):
+        return jax.lax.reduce_window(
+            x, init, op, window_dimensions=(1,) + self.pool_size + (1,),
+            window_strides=(1,) + self.strides + (1,), padding=self.padding)
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        if self.padding == "SAME":
+            return (-(-h // sh), -(-w // sw), c)
+        return ((h - ph) // sh + 1, (w - pw) // sw + 1, c)
+
+
+class MaxPooling2D(_Pool2D):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self._reduce(x, -jnp.inf, jax.lax.max), state
+
+
+class AveragePooling2D(_Pool2D):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        summed = self._reduce(x, 0.0, jax.lax.add)
+        return summed / (self.pool_size[0] * self.pool_size[1]), state
+
+
+class _Pool1D(Layer):
+    def __init__(self, pool_length=2, stride=None, border_mode="valid", name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.pool_length = int(pool_length)
+        self.stride = int(stride) if stride is not None else self.pool_length
+        self.padding = border_mode.upper()
+
+    def _reduce(self, x, init, op):
+        return jax.lax.reduce_window(
+            x, init, op, window_dimensions=(1, self.pool_length, 1),
+            window_strides=(1, self.stride, 1), padding=self.padding)
+
+    def compute_output_shape(self, input_shape):
+        steps, c = input_shape
+        if self.padding == "SAME":
+            return (-(-steps // self.stride), c)
+        return ((steps - self.pool_length) // self.stride + 1, c)
+
+
+class MaxPooling1D(_Pool1D):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self._reduce(x, -jnp.inf, jax.lax.max), state
+
+
+class AveragePooling1D(_Pool1D):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self._reduce(x, 0.0, jax.lax.add) / self.pool_length, state
+
+
+class GlobalMaxPooling1D(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.max(x, axis=1), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+
+class GlobalAveragePooling1D(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.mean(x, axis=1), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+
+class GlobalMaxPooling2D(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.max(x, axis=(1, 2)), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+
+class GlobalAveragePooling2D(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.mean(x, axis=(1, 2)), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+
+class UpSampling2D(Layer):
+    def __init__(self, size=(2, 2), name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.size = _pair(size)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = jnp.repeat(x, self.size[0], axis=1)
+        return jnp.repeat(y, self.size[1], axis=2), state
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (h * self.size[0], w * self.size[1], c)
+
+
+class ZeroPadding2D(Layer):
+    def __init__(self, padding=(1, 1), name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.pad = _pair(padding)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        ph, pw = self.pad
+        return jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0))), state
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (h + 2 * self.pad[0], w + 2 * self.pad[1], c)

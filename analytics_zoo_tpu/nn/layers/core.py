@@ -1,0 +1,288 @@
+"""Core layers: Dense, Dropout, Flatten, Reshape, shape ops, Lambda.
+
+Parity targets (all /root/reference/zoo/src/main/scala/com/intel/analytics/zoo/
+pipeline/api/keras/layers/): Dense.scala, Dropout.scala, Flatten.scala,
+Reshape.scala, Permute.scala, RepeatVector.scala, Select.scala, Squeeze.scala,
+ExpandDim.scala, Narrow.scala, Masking.scala, GaussianNoise/Dropout.scala,
+SparseDense.scala. Each is a thin pure function over ``jnp`` — XLA fuses them; the
+only matmul (Dense) lands on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..activations import get_activation
+from ..module import (Layer, Shape, as_compute, compute_dtype, get_initializer,
+                      param_dtype)
+
+
+class InputLayer(Layer):
+    """Placeholder layer carrying an input shape (Input.scala parity)."""
+
+    def __init__(self, input_shape: Shape, name: Optional[str] = None):
+        super().__init__(name=name, input_shape=input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x, state
+
+
+class Dense(Layer):
+    """Fully-connected layer: ``y = act(x @ W + b)``.
+
+    Parity: Dense.scala (wraps BigDL Linear). ``W`` is stored ``(in, out)`` so the
+    forward is a single MXU matmul with no transpose.
+    """
+
+    def __init__(self, output_dim: int, activation=None, use_bias: bool = True,
+                 init="glorot_uniform", w_regularizer=None, b_regularizer=None,
+                 name: Optional[str] = None, input_shape: Optional[Shape] = None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.output_dim = int(output_dim)
+        self.activation = get_activation(activation)
+        self.use_bias = use_bias
+        self.init = get_initializer(init)
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def build(self, rng, input_shape):
+        in_dim = input_shape[-1]
+        k_w, _ = jax.random.split(rng)
+        params = {"kernel": self.init(k_w, (in_dim, self.output_dim), param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.output_dim,), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        kernel = jnp.asarray(params["kernel"], x.dtype)
+        y = x @ kernel
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class SparseDense(Dense):
+    """Dense over sparse-ish inputs (SparseDense.scala parity).
+
+    On TPU a dense matmul on the MXU beats sparse gather for the reference's use
+    cases (wide models); kept as an alias with the same semantics.
+    """
+
+
+class Activation(Layer):
+    def __init__(self, activation, name: Optional[str] = None,
+                 input_shape: Optional[Shape] = None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.activation = get_activation(activation)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self.activation(as_compute(x)), state
+
+
+class Dropout(Layer):
+    """Inverted dropout (Dropout.scala parity). Identity at inference."""
+
+    def __init__(self, p: float, name: Optional[str] = None,
+                 input_shape: Optional[Shape] = None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.rate = float(p)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or self.rate <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError(f"{self.name}: dropout in training mode needs an rng")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), state
+
+
+class GaussianNoise(Layer):
+    def __init__(self, sigma: float, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.sigma = float(sigma)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training:
+            return x, state
+        if rng is None:
+            raise ValueError(f"{self.name}: needs rng in training mode")
+        return x + self.sigma * jax.random.normal(rng, x.shape, x.dtype), state
+
+
+class GaussianDropout(Layer):
+    def __init__(self, p: float, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.rate = float(p)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or self.rate <= 0:
+            return x, state
+        if rng is None:
+            raise ValueError(f"{self.name}: needs rng in training mode")
+        std = np.sqrt(self.rate / (1.0 - self.rate))
+        return x * (1.0 + std * jax.random.normal(rng, x.shape, x.dtype)), state
+
+
+class Flatten(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x.reshape(x.shape[0], -1), state
+
+    def compute_output_shape(self, input_shape):
+        return (int(np.prod(input_shape)),)
+
+
+class Reshape(Layer):
+    """Reshape (batch dim preserved); one target dim may be -1 (Reshape.scala)."""
+
+    def __init__(self, target_shape: Sequence[int], name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.target_shape = tuple(target_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x.reshape((x.shape[0],) + self.target_shape), state
+
+    def compute_output_shape(self, input_shape):
+        if -1 in self.target_shape:
+            total = int(np.prod(input_shape))
+            known = -int(np.prod(self.target_shape))
+            return tuple(total // known if d == -1 else d for d in self.target_shape)
+        return self.target_shape
+
+
+class Permute(Layer):
+    """Permute non-batch dims; ``dims`` are 1-indexed like Keras (Permute.scala)."""
+
+    def __init__(self, dims: Sequence[int], name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.dims = tuple(dims)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        perm = (0,) + tuple(d for d in self.dims)
+        return jnp.transpose(x, perm), state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[d - 1] for d in self.dims)
+
+
+class RepeatVector(Layer):
+    def __init__(self, n: int, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.n = int(n)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), state
+
+    def compute_output_shape(self, input_shape):
+        return (self.n,) + tuple(input_shape)
+
+
+class Select(Layer):
+    """Select index ``index`` along (0-indexed, batch-excluded) ``dim``.
+
+    Parity: Select.scala (used by NeuralCF to split the [user,item] input pair,
+    models/recommendation/NeuralCF.scala:59-60).
+    """
+
+    def __init__(self, dim: int, index: int, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.dim = int(dim)
+        self.index = int(index)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.take(x, self.index, axis=self.dim + 1 if self.dim >= 0 else self.dim), state
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        del shape[self.dim]
+        return tuple(shape)
+
+
+class Narrow(Layer):
+    """Slice ``length`` elements starting at ``offset`` along ``dim`` (Narrow.scala)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.dim, self.offset, self.length = int(dim), int(offset), int(length)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        axis = self.dim + 1 if self.dim >= 0 else self.dim
+        return jax.lax.slice_in_dim(x, self.offset, self.offset + self.length, axis=axis), state
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        shape[self.dim] = self.length
+        return tuple(shape)
+
+
+class Squeeze(Layer):
+    def __init__(self, dim: int, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.dim = int(dim)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.squeeze(x, axis=self.dim + 1), state
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        del shape[self.dim]
+        return tuple(shape)
+
+
+class ExpandDim(Layer):
+    def __init__(self, dim: int, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.dim = int(dim)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.expand_dims(x, axis=self.dim + 1), state
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        shape.insert(self.dim, 1)
+        return tuple(shape)
+
+
+class Masking(Layer):
+    """Zero out timesteps equal to ``mask_value`` (Masking.scala)."""
+
+    def __init__(self, mask_value: float = 0.0, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.mask_value = mask_value
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, x, 0.0).astype(x.dtype), state
+
+
+class Lambda(Layer):
+    """Wrap an arbitrary JAX function as a layer.
+
+    Parity: the autograd ``Lambda`` capability (/root/reference/zoo/.../pipeline/api/
+    autograd/Lambda.scala) — in JAX any pure function is differentiable, so this IS
+    the autograd layer, no symbolic Variable algebra needed.
+    """
+
+    def __init__(self, fn: Callable, output_shape_fn: Optional[Callable] = None,
+                 name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.fn = fn
+        self.output_shape_fn = output_shape_fn
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if isinstance(x, (list, tuple)):
+            return self.fn(*x), state
+        return self.fn(x), state
+
+    def compute_output_shape(self, input_shape):
+        if self.output_shape_fn is not None:
+            return self.output_shape_fn(input_shape)
+        return input_shape

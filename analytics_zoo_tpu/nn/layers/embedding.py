@@ -1,0 +1,85 @@
+"""Embedding layers.
+
+Parity: Embedding.scala, SparseEmbedding.scala, WordEmbedding.scala
+(/root/reference/zoo/.../pipeline/api/keras/layers/). On TPU an embedding lookup is a
+gather from an HBM-resident table; for tensor-parallel runs the table is sharded over
+the ``tp`` mesh axis by rows (see analytics_zoo_tpu.parallel.sharding).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..module import Layer, Shape, get_initializer, param_dtype
+
+
+class Embedding(Layer):
+    """Lookup table ``(input_dim, output_dim)``; input is int ids ``(B, ...)``.
+
+    Matches the reference's 1-based-safe sizing convention (NeuralCF allocates
+    ``userCount + 1`` rows — models/recommendation/NeuralCF.scala:65).
+    """
+
+    def __init__(self, input_dim: int, output_dim: int, init="uniform",
+                 weights: Optional[np.ndarray] = None, trainable: bool = True,
+                 name=None, input_shape: Optional[Shape] = None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.init = get_initializer(init)
+        self.pretrained = weights
+        self.trainable = trainable
+
+    def build(self, rng, input_shape):
+        if self.pretrained is not None:
+            table = jnp.asarray(self.pretrained, param_dtype())
+            assert table.shape == (self.input_dim, self.output_dim), (
+                f"pretrained weights {table.shape} != "
+                f"({self.input_dim}, {self.output_dim})")
+        else:
+            table = self.init(rng, (self.input_dim, self.output_dim), param_dtype())
+        if self.trainable:
+            return {"embeddings": table}, {}
+        return {}, {"embeddings": table}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        table = params["embeddings"] if self.trainable else state["embeddings"]
+        ids = jnp.asarray(x, jnp.int32)
+        return jnp.take(table, ids, axis=0), state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+
+class SparseEmbedding(Embedding):
+    """Reference's SparseEmbedding keeps sparse gradients for the table
+    (SparseEmbedding.scala). Under JAX, gather gradients are already scatter-adds
+    that XLA emits natively; semantics are identical, so this is an alias."""
+
+
+class WordEmbedding(Embedding):
+    """Frozen pretrained word-embedding layer (WordEmbedding.scala parity —
+    used by TextClassifier / TextMatcher with GloVe tables)."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 weights: Optional[np.ndarray] = None, name=None, input_shape=None):
+        super().__init__(input_dim, output_dim, weights=weights, trainable=False,
+                         name=name, input_shape=input_shape)
+
+    @staticmethod
+    def from_glove(path: str, word_index: dict, output_dim: int = 100):
+        """Build a frozen table from a GloVe text file + word index
+        (WordEmbedding.scala companion loader parity)."""
+        vocab = max(word_index.values()) + 1
+        table = np.random.RandomState(0).normal(0, 0.05, (vocab, output_dim)).astype("float32")
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip().split(" ")
+                w, vec = parts[0], parts[1:]
+                if w in word_index and len(vec) == output_dim:
+                    table[word_index[w]] = np.asarray(vec, dtype="float32")
+        return WordEmbedding(vocab, output_dim, weights=table)

@@ -1,0 +1,84 @@
+"""Merge layers — combine multiple branches (two-tower models etc.).
+
+Parity: Merge.scala / merge() (/root/reference/zoo/.../pipeline/api/keras/layers/
+Merge.scala), the mechanism NeuralCF uses for concat/mul tower fusion
+(models/recommendation/NeuralCF.scala:71,89-91).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..module import Layer, Shape
+
+
+class Merge(Layer):
+    """Merge a list of inputs: concat | sum | mul | ave | max | min | dot | cos.
+
+    ``concat_axis`` is 0-indexed over non-batch dims (reference uses 1-indexed
+    including batch; adapterd here to the framework convention).
+    """
+
+    MODES = ("concat", "sum", "mul", "ave", "max", "min", "dot", "cos")
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1, name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        mode = mode.lower()
+        if mode not in self.MODES:
+            raise ValueError(f"unknown merge mode {mode!r}")
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def apply(self, params, state, xs, *, training=False, rng=None):
+        assert isinstance(xs, (list, tuple)) and len(xs) >= 2, "Merge needs >=2 inputs"
+        if self.mode == "concat":
+            axis = self.concat_axis if self.concat_axis < 0 else self.concat_axis + 1
+            return jnp.concatenate(xs, axis=axis), state
+        if self.mode == "sum":
+            return sum(xs[1:], xs[0]), state
+        if self.mode == "ave":
+            return sum(xs[1:], xs[0]) / len(xs), state
+        if self.mode == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out, state
+        if self.mode == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out, state
+        if self.mode == "min":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
+            return out, state
+        if self.mode == "dot":
+            a, b = xs
+            return jnp.sum(a * b, axis=-1, keepdims=True), state
+        if self.mode == "cos":
+            a, b = xs
+            na = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + 1e-8)
+            nb = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + 1e-8)
+            return jnp.sum(na * nb, axis=-1, keepdims=True), state
+        raise AssertionError(self.mode)
+
+    def compute_output_shape(self, input_shapes):
+        shapes = [tuple(s) for s in input_shapes]
+        if self.mode == "concat":
+            axis = self.concat_axis if self.concat_axis >= 0 else len(shapes[0]) + self.concat_axis
+            out = list(shapes[0])
+            out[axis] = sum(s[axis] for s in shapes)
+            return tuple(out)
+        if self.mode in ("dot", "cos"):
+            return (1,)
+        return shapes[0]
+
+
+def merge(inputs, mode: str = "sum", concat_axis: int = -1, name=None):
+    """Functional-graph helper: ``merge([a, b], mode="concat")`` (Merge.merge parity)."""
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(list(inputs))

@@ -1,0 +1,153 @@
+"""Loss objectives (Keras-style, string-addressable).
+
+Parity: the 15 objectives in /root/reference/zoo/.../pipeline/api/keras/objectives/
+(MeanSquaredError, MeanAbsoluteError, MAPE, MSLE, BinaryCrossEntropy,
+CategoricalCrossEntropy, SparseCategoricalCrossEntropy, KullbackLeiblerDivergence,
+Poisson, CosineProximity, Hinge, SquaredHinge, RankHinge, MeanAbsolutePercentageError)
+plus the ``CustomLoss`` capability (api/autograd/CustomLoss.scala) — in JAX any
+``f(y_true, y_pred) -> scalar`` IS a custom loss; pass the callable directly.
+
+All losses reduce to a scalar mean over the batch; computations are float32 for
+numerical stability regardless of the compute dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def _f32(y_true, y_pred):
+    return jnp.asarray(y_true, jnp.float32), jnp.asarray(y_pred, jnp.float32)
+
+
+def mean_squared_error(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    return jnp.mean(jnp.square(y_pred - y_true))
+
+
+def mean_absolute_error(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    return jnp.mean(jnp.abs(y_pred - y_true))
+
+
+def mean_absolute_percentage_error(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    diff = jnp.abs((y_true - y_pred) / jnp.clip(jnp.abs(y_true), _EPS, None))
+    return 100.0 * jnp.mean(diff)
+
+
+def mean_squared_logarithmic_error(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    a = jnp.log(jnp.clip(y_pred, _EPS, None) + 1.0)
+    b = jnp.log(jnp.clip(y_true, _EPS, None) + 1.0)
+    return jnp.mean(jnp.square(a - b))
+
+
+def binary_crossentropy(y_true, y_pred, from_logits: bool = False):
+    y_true, y_pred = _f32(y_true, y_pred)
+    if from_logits:
+        return jnp.mean(
+            jnp.maximum(y_pred, 0) - y_pred * y_true + jnp.log1p(jnp.exp(-jnp.abs(y_pred))))
+    p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
+    return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
+
+
+def categorical_crossentropy(y_true, y_pred, from_logits: bool = False):
+    y_true, y_pred = _f32(y_true, y_pred)
+    if from_logits:
+        logp = jax.nn.log_softmax(y_pred, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(y_pred, _EPS, 1.0))
+    return -jnp.mean(jnp.sum(y_true * logp, axis=-1))
+
+
+def sparse_categorical_crossentropy(y_true, y_pred, from_logits: bool = False):
+    """``y_true`` int class ids (B,) or (B,1); ``y_pred`` (B, C).
+
+    Matches the reference's SparseCategoricalCrossEntropy (zeroBasedLabel=true
+    default; the BigDL ClassNLL 1-based convention is hidden from users).
+    """
+    y_pred = jnp.asarray(y_pred, jnp.float32)
+    labels = jnp.asarray(y_true, jnp.int32).reshape(y_pred.shape[:-1])
+    if from_logits:
+        logp = jax.nn.log_softmax(y_pred, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(y_pred, _EPS, 1.0))
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def kullback_leibler_divergence(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    p = jnp.clip(y_true, _EPS, 1.0)
+    q = jnp.clip(y_pred, _EPS, 1.0)
+    return jnp.mean(jnp.sum(p * jnp.log(p / q), axis=-1))
+
+
+def poisson(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    return jnp.mean(y_pred - y_true * jnp.log(y_pred + _EPS))
+
+
+def cosine_proximity(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    a = y_true / (jnp.linalg.norm(y_true, axis=-1, keepdims=True) + _EPS)
+    b = y_pred / (jnp.linalg.norm(y_pred, axis=-1, keepdims=True) + _EPS)
+    return -jnp.mean(jnp.sum(a * b, axis=-1))
+
+
+def hinge(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
+
+
+def squared_hinge(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    return jnp.mean(jnp.square(jnp.maximum(1.0 - y_true * y_pred, 0.0)))
+
+
+def rank_hinge(y_true, y_pred, margin: float = 1.0):
+    """Pairwise rank hinge for (pos, neg) interleaved batches (RankHinge.scala,
+    used by KNRM/qaranker: batch is [pos, neg, pos, neg, ...])."""
+    y_pred = jnp.asarray(y_pred, jnp.float32).reshape(-1)
+    pos = y_pred[0::2]
+    neg = y_pred[1::2]
+    return jnp.mean(jnp.maximum(margin - pos + neg, 0.0))
+
+
+LOSSES: Dict[str, Callable] = {
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mape": mean_absolute_percentage_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "msle": mean_squared_logarithmic_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "binary_crossentropy": binary_crossentropy,
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "kld": kullback_leibler_divergence,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "rank_hinge": rank_hinge,
+}
+
+
+def get_loss(loss: Union[str, Callable]) -> Callable:
+    """Resolve a loss by name, or accept any ``f(y_true, y_pred)->scalar``
+    (CustomLoss parity)."""
+    if callable(loss):
+        return loss
+    try:
+        return LOSSES[loss.lower()]
+    except KeyError:
+        raise ValueError(f"unknown loss {loss!r}; known: {sorted(LOSSES)}")

@@ -1,0 +1,243 @@
+"""Evaluation metrics.
+
+Parity: /root/reference/zoo/.../pipeline/api/keras/metrics/Accuracy.scala:36-99
+(Accuracy / SparseCategoricalAccuracy / BinaryAccuracy / CategoricalAccuracy / Top5),
+AUC.scala, MAE.scala; ranking metrics NDCG / MAP from models/common/Ranker.scala:81-99
+and the HitRate@k validation used by the NCF app.
+
+Metrics are *streaming*: ``update(acc, y_true, y_pred) -> acc`` returns pure pytree
+accumulators so evaluation folds under ``jit`` and across sharded batches with a
+final host-side ``result``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class Metric:
+    name = "metric"
+
+    def init(self):
+        return {"total": jnp.zeros((), jnp.float32), "count": jnp.zeros((), jnp.float32)}
+
+    def update(self, acc, y_true, y_pred):
+        raise NotImplementedError
+
+    def result(self, acc) -> float:
+        return float(acc["total"] / jnp.maximum(acc["count"], 1.0))
+
+
+class SparseCategoricalAccuracy(Metric):
+    """Labels are int ids; predictions are (B, C) scores (Accuracy.scala:56)."""
+
+    name = "sparse_categorical_accuracy"
+
+    def update(self, acc, y_true, y_pred):
+        labels = jnp.asarray(y_true, jnp.int32).reshape(-1)
+        pred = jnp.argmax(y_pred, axis=-1).reshape(-1)
+        return {"total": acc["total"] + jnp.sum(pred == labels),
+                "count": acc["count"] + labels.shape[0]}
+
+
+class CategoricalAccuracy(Metric):
+    """One-hot labels (Accuracy.scala:84)."""
+
+    name = "categorical_accuracy"
+
+    def update(self, acc, y_true, y_pred):
+        labels = jnp.argmax(y_true, axis=-1).reshape(-1)
+        pred = jnp.argmax(y_pred, axis=-1).reshape(-1)
+        return {"total": acc["total"] + jnp.sum(pred == labels),
+                "count": acc["count"] + labels.shape[0]}
+
+
+class BinaryAccuracy(Metric):
+    """Threshold-0.5 accuracy (Accuracy.scala:70)."""
+
+    name = "binary_accuracy"
+
+    def update(self, acc, y_true, y_pred):
+        labels = jnp.asarray(y_true, jnp.float32).reshape(-1)
+        pred = (jnp.asarray(y_pred, jnp.float32).reshape(-1) > 0.5).astype(jnp.float32)
+        return {"total": acc["total"] + jnp.sum(pred == labels),
+                "count": acc["count"] + labels.shape[0]}
+
+
+class TopK(Metric):
+    """Top-k categorical accuracy (Top5Accuracy parity, Accuracy.scala:99)."""
+
+    def __init__(self, k: int = 5):
+        self.k = k
+        self.name = f"top{k}_accuracy"
+
+    def update(self, acc, y_true, y_pred):
+        labels = jnp.asarray(y_true, jnp.int32).reshape(-1)
+        _, topk = jax.lax.top_k(y_pred, self.k)
+        hit = jnp.any(topk == labels[:, None], axis=-1)
+        return {"total": acc["total"] + jnp.sum(hit),
+                "count": acc["count"] + labels.shape[0]}
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def update(self, acc, y_true, y_pred):
+        err = jnp.abs(jnp.asarray(y_true, jnp.float32) - jnp.asarray(y_pred, jnp.float32))
+        return {"total": acc["total"] + jnp.sum(err),
+                "count": acc["count"] + err.size}
+
+
+class MSE(Metric):
+    name = "mse"
+
+    def update(self, acc, y_true, y_pred):
+        err = jnp.square(jnp.asarray(y_true, jnp.float32) - jnp.asarray(y_pred, jnp.float32))
+        return {"total": acc["total"] + jnp.sum(err),
+                "count": acc["count"] + err.size}
+
+
+class Loss(Metric):
+    """Wraps a loss fn as a streaming metric (BigDL ``Loss`` validation parity)."""
+
+    def __init__(self, loss_fn):
+        from .losses import get_loss
+
+        self.loss_fn = get_loss(loss_fn)
+        self.name = "loss"
+
+    def update(self, acc, y_true, y_pred):
+        b = jnp.asarray(y_pred).shape[0]
+        return {"total": acc["total"] + self.loss_fn(y_true, y_pred) * b,
+                "count": acc["count"] + b}
+
+
+class AUC(Metric):
+    """Streaming ROC-AUC via fixed-threshold histogram (AUC.scala parity; the
+    reference also bins by thresholds). 200 buckets over [0, 1]."""
+
+    name = "auc"
+
+    def __init__(self, n_thresholds: int = 200):
+        self.n = n_thresholds
+
+    def init(self):
+        return {"tp": jnp.zeros((self.n,), jnp.float32),
+                "fp": jnp.zeros((self.n,), jnp.float32),
+                "pos": jnp.zeros((), jnp.float32),
+                "neg": jnp.zeros((), jnp.float32)}
+
+    def update(self, acc, y_true, y_pred):
+        y = jnp.asarray(y_true, jnp.float32).reshape(-1)
+        p = jnp.asarray(y_pred, jnp.float32).reshape(-1)
+        thresholds = jnp.linspace(0.0, 1.0, self.n)
+        above = p[None, :] >= thresholds[:, None]          # (n, B)
+        tp = jnp.sum(above * y[None, :], axis=1)
+        fp = jnp.sum(above * (1 - y)[None, :], axis=1)
+        return {"tp": acc["tp"] + tp, "fp": acc["fp"] + fp,
+                "pos": acc["pos"] + jnp.sum(y), "neg": acc["neg"] + jnp.sum(1 - y)}
+
+    def result(self, acc):
+        tpr = acc["tp"] / jnp.maximum(acc["pos"], 1.0)
+        fpr = acc["fp"] / jnp.maximum(acc["neg"], 1.0)
+        # thresholds ascend => fpr/tpr descend; integrate with trapezoid
+        auc = -jnp.trapezoid(tpr, fpr)
+        return float(auc)
+
+
+# --------------------------------------------------------------- ranking metrics
+# Parity: Ranker.evaluateNDCG/evaluateMAP (models/common/Ranker.scala:81-99) and
+# HitRate@k used as validation in the NCF workload.
+
+
+class HitRate(Metric):
+    """HR@k over grouped candidate lists.
+
+    Expects ``y_pred`` (G, C) scores for G groups of C candidates where index 0 is
+    the positive item (the standard NCF leave-one-out eval layout), ``y_true``
+    ignored-or-position-0. ``update`` accepts pre-grouped arrays.
+    """
+
+    def __init__(self, k: int = 10):
+        self.k = k
+        self.name = f"hit_rate@{k}"
+
+    def update(self, acc, y_true, y_pred):
+        scores = jnp.asarray(y_pred, jnp.float32)
+        pos_score = scores[:, 0:1]
+        rank = jnp.sum(scores[:, 1:] > pos_score, axis=1) + 1
+        hit = (rank <= self.k).astype(jnp.float32)
+        return {"total": acc["total"] + jnp.sum(hit),
+                "count": acc["count"] + scores.shape[0]}
+
+
+class NDCG(Metric):
+    """NDCG@k over the same grouped layout (Ranker.evaluateNDCG parity)."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+        self.name = f"ndcg@{k}"
+
+    def update(self, acc, y_true, y_pred):
+        scores = jnp.asarray(y_pred, jnp.float32)
+        pos_score = scores[:, 0:1]
+        rank = jnp.sum(scores[:, 1:] > pos_score, axis=1) + 1
+        gain = jnp.where(rank <= self.k, 1.0 / jnp.log2(rank + 1.0), 0.0)
+        return {"total": acc["total"] + jnp.sum(gain),
+                "count": acc["count"] + scores.shape[0]}
+
+
+def ndcg_at_k(y_true_relevance, y_score, k: int) -> float:
+    """Listwise NDCG over relevance-labelled candidates (Ranker.evaluateNDCG)."""
+    y_true_relevance = jnp.asarray(y_true_relevance, jnp.float32)
+    y_score = jnp.asarray(y_score, jnp.float32)
+    order = jnp.argsort(-y_score, axis=-1)[..., :k]
+    rel = jnp.take_along_axis(y_true_relevance, order, axis=-1)
+    discounts = 1.0 / jnp.log2(jnp.arange(2, k + 2, dtype=jnp.float32))
+    dcg = jnp.sum(rel * discounts, axis=-1)
+    ideal = jnp.sort(y_true_relevance, axis=-1)[..., ::-1][..., :k]
+    idcg = jnp.sum(ideal * discounts, axis=-1)
+    return float(jnp.mean(jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-9), 0.0)))
+
+
+def map_at_k(y_true_relevance, y_score, k: int) -> float:
+    """Mean average precision@k (Ranker.evaluateMAP parity)."""
+    y_true_relevance = jnp.asarray(y_true_relevance, jnp.float32)
+    y_score = jnp.asarray(y_score, jnp.float32)
+    order = jnp.argsort(-y_score, axis=-1)[..., :k]
+    rel = (jnp.take_along_axis(y_true_relevance, order, axis=-1) > 0).astype(jnp.float32)
+    cum = jnp.cumsum(rel, axis=-1)
+    prec = cum / jnp.arange(1, k + 1, dtype=jnp.float32)
+    denom = jnp.maximum(jnp.sum(rel, axis=-1), 1.0)
+    ap = jnp.sum(prec * rel, axis=-1) / denom
+    return float(jnp.mean(ap))
+
+
+METRICS: Dict[str, Callable[[], Metric]] = {
+    "accuracy": SparseCategoricalAccuracy,
+    "acc": SparseCategoricalAccuracy,
+    "sparse_categorical_accuracy": SparseCategoricalAccuracy,
+    "categorical_accuracy": CategoricalAccuracy,
+    "binary_accuracy": BinaryAccuracy,
+    "top5": lambda: TopK(5),
+    "top5_accuracy": lambda: TopK(5),
+    "mae": MAE,
+    "mse": MSE,
+    "auc": AUC,
+    "hit_rate": HitRate,
+    "hitrate10": lambda: HitRate(10),
+    "ndcg": NDCG,
+    "ndcg10": lambda: NDCG(10),
+}
+
+
+def get_metric(metric: Union[str, Metric]) -> Metric:
+    if isinstance(metric, Metric):
+        return metric
+    try:
+        return METRICS[metric.lower()]()
+    except KeyError:
+        raise ValueError(f"unknown metric {metric!r}; known: {sorted(METRICS)}")

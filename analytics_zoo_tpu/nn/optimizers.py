@@ -1,0 +1,148 @@
+"""Optimizers & learning-rate schedules (optax-backed, Keras/zoo-named facade).
+
+Parity: /root/reference/zoo/.../pipeline/api/keras/optimizers/ (Adam with schedules,
+AdamWeightDecay with warmup — the BERT optimizer), BigDL OptimMethods the reference
+exposes (SGD/Adagrad/RMSprop/Adadelta/Adamax), plus LR schedules from
+common/Optim.scala (Fixed/Poly/...).
+
+Each factory returns an ``optax.GradientTransformation``; gradient clipping is
+composed in by the training engine (Topology.scala clip config parity:
+setGradientClippingByL2Norm / setConstantGradientClipping, Topology.scala:161-194).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Union
+
+import optax
+
+Schedule = Union[float, Callable[[int], float]]
+
+
+# ------------------------------------------------------------------- schedules
+
+
+def fixed(lr: float) -> Schedule:
+    """Constant LR (common/Optim.scala Fixed parity)."""
+    return lr
+
+
+def poly(lr: float, power: float, max_iteration: int) -> Schedule:
+    return optax.polynomial_schedule(lr, 0.0, power, max_iteration)
+
+
+def exponential_decay(lr: float, decay_rate: float, decay_steps: int,
+                      staircase: bool = False) -> Schedule:
+    return optax.exponential_decay(lr, decay_steps, decay_rate, staircase=staircase)
+
+
+def warmup_linear(lr: float, warmup_steps: int, total_steps: int) -> Schedule:
+    """Linear warmup then linear decay — AdamWeightDecay's schedule
+    (keras/optimizers/AdamWeightDecay.scala warmupPortion parity)."""
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, lr, warmup_steps),
+         optax.linear_schedule(lr, 0.0, max(1, total_steps - warmup_steps))],
+        [warmup_steps])
+
+
+# ------------------------------------------------------------------ optimizers
+
+
+def SGD(lr: Schedule = 0.01, momentum: float = 0.0, dampening: float = 0.0,
+        weight_decay: float = 0.0, nesterov: bool = False):
+    tx = optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+def Adam(lr: Schedule = 1e-3, beta_1: float = 0.9, beta_2: float = 0.999,
+         epsilon: float = 1e-8):
+    return optax.adam(lr, b1=beta_1, b2=beta_2, eps=epsilon)
+
+
+def AdamWeightDecay(lr: Schedule = 1e-3, warmup_portion: float = -1.0,
+                    total: int = -1, schedule: str = "linear",
+                    beta_1: float = 0.9, beta_2: float = 0.999,
+                    epsilon: float = 1e-6, weight_decay: float = 0.01):
+    """BERT-style AdamW with warmup (keras/optimizers/AdamWeightDecay.scala)."""
+    if total > 0 and warmup_portion > 0:
+        sched = warmup_linear(lr if isinstance(lr, float) else 1e-3,
+                              int(total * warmup_portion), total)
+    else:
+        sched = lr
+    return optax.adamw(sched, b1=beta_1, b2=beta_2, eps=epsilon,
+                       weight_decay=weight_decay)
+
+
+def RMSprop(lr: Schedule = 1e-3, decay_rate: float = 0.9, epsilon: float = 1e-8):
+    return optax.rmsprop(lr, decay=decay_rate, eps=epsilon)
+
+
+def Adagrad(lr: Schedule = 0.01, epsilon: float = 1e-8):
+    return optax.adagrad(lr, eps=epsilon)
+
+
+def Adadelta(lr: Schedule = 1.0, rho: float = 0.95, epsilon: float = 1e-8):
+    return optax.adadelta(lr, rho=rho, eps=epsilon)
+
+
+def Adamax(lr: Schedule = 2e-3, beta_1: float = 0.9, beta_2: float = 0.999,
+           epsilon: float = 1e-8):
+    return optax.adamax(lr, b1=beta_1, b2=beta_2, eps=epsilon)
+
+
+def LARS(lr: Schedule = 0.1, momentum: float = 0.9, weight_decay: float = 1e-4):
+    return optax.lars(lr, momentum=momentum, weight_decay=weight_decay)
+
+
+OPTIMIZERS: Dict[str, Callable] = {
+    "sgd": SGD,
+    "adam": Adam,
+    "adamw": AdamWeightDecay,
+    "adamweightdecay": AdamWeightDecay,
+    "rmsprop": RMSprop,
+    "adagrad": Adagrad,
+    "adadelta": Adadelta,
+    "adamax": Adamax,
+    "lars": LARS,
+}
+
+
+def get_optimizer(opt) -> optax.GradientTransformation:
+    """Resolve ``'adam'`` / factory / GradientTransformation to a transformation."""
+    if isinstance(opt, optax.GradientTransformation):
+        return opt
+    if callable(opt):
+        return opt()
+    try:
+        return OPTIMIZERS[opt.lower()]()
+    except KeyError:
+        raise ValueError(f"unknown optimizer {opt!r}; known: {sorted(OPTIMIZERS)}")
+
+
+def clip_by_range(lo: float, hi: float) -> optax.GradientTransformation:
+    """Clamp every gradient element to ``[lo, hi]`` — the reference's
+    setConstantGradientClipping(min, max) semantics (asymmetric ranges allowed)."""
+    import jax
+    import jax.numpy as jnp
+
+    def update_fn(updates, state, params=None):
+        del params
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, lo, hi), updates), state
+
+    return optax.GradientTransformation(lambda params: optax.EmptyState(), update_fn)
+
+
+def with_clipping(tx: optax.GradientTransformation,
+                  clip_norm: Optional[float] = None,
+                  clip_value: Optional[tuple] = None) -> optax.GradientTransformation:
+    """Compose gradient clipping (global-L2 and/or constant range) before ``tx``."""
+    parts = []
+    if clip_norm is not None:
+        parts.append(optax.clip_by_global_norm(clip_norm))
+    if clip_value is not None:
+        lo, hi = clip_value
+        parts.append(clip_by_range(lo, hi))
+    parts.append(tx)
+    return optax.chain(*parts) if len(parts) > 1 else tx

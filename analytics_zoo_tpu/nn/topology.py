@@ -1,0 +1,149 @@
+"""Keras-style training topology: ``Sequential`` and ``Model`` with
+``compile / fit / evaluate / predict``.
+
+Parity: KerasNet (/root/reference/zoo/src/main/scala/com/intel/analytics/zoo/pipeline/
+api/keras/models/Topology.scala — compile :138-194, fit :346-374, evaluate :499-550,
+predict :560-603; ``Model`` :605, ``Sequential`` :828) and the python mirror
+(/root/reference/pyzoo/zoo/pipeline/api/keras/engine/topology.py).
+
+Where the reference's ``fit`` selects Local vs Distri optimizer, here a single
+:class:`analytics_zoo_tpu.engine.estimator.Estimator` serves both: the mesh decides
+whether "distribution" means 1 chip or a pod.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..common.config import TrainConfig
+from ..common.triggers import Trigger
+from .graph import GraphModule, Node, SequentialModule
+from .module import Layer
+
+
+class KerasNet:
+    """Mixin adding the compile/fit/evaluate/predict training API to a module."""
+
+    def compile(self, optimizer="sgd", loss="mse", metrics: Sequence = (),
+                config: Optional[TrainConfig] = None, mesh=None,
+                param_sharding=None) -> "KerasNet":
+        """Configure the learning process (Topology.scala:138-194 parity)."""
+        from ..engine.estimator import Estimator
+
+        self._metrics = list(metrics)
+        self.estimator = Estimator(self, optimizer=optimizer, loss=loss,
+                                   mesh=mesh, config=config,
+                                   param_sharding=param_sharding)
+        return self
+
+    # -- training config sugar (Topology.scala:161-258 parity) ----------------
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float):
+        self._require_compiled()
+        self.estimator.set_gradient_clipping(clip_norm=clip_norm)
+        return self
+
+    def set_constant_gradient_clipping(self, min_value: float, max_value: float):
+        self._require_compiled()
+        self.estimator.set_gradient_clipping(clip_value=(min_value, max_value))
+        return self
+
+    def set_tensorboard(self, log_dir: str, app_name: str):
+        self._require_compiled()
+        self.estimator.set_tensorboard(log_dir, app_name)
+        return self
+
+    def set_checkpoint(self, path: str, over_write: bool = True):
+        self._require_compiled()
+        self.estimator.config.checkpoint_dir = path
+        return self
+
+    def get_train_summary(self, tag: str):
+        self._require_compiled()
+        if self.estimator.train_summary is None:
+            return []
+        return self.estimator.train_summary.read_scalar(tag)
+
+    def get_validation_summary(self, tag: str):
+        self._require_compiled()
+        if self.estimator.val_summary is None:
+            return []
+        return self.estimator.val_summary.read_scalar(tag)
+
+    def _require_compiled(self):
+        if not hasattr(self, "estimator") or self.estimator is None:
+            raise RuntimeError("call compile(...) first")
+
+    # -- train/eval/predict ---------------------------------------------------
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 1,
+            validation_data=None, end_trigger: Optional[Trigger] = None,
+            seed: int = 0):
+        """Train (Topology.scala:346-374 / pyzoo topology.py:187 parity).
+
+        ``x`` may be a FeatureSet, an (x, y) pair via separate args, or a list of
+        arrays for multi-input graphs.
+        """
+        self._require_compiled()
+        from ..data.featureset import FeatureSet
+
+        if isinstance(x, FeatureSet):
+            data = x
+        else:
+            xs = tuple(x) if isinstance(x, (list, tuple)) else x
+            data = FeatureSet.from_numpy(xs, y)
+        val = None
+        if validation_data is not None:
+            if isinstance(validation_data, FeatureSet):
+                val = validation_data
+            else:
+                vx, vy = validation_data
+                vxs = tuple(vx) if isinstance(vx, (list, tuple)) else vx
+                val = FeatureSet.from_numpy(vxs, vy)
+        self.estimator.fit(data, batch_size=batch_size, epochs=nb_epoch,
+                           end_trigger=end_trigger, validation_data=val,
+                           validation_metrics=self._metrics, seed=seed)
+        return self
+
+    def evaluate(self, x, y=None, batch_size: int = 32,
+                 metrics: Optional[Sequence] = None) -> Dict[str, float]:
+        self._require_compiled()
+        from ..data.featureset import FeatureSet
+
+        if isinstance(x, FeatureSet):
+            data = x
+        else:
+            xs = tuple(x) if isinstance(x, (list, tuple)) else x
+            data = FeatureSet.from_numpy(xs, y)
+        return self.estimator.evaluate(
+            data, batch_size=batch_size,
+            metrics=metrics if metrics is not None else (self._metrics or ("accuracy",)))
+
+    def predict(self, x, batch_size: int = 256, distributed: bool = True) -> np.ndarray:
+        self._require_compiled()
+        return self.estimator.predict(x, batch_size=batch_size)
+
+    def predict_classes(self, x, batch_size: int = 256, zero_based_label=True):
+        probs = self.predict(x, batch_size)
+        cls = np.argmax(probs, axis=-1)
+        return cls if zero_based_label else cls + 1
+
+    # -- persistence (ZooModel save/load parity) ------------------------------
+    def save_model(self, path: str):
+        self._require_compiled()
+        from ..models.common.zoo_model import save_model_bundle
+
+        save_model_bundle(path, self)
+
+    @property
+    def parameters(self):
+        self._require_compiled()
+        return self.estimator.params
+
+
+class Sequential(SequentialModule, KerasNet):
+    """``Sequential()`` container with training API (Topology.scala:828)."""
+
+
+class Model(GraphModule, KerasNet):
+    """Functional graph model with training API (Topology.scala:605)."""

@@ -1,0 +1,56 @@
+"""Test harness: fake 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): everything "distributed" runs
+multi-device-on-one-host — the reference used ``local[4]`` Spark; here it's
+``--xla_force_host_platform_device_count=8`` CPU devices, so DP/TP/SP code paths
+execute real collectives in CI without a TPU pod.
+"""
+
+import os
+
+# Must happen before jax initializes its backends.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# The environment's TPU-tunnel sitecustomize force-sets jax_platforms at import;
+# override it back so tests always run on the virtual CPU mesh (and never hang on
+# a busy/unavailable TPU tunnel).
+jax.config.update("jax_platforms", "cpu")
+
+# Differential tests compare against float64/float32 numpy oracles; keep matmuls
+# exact in CI (TPU runs keep the fast default so the MXU runs bf16).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def zoo_ctx():
+    """Fresh default context (mesh = 8-way dp) per test."""
+    from analytics_zoo_tpu.common import init_zoo_context, reset_zoo_context
+
+    reset_zoo_context()
+    ctx = init_zoo_context()
+    yield ctx
+    reset_zoo_context()
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture()
+def np_rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,98 @@
+"""Losses/metrics differential tests vs numpy/sklearn-style oracles."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.nn import losses as Lo
+from analytics_zoo_tpu.nn import metrics as M
+
+
+def test_mse_mae(np_rng):
+    a = np_rng.normal(size=(8, 3)).astype("float32")
+    b = np_rng.normal(size=(8, 3)).astype("float32")
+    assert np.isclose(float(Lo.mean_squared_error(a, b)), ((a - b) ** 2).mean(), rtol=1e-5)
+    assert np.isclose(float(Lo.mean_absolute_error(a, b)), np.abs(a - b).mean(), rtol=1e-5)
+
+
+def test_binary_crossentropy_logits_consistency(np_rng):
+    y = (np_rng.random(size=(16, 1)) > 0.5).astype("float32")
+    logits = np_rng.normal(size=(16, 1)).astype("float32")
+    probs = 1 / (1 + np.exp(-logits))
+    a = float(Lo.binary_crossentropy(y, probs))
+    b = float(Lo.binary_crossentropy(y, logits, from_logits=True))
+    assert np.isclose(a, b, rtol=1e-4)
+
+
+def test_sparse_vs_dense_crossentropy(np_rng):
+    y = np_rng.integers(0, 4, size=(10,))
+    logits = np_rng.normal(size=(10, 4)).astype("float32")
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    onehot = np.eye(4, dtype="float32")[y]
+    a = float(Lo.sparse_categorical_crossentropy(y, probs))
+    b = float(Lo.categorical_crossentropy(onehot, probs))
+    assert np.isclose(a, b, rtol=1e-5)
+
+
+def test_rank_hinge():
+    # pos scores 1.0, neg scores 0.5 => margin 1 - 0.5 = 0.5 loss
+    pred = np.array([1.0, 0.5, 1.0, 0.5], dtype="float32")
+    assert np.isclose(float(Lo.rank_hinge(None, pred)), 0.5)
+
+
+def test_accuracy_metric(np_rng):
+    m = M.SparseCategoricalAccuracy()
+    acc = m.init()
+    y = np.array([0, 1, 2, 1])
+    pred = np.eye(3, dtype="float32")[[0, 1, 0, 1]]
+    acc = m.update(acc, y, pred)
+    assert np.isclose(m.result(acc), 0.75)
+
+
+def test_topk_metric():
+    m = M.TopK(2)
+    acc = m.init()
+    scores = np.array([[0.1, 0.5, 0.4], [0.8, 0.1, 0.1]], dtype="float32")
+    acc = m.update(acc, np.array([2, 2]), scores)
+    assert np.isclose(m.result(acc), 0.5)  # first hits in top2, second doesn't
+
+
+def test_auc_perfect_and_random(np_rng):
+    m = M.AUC()
+    y = np.concatenate([np.ones(50), np.zeros(50)]).astype("float32")
+    perfect = np.concatenate([np.full(50, 0.9), np.full(50, 0.1)]).astype("float32")
+    acc = m.update(m.init(), y, perfect)
+    assert m.result(acc) > 0.99
+    same = np.full(100, 0.5, dtype="float32")
+    acc = m.update(m.init(), y, same)
+    assert 0.4 < m.result(acc) < 0.6
+
+
+def test_hit_rate_and_ndcg():
+    # group of 1 positive (index 0) + 4 negatives
+    m = M.HitRate(2)
+    scores = np.array([[0.9, 0.1, 0.2, 0.3, 0.4],   # pos ranked 1 => hit@2
+                       [0.2, 0.9, 0.8, 0.1, 0.1]],  # pos ranked 3 => miss@2
+                      dtype="float32")
+    acc = m.update(m.init(), None, scores)
+    assert np.isclose(m.result(acc), 0.5)
+    n = M.NDCG(10)
+    acc = n.update(n.init(), None, scores)
+    expect = (1 / np.log2(2) + 1 / np.log2(4)) / 2
+    assert np.isclose(n.result(acc), expect, rtol=1e-5)
+
+
+def test_ndcg_map_listwise():
+    rel = np.array([[1.0, 0.0, 0.0]])
+    score = np.array([[0.9, 0.5, 0.1]])
+    assert np.isclose(M.ndcg_at_k(rel, score, 3), 1.0)
+    assert np.isclose(M.map_at_k(rel, score, 3), 1.0)
+    score2 = np.array([[0.1, 0.9, 0.5]])  # positive ranked 3rd => AP = 1/3
+    assert np.isclose(M.map_at_k(rel, score2, 3), 1.0 / 3.0)
+
+
+def test_get_loss_custom():
+    fn = Lo.get_loss(lambda yt, yp: jnp.mean(yp))
+    assert float(fn(None, jnp.ones((3,)))) == 1.0
+    with pytest.raises(ValueError):
+        Lo.get_loss("nope")
