@@ -70,6 +70,10 @@ class Estimator:
         self.val_summary: Optional[ValidationSummary] = None
         self._train_step = None
         self._eval_cache: Dict[Any, Callable] = {}
+        # optional (params, model_state) replacing the fresh init — used by
+        # model-bundle loading (ZooModel.loadModel); weights were already read
+        # from disk eagerly by KerasNet.load_weights
+        self.initial_weights: Optional[tuple] = None
 
     def set_gradient_clipping(self, clip_norm: Optional[float] = None,
                               clip_value: Optional[tuple] = None) -> "Estimator":
@@ -138,6 +142,8 @@ class Estimator:
         rng = jax.random.PRNGKey(seed)
         k_init, k_train = jax.random.split(rng)
         params, mstate = self.model.build(k_init, in_shape)
+        if self.initial_weights is not None:
+            params, mstate = self.initial_weights
         opt_state = self.tx.init(params)
         state = {
             "params": params,

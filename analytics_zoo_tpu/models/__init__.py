@@ -1,0 +1,5 @@
+"""Built-in model zoo (reference: zoo/.../models/, pyzoo/zoo/models/)."""
+
+from . import common, recommendation
+
+__all__ = ["common", "recommendation"]

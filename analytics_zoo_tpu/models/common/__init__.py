@@ -1,0 +1,5 @@
+from .zoo_model import (MODEL_REGISTRY, load_model_bundle, load_weights,
+                        register_model, save_model_bundle, save_weights)
+
+__all__ = ["MODEL_REGISTRY", "load_model_bundle", "load_weights",
+           "register_model", "save_model_bundle", "save_weights"]

@@ -6,11 +6,13 @@ format). The TPU-native format is a directory bundle:
 
     <path>/
       config.json     # model class + constructor kwargs (rebuildable models)
-      weights.npz     # flat leaves of (params, model_state)
-      tree.json       # key paths for the leaves
+      weights.npz     # params/state leaves keyed by their pytree path
+      manifest.json   # sorted key list (integrity check)
 
-Built-in models register themselves in ``MODEL_REGISTRY`` so ``load_model`` can
-reconstruct the architecture, then restore weights.
+Key determinism: container modules (GraphModule/SequentialModule) key params by
+POSITIONAL slots (``0_dense``), and custom modules use fixed string keys, so pytree
+paths are identical across processes for the same architecture. Both missing and
+unexpected keys fail loudly on load.
 """
 
 from __future__ import annotations
@@ -32,38 +34,57 @@ def register_model(name: str):
     return deco
 
 
-def _flatten(tree) -> Tuple[Dict[str, np.ndarray], Any]:
-    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)
-    flat = {}
-    for path, leaf in leaves_with_paths[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        flat[key] = np.asarray(jax.device_get(leaf))
-    return flat, leaves_with_paths[1]
+def _leaf_key(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
 
 
-def save_weights(path: str, params, model_state=None) -> None:
+def _flatten_tree(tree) -> Dict[str, np.ndarray]:
+    paths_and_leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {_leaf_key(p): np.asarray(jax.device_get(l)) for p, l in paths_and_leaves}
+
+
+def save_weights(path: str, module, params, model_state=None) -> None:
+    """Save (params, state) as a weights bundle. ``module`` is accepted for
+    signature stability (future per-layer remapping) but keys come from the
+    pytree paths, which the slot convention makes deterministic."""
+    del module
     os.makedirs(path, exist_ok=True)
-    flat, _ = _flatten({"params": params, "state": model_state or {}})
+    flat = _flatten_tree({"params": params, "state": model_state or {}})
+    if not flat:
+        raise ValueError("refusing to save an empty weight tree")
     np.savez(os.path.join(path, "weights.npz"), **flat)
-    with open(os.path.join(path, "tree.json"), "w") as f:
+    with open(os.path.join(path, "manifest.json"), "w") as f:
         json.dump(sorted(flat.keys()), f)
 
 
-def load_weights(path: str, params_template, state_template=None):
-    """Restore weights into pytrees shaped like the templates."""
+def load_weights(path: str, module, params_template, state_template=None):
+    """Restore a bundle into templates from a structurally-identical module.
+
+    Fails loudly on ANY mismatch: missing keys, unexpected keys, or shape
+    disagreement (no silent partial restores).
+    """
+    del module
+    state_template = state_template or {}
     data = np.load(os.path.join(path, "weights.npz"))
-    tree = {"params": params_template, "state": state_template or {}}
+    tree = {"params": params_template, "state": state_template}
     paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
-    new_leaves = []
+    expected = {_leaf_key(p) for p, _ in paths_and_leaves}
+    saved = set(data.files)
+    if expected != saved:
+        missing = sorted(expected - saved)[:5]
+        extra = sorted(saved - expected)[:5]
+        raise ValueError(
+            f"weight bundle mismatch at {path}: "
+            f"{len(expected - saved)} missing (e.g. {missing}), "
+            f"{len(saved - expected)} unexpected (e.g. {extra})")
+    leaves = []
     for p, leaf in paths_and_leaves:
-        key = "/".join(str(getattr(q, "key", getattr(q, "idx", q))) for q in p)
-        if key not in data:
-            raise KeyError(f"weight {key!r} missing from {path}")
+        key = _leaf_key(p)
         arr = data[key]
         if tuple(arr.shape) != tuple(np.shape(leaf)):
             raise ValueError(f"{key}: saved {arr.shape} != expected {np.shape(leaf)}")
-        new_leaves.append(arr)
-    restored = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        leaves.append(arr)
+    restored = jax.tree_util.tree_unflatten(treedef, leaves)
     return restored["params"], restored["state"]
 
 
@@ -73,15 +94,17 @@ def save_model_bundle(path: str, model, config: Optional[Dict] = None) -> None:
     est = getattr(model, "estimator", None)
     if est is None or est.train_state is None:
         raise RuntimeError("model has no trained state; compile+fit (or build) first")
-    save_weights(path, est.train_state["params"], est.train_state["model_state"])
+    save_weights(path, model, est.train_state["params"],
+                 est.train_state["model_state"])
     cfg = {"class": type(model).__name__, "config": config or {}}
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(cfg, f)
 
 
 def load_model_bundle(path: str, model=None):
-    """Load a bundle. If ``model`` is given, restore weights into it; otherwise
-    reconstruct from MODEL_REGISTRY (built-in zoo models)."""
+    """Load a bundle. If ``model`` is given, restore into it (immediately when it
+    is compiled, else on its next ``compile``); otherwise rebuild the architecture
+    from MODEL_REGISTRY (built-in zoo models) and defer weights to ``compile``."""
     with open(os.path.join(path, "config.json")) as f:
         cfg = json.load(f)
     if model is None:
@@ -91,4 +114,5 @@ def load_model_bundle(path: str, model=None):
                 f"unknown model class {cfg['class']!r}; pass model= explicitly "
                 f"(registered: {sorted(MODEL_REGISTRY)})")
         model = cls(**cfg["config"])
+    model.load_weights(path)
     return model, cfg

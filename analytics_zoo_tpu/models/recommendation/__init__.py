@@ -1,0 +1,4 @@
+from .neuralcf import NeuralCF
+from .recommender import Recommender, UserItemPrediction
+
+__all__ = ["NeuralCF", "Recommender", "UserItemPrediction"]

@@ -1,0 +1,90 @@
+"""NeuralCF — neural collaborative filtering (the north-star model).
+
+Parity: /root/reference/zoo/src/main/scala/com/intel/analytics/zoo/models/
+recommendation/NeuralCF.scala:45-103 and
+/root/reference/pyzoo/zoo/models/recommendation/neuralcf.py:30-97 — GMF + MLP
+dual-embedding towers over (user, item) pairs, merged into a softmax rating head.
+
+TPU-native notes:
+* The four embedding tables are HBM gathers; under tensor parallelism they shard
+  row-wise over the ``tp`` axis (see analytics_zoo_tpu.parallel.sharding).
+* The whole forward is one fused XLA program; the MLP matmuls land on the MXU. The
+  batch is the only meaningful FLOP axis, so throughput scales with dp sharding.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ...nn import layers as L
+from ...nn.graph import Input
+from ...nn.layers.merge import merge
+from ..common.zoo_model import register_model
+from .recommender import Recommender
+
+
+@register_model("NeuralCF")
+class NeuralCF(Recommender):
+    """GMF + MLP recommender.
+
+    Args mirror the reference constructor (NeuralCF.scala:45-53): ``user_count``,
+    ``item_count``, ``class_num``, ``user_embed``, ``item_embed``,
+    ``hidden_layers``, ``include_mf``, ``mf_embed``.
+    """
+
+    def __init__(self, user_count: int, item_count: int, class_num: int,
+                 user_embed: int = 20, item_embed: int = 20,
+                 hidden_layers: Sequence[int] = (40, 20, 10),
+                 include_mf: bool = True, mf_embed: int = 20):
+        self.user_count = user_count
+        self.item_count = item_count
+        self.class_num = class_num
+        self.user_embed = user_embed
+        self.item_embed = item_embed
+        self.hidden_layers = list(hidden_layers)
+        self.include_mf = include_mf
+        self.mf_embed = mf_embed
+
+        # (B, 2) int input: [:, 0]=user id, [:, 1]=item id (NeuralCF.scala:57-60)
+        pair = Input((2,), name="user_item_pair")
+        user_id = L.Select(0, 0)(pair)
+        item_id = L.Select(0, 1)(pair)
+
+        # +1 rows: ids are 1-based in the reference datasets (NeuralCF.scala:65-66)
+        mlp_user = L.Embedding(user_count + 1, user_embed, init="normal")(user_id)
+        mlp_item = L.Embedding(item_count + 1, item_embed, init="normal")(item_id)
+        mlp = merge([mlp_user, mlp_item], mode="concat")
+        for h in self.hidden_layers:
+            mlp = L.Dense(h, activation="relu")(mlp)
+
+        if include_mf:
+            assert mf_embed > 0, "provide a meaningful number of mf embedding units"
+            mf_user = L.Embedding(user_count + 1, mf_embed, init="normal")(user_id)
+            mf_item = L.Embedding(item_count + 1, mf_embed, init="normal")(item_id)
+            gmf = merge([mf_user, mf_item], mode="mul")
+            head_in = merge([mlp, gmf], mode="concat")
+        else:
+            head_in = mlp
+        out = L.Dense(class_num, activation="softmax")(head_in)
+
+        super().__init__(pair, out, name="neuralcf")
+
+    def constructor_config(self) -> dict:
+        return dict(user_count=self.user_count, item_count=self.item_count,
+                    class_num=self.class_num, user_embed=self.user_embed,
+                    item_embed=self.item_embed, hidden_layers=self.hidden_layers,
+                    include_mf=self.include_mf, mf_embed=self.mf_embed)
+
+    def save_model(self, path: str):
+        from ..common.zoo_model import save_model_bundle
+
+        save_model_bundle(path, self, config=self.constructor_config())
+
+    @classmethod
+    def load_model(cls, path: str) -> "NeuralCF":
+        """Rebuild architecture from config.json + restore weights on the next
+        ``compile`` (NeuralCF.loadModel parity)."""
+        from ..common.zoo_model import load_model_bundle
+
+        model, _cfg = load_model_bundle(path)
+        return model

@@ -1,0 +1,108 @@
+"""TransformerLM — the flagship distributed model (causal LM / classifier).
+
+The reference exposes transformer capability as layers (TransformerLayer.scala,
+BERT.scala) used by the text estimators (tfpark/text/). Here the flagship model
+additionally exercises every parallelism axis: batch over dp/fsdp, params over
+fsdp+tp (megatron layout, parallel.sharding.TP_RULES), sequence over sp via
+ring/Ulysses attention. This is the model behind ``__graft_entry__``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn import layers as L
+from ..nn.layers.attention import TransformerLayer
+from ..nn.layers.normalization import LayerNormalization
+from ..nn.module import Layer, as_compute, get_initializer, param_dtype
+from ..nn.topology import KerasNet
+from .common.zoo_model import register_model
+
+
+@register_model("TransformerLM")
+class TransformerLM(Layer, KerasNet):
+    """Decoder-only transformer over int token ids (B, T) → logits (B, T, V)."""
+
+    def __init__(self, vocab: int, hidden_size: int = 256, n_block: int = 4,
+                 n_head: int = 8, seq_len: int = 512,
+                 intermediate_size: Optional[int] = None,
+                 attn_strategy: str = "auto", remat: bool = False, name=None):
+        super().__init__(name=name)
+        self.vocab = vocab
+        self.hidden_size = hidden_size
+        self.n_block = n_block
+        self.seq_len = seq_len
+        self.remat = remat
+        self.blocks = [
+            TransformerLayer(hidden_size, n_head, intermediate_size, causal=True,
+                             attn_strategy=attn_strategy,
+                             name=f"{self.name}_block{i}")
+            for i in range(n_block)
+        ]
+        self.ln_f = LayerNormalization(name=f"{self.name}_lnf")
+        self.layers = list(self.blocks) + [self.ln_f]  # canonical order (persistence)
+
+    @property
+    def input_shape(self):
+        return (self.seq_len,)
+
+    def build(self, rng, input_shape=None):
+        ks = jax.random.split(rng, self.n_block + 3)
+        params = {
+            "token_embeddings": jax.random.normal(
+                ks[0], (self.vocab, self.hidden_size), param_dtype()) * 0.02,
+            "pos_embeddings": jax.random.normal(
+                ks[1], (self.seq_len, self.hidden_size), param_dtype()) * 0.02,
+            "logits_kernel": get_initializer("glorot_uniform")(
+                ks[2], (self.hidden_size, self.vocab), param_dtype()),
+        }
+        for i, blk in enumerate(self.blocks):
+            p, _ = blk.build(ks[3 + i], (None, self.hidden_size))
+            params[f"block{i}"] = p
+        lnf, _ = self.ln_f.build(ks[-1], (None, self.hidden_size))
+        params["ln_f"] = lnf
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        ids = jnp.asarray(x, jnp.int32)
+        h = jnp.take(params["token_embeddings"], ids, axis=0)
+        h = h + params["pos_embeddings"][: ids.shape[1]][None]
+        h = as_compute(h)
+        rngs = (jax.random.split(rng, self.n_block) if rng is not None
+                else [None] * self.n_block)
+
+        for i, blk in enumerate(self.blocks):
+            apply_fn = blk.apply
+            if self.remat:
+                # trade FLOPs for HBM: recompute block activations in backward
+                apply_fn = jax.checkpoint(
+                    lambda p, h, blk=blk, r=rngs[i]: blk.apply(
+                        p, {}, h, training=training, rng=r)[0])
+                h = apply_fn(params[f"block{i}"], h)
+            else:
+                h, _ = blk.apply(params[f"block{i}"], {}, h, training=training,
+                                 rng=rngs[i])
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h)
+        logits = h @ jnp.asarray(params["logits_kernel"], h.dtype)
+        return logits, state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.vocab,)
+
+    def constructor_config(self):
+        return dict(vocab=self.vocab, hidden_size=self.hidden_size,
+                    n_block=self.n_block, n_head=self.blocks[0].attn.n_head,
+                    seq_len=self.seq_len)
+
+
+def lm_loss(y_true, logits):
+    """Next-token cross entropy over (B, T) int targets and (B, T, V) logits."""
+    logits = jnp.asarray(logits, jnp.float32)
+    labels = jnp.asarray(y_true, jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)

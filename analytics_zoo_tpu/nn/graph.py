@@ -96,6 +96,14 @@ class GraphModule(Layer):
             if id(n.layer) not in seen and not isinstance(n.layer, InputLayer):
                 seen.add(id(n.layer))
                 self.layers.append(n.layer)
+        # deterministic param keys: positional slot, NOT the process-global auto
+        # name (auto names depend on construction history and break persistence
+        # across processes)
+        self._slots = {id(l): f"{i}_{type(l).__name__.lower()}"
+                       for i, l in enumerate(self.layers)}
+
+    def slot(self, layer: Layer) -> str:
+        return self._slots[id(layer)]
 
     @property
     def input_shape(self):
@@ -122,9 +130,9 @@ class GraphModule(Layer):
                         else [p.shape for p in node.inbound])
             p, s = layer.build(r, in_shape)
             if p:
-                params[layer.name] = p
+                params[self.slot(layer)] = p
             if s:
-                state[layer.name] = s
+                state[self.slot(layer)] = s
         return params, state
 
     def apply(self, params, state, x, *, training=False, rng=None):
@@ -142,11 +150,12 @@ class GraphModule(Layer):
             layer = node.layer
             inp = (values[node.inbound[0].uid] if len(node.inbound) == 1
                    else [values[p.uid] for p in node.inbound])
-            p = params.get(layer.name, {})
-            s = new_state.get(layer.name, {})
+            key = self.slot(layer)
+            p = params.get(key, {})
+            s = new_state.get(key, {})
             y, s2 = layer.apply(p, s, inp, training=training, rng=next(rngs))
-            if s2 != {} or layer.name in new_state:
-                new_state[layer.name] = s2
+            if s2 != {} or key in new_state:
+                new_state[key] = s2
             values[node.uid] = y
         outs = [values[n.uid] for n in self.output_nodes]
         return (outs[0] if self.single_output else outs), new_state
@@ -166,6 +175,10 @@ class SequentialModule(Layer):
         self.layers.append(layer)
         return self
 
+    def slot(self, layer: Layer) -> str:
+        """Deterministic positional param key (see GraphModule.slot)."""
+        return f"{self.layers.index(layer)}_{type(layer).__name__.lower()}"
+
     @property
     def input_shape(self):
         for l in self.layers:
@@ -184,24 +197,26 @@ class SequentialModule(Layer):
         shape = tuple(input_shape) if input_shape is not None else self.input_shape
         params, state = {}, {}
         rngs = split_rng(rng, len(self.layers))
-        for r, layer in zip(rngs, self.layers):
+        for i, (r, layer) in enumerate(zip(rngs, self.layers)):
             p, s = layer.build(r, shape)
+            key = f"{i}_{type(layer).__name__.lower()}"
             if p:
-                params[layer.name] = p
+                params[key] = p
             if s:
-                state[layer.name] = s
+                state[key] = s
             shape = layer.compute_output_shape(shape)
         return params, state
 
     def apply(self, params, state, x, *, training=False, rng=None):
         new_state = dict(state)
         rngs = iter(split_rng(rng, len(self.layers)))
-        for layer in self.layers:
-            p = params.get(layer.name, {})
-            s = new_state.get(layer.name, {})
+        for i, layer in enumerate(self.layers):
+            key = f"{i}_{type(layer).__name__.lower()}"
+            p = params.get(key, {})
+            s = new_state.get(key, {})
             x, s2 = layer.apply(p, s, x, training=training, rng=next(rngs))
-            if s2 != {} or layer.name in new_state:
-                new_state[layer.name] = s2
+            if s2 != {} or key in new_state:
+                new_state[key] = s2
         return x, new_state
 
     def compute_output_shape(self, input_shape):

@@ -36,6 +36,34 @@ class KerasNet:
         self.estimator = Estimator(self, optimizer=optimizer, loss=loss,
                                    mesh=mesh, config=config,
                                    param_sharding=param_sharding)
+        pending = getattr(self, "_pending_weights_path", None)
+        if pending:
+            del self._pending_weights_path
+            self.load_weights(pending)
+        return self
+
+    def load_weights(self, path: str):
+        """Restore a weight bundle. Before ``compile``: deferred to compile time.
+        After: loaded EAGERLY (I/O errors surface here, not at first predict) into
+        either the live train state or the estimator's initial weights."""
+        if not hasattr(self, "estimator") or self.estimator is None:
+            self._pending_weights_path = path
+            return self
+        import jax
+
+        from ..models.common.zoo_model import load_weights as _load
+
+        est = self.estimator
+        if est.train_state is not None:
+            cur = jax.device_get({"p": est.train_state["params"],
+                                  "s": est.train_state["model_state"]})
+            params, state = _load(path, self, cur["p"], cur["s"])
+            est.train_state["params"] = est._place_state(params)
+            est.train_state["model_state"] = est._place_state(state)
+        else:
+            params_t, state_t = self.build(jax.random.PRNGKey(0))
+            params, state = _load(path, self, params_t, state_t)
+            est.initial_weights = (params, state)
         return self
 
     # -- training config sugar (Topology.scala:161-258 parity) ----------------

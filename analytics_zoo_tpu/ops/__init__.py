@@ -1,0 +1,7 @@
+"""TPU compute ops: attention strategies (full/ring/Ulysses), pallas kernels."""
+
+from .attention import (full_attention, ring_attention_local, sharded_attention,
+                        ulysses_attention_local)
+
+__all__ = ["full_attention", "ring_attention_local", "sharded_attention",
+           "ulysses_attention_local"]

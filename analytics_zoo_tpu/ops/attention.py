@@ -1,0 +1,142 @@
+"""Attention ops with selectable parallelism strategy.
+
+The reference's attention is fixed-length single-node (TransformerLayer.scala,
+BERT.scala — SURVEY.md §5.7: no ring attention, no sequence parallelism). Here
+long-context is first-class: three interchangeable strategies over the global mesh:
+
+* ``full``    — plain batched attention; GSPMD shards it over dp/tp axes.
+* ``ring``    — ring attention over the ``sp`` axis: K/V blocks rotate around the
+                ring via ``lax.ppermute`` while each device keeps an online-softmax
+                accumulator for its local Q block. Peak memory per device is
+                O(T/sp · T/sp) and the K/V transfer rides ICI neighbor links.
+* ``ulysses`` — DeepSpeed-Ulysses-style all-to-all: resharding from sequence-split
+                to head-split, local full attention, then the inverse all-to-all.
+
+All strategies compute bitwise-comparable results (up to float reassociation) and
+are differentiable (pure jnp/lax — JAX autodiff through collectives).
+
+Shapes: q, k, v are (B, T, H, D) per-device LOCAL blocks inside shard_map, or
+global arrays for ``full``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def full_attention(q, k, v, *, causal: bool = False, q_offset=0, k_offset=0):
+    """Reference attention: softmax(q k^T / sqrt(d)) v. (B, T, H, D) layout."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _ring_body(q, k_blk, v_blk, o, m, l, *, scale, causal, q_pos, k_pos):
+    """One ring step: fold k_blk/v_blk into the online-softmax accumulator."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    blk_max = jnp.max(scores, axis=-1)                       # (B,H,Tq)
+    m_new = jnp.maximum(m, blk_max)
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])                   # (B,H,Tq,Tk)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv.astype(jnp.float32)
+    return o_new, m_new, l_new
+
+
+def ring_attention_local(q, k, v, *, axis_name: str = "sp", causal: bool = False):
+    """Ring attention over ``axis_name``; called INSIDE shard_map.
+
+    q/k/v: local blocks (B, T_local, H, D); global seq is sharded over the ring.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, t_q, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    q32 = q
+    o = jnp.zeros((b, t_q, h, d), jnp.float32)
+    m = jnp.full((b, h, t_q), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, t_q), jnp.float32)
+    q_pos = idx * t_q + jnp.arange(t_q)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        src = (idx - i) % n                     # which global block we now hold
+        k_pos = src * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
+        o, m, l = _ring_body(q32, k_blk, v_blk, o, m, l, scale=scale,
+                             causal=causal, q_pos=q_pos, k_pos=k_pos)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o, m, l, k_blk, v_blk), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(step, (o, m, l, k, v), jnp.arange(n))
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ulysses_attention_local(q, k, v, *, axis_name: str = "sp",
+                            causal: bool = False):
+    """Ulysses all-to-all attention; called INSIDE shard_map.
+
+    Reshard (B, T/n, H, D) -> (B, T, H/n, D) with all_to_all, run full local
+    attention over the complete sequence, reshard back. Head count must divide
+    the ``sp`` axis size.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    def a2a(x, split, concat):
+        return jax.lax.all_to_all(x, axis_name, split_axis=split,
+                                  concat_axis=concat, tiled=True)
+
+    # seq-sharded -> head-sharded (gather full sequence, scatter heads)
+    q_h = a2a(q, 2, 1)
+    k_h = a2a(k, 2, 1)
+    v_h = a2a(v, 2, 1)
+    o = full_attention(q_h, k_h, v_h, causal=causal)
+    return a2a(o, 1, 2)
+
+
+def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
+                      causal: bool = False, seq_axis: str = "sp",
+                      batch_axes=("dp", "fsdp"), head_axis: str = "tp"):
+    """Dispatch attention under the global mesh (called inside jit).
+
+    With ``sp > 1`` wraps the chosen sequence-parallel kernel in a shard_map whose
+    specs shard batch over dp/fsdp, sequence over sp, heads over tp — so tensor and
+    sequence parallelism compose.
+    """
+    if strategy not in ("auto", "full", "ring", "ulysses"):
+        raise ValueError(f"unknown attention strategy {strategy!r}; "
+                         "known: auto, full, ring, ulysses")
+    sp = mesh.shape[seq_axis]
+    if strategy == "auto":
+        strategy = "ring" if sp > 1 else "full"
+    if strategy == "full" or sp == 1:
+        return full_attention(q, k, v, causal=causal)
+
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = {"ring": ring_attention_local,
+          "ulysses": ulysses_attention_local}[strategy]
+    wrapped = jax.shard_map(
+        functools.partial(fn, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return wrapped(q, k, v)

@@ -1,0 +1,17 @@
+"""Parallelism strategies: mesh-axis sharding rules + sequence-parallel attention.
+
+Axes (SURVEY.md §2.2 — all first-class here, vs. data-parallel-only reference):
+dp (data), fsdp (ZeRO param/optstate), tp (tensor), sp (sequence/ring attention),
+pp (pipeline), ep (expert).
+"""
+
+from ..common.context import build_mesh
+from ..ops.attention import (full_attention, ring_attention_local,
+                             sharded_attention, ulysses_attention_local)
+from .sharding import TP_RULES, make_param_sharding, replicated
+
+__all__ = [
+    "TP_RULES", "build_mesh", "full_attention", "make_param_sharding",
+    "replicated", "ring_attention_local", "sharded_attention",
+    "ulysses_attention_local",
+]

@@ -1,0 +1,122 @@
+"""North-star benchmark: NCF MovieLens-1M training throughput (samples/sec/chip).
+
+Reference workload: apps/recommendation-ncf/ncf-explicit-feedback.ipynb (pyzoo
+KerasModel NCF on local Spark, MKL CPU). BASELINE.json publishes no absolute
+number (``published: {}``); the recorded CPU baseline below was measured with THIS
+framework's identical train step on the host CPU (all cores, same batch size) —
+the honest stand-in for the reference's CPU-bound stack, per BASELINE.md.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# samples/sec for the same NCF train step on this machine's CPU backend
+# (measured via `python bench.py --cpu-baseline`; see __main__ below).
+CPU_BASELINE_SAMPLES_PER_SEC = 575_000.0
+
+BATCH = 8192
+EPOCH_SAMPLES = 1_000_209
+WARMUP_STEPS = 5
+MEASURE_STEPS = 40
+
+
+def run(platform: str | None = None) -> dict:
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    from analytics_zoo_tpu.common import (MeshConfig, PrecisionConfig,
+                                          RuntimeConfig, TrainConfig,
+                                          init_zoo_context, reset_zoo_context)
+    from analytics_zoo_tpu.data.datasets import synthetic_movielens
+    from analytics_zoo_tpu.engine import Estimator
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.nn.optimizers import Adam
+
+    reset_zoo_context()
+    ctx = init_zoo_context(RuntimeConfig(
+        mesh=MeshConfig(dp=0),  # all chips on the dp axis
+        precision=PrecisionConfig(compute_dtype="bfloat16")))
+    n_chips = ctx.num_devices
+
+    pairs, ratings = synthetic_movielens(EPOCH_SAMPLES)
+    labels = (ratings - 1).astype("int32")
+
+    model = NeuralCF(user_count=6040, item_count=3706, class_num=5)
+    est = Estimator(model, optimizer=Adam(lr=1e-3),
+                    loss="sparse_categorical_crossentropy", mesh=ctx.mesh,
+                    config=TrainConfig(log_every_n_steps=10_000))
+
+    from analytics_zoo_tpu.data import FeatureSet
+
+    fs = FeatureSet.from_numpy(pairs, labels)
+    batches = fs.batches(BATCH, epoch=0, shuffle=True)
+    first = next(batches)
+    est.train_state = est._init_state(first, seed=0)
+    est._train_step = est._make_train_step()
+
+    def step(host_batch):
+        gb = est._to_global(host_batch)
+        est.train_state, loss = est._train_step(est.train_state, gb)
+        return loss
+
+    # warmup (compile + cache)
+    loss = step(first)
+    for _ in range(WARMUP_STEPS - 1):
+        loss = step(next(batches))
+    loss.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        loss = step(next(batches))
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = MEASURE_STEPS * BATCH / dt
+    per_chip = samples_per_sec / n_chips
+    return {
+        "metric": "NCF MovieLens-1M training throughput",
+        "value": round(per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(per_chip / CPU_BASELINE_SAMPLES_PER_SEC, 3),
+        "total_samples_per_sec": round(samples_per_sec, 1),
+        "n_chips": n_chips,
+        "final_loss": float(loss),
+        "platform": str(jax.devices()[0].platform),
+    }
+
+
+def _accelerator_alive(timeout_s: int = 90) -> bool:
+    """Probe the default (TPU-tunnel) backend in a subprocess — a wedged tunnel
+    blocks forever inside PJRT client init, so an in-process try/except can't
+    catch it."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); print(d[0].platform)"],
+            timeout=timeout_s, capture_output=True, text=True)
+        return r.returncode == 0 and "cpu" not in r.stdout.lower()
+    except subprocess.TimeoutExpired:
+        return False
+
+
+if __name__ == "__main__":
+    if "--cpu-baseline" in sys.argv:
+        result = run(platform="cpu")
+    elif _accelerator_alive():
+        result = run()
+    else:
+        print("[bench] accelerator backend unreachable; falling back to cpu",
+              file=sys.stderr)
+        result = run(platform="cpu")
+    print(json.dumps(result))

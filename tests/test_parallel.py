@@ -1,0 +1,112 @@
+"""Parallelism tests on the faked 8-device mesh (SURVEY.md §7 stage 5 pattern):
+ring/Ulysses attention vs full-attention oracle, tp/fsdp sharding rules, and the
+full multi-axis training step (the driver's dryrun_multichip path).
+"""
+
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.ops.attention import full_attention, sharded_attention
+
+
+@pytest.fixture(scope="module")
+def mesh6():
+    return Mesh(np.array(jax.devices()).reshape(2, 1, 1, 4, 1, 1),
+                axis_names=("dp", "fsdp", "tp", "sp", "pp", "ep"))
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_sequence_parallel_attention_matches_full(mesh6, strategy, causal):
+    B, T, H, D = 4, 32, 4, 16
+    rng = np.random.default_rng(0)
+    q, k, v = (rng.normal(size=(B, T, H, D)).astype("float32") for _ in range(3))
+    ref = full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                         causal=causal)
+    spec = NamedSharding(mesh6, P(("dp", "fsdp"), "sp", "tp", None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: sharded_attention(
+        a, b, c, mesh6, strategy=strategy, causal=causal))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_grad_matches_full(mesh6):
+    B, T, H, D = 2, 16, 2, 8
+    rng = np.random.default_rng(1)
+    q, k, v = (rng.normal(size=(B, T, H, D)).astype("float32") for _ in range(3))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(sharded_attention(q, k, v, mesh6, strategy="ring",
+                                         causal=True) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_param_sharding_rules():
+    from analytics_zoo_tpu.parallel import make_param_sharding
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2, 1, 1, 1),
+                axis_names=("dp", "fsdp", "tp", "sp", "pp", "ep"))
+    rule = make_param_sharding(mesh)
+
+    class FakeKey:
+        def __init__(self, key):
+            self.key = key
+
+    qkv = np.zeros((64, 3 * 64), dtype="float32")
+    assert rule((FakeKey("block0"), FakeKey("attn"), FakeKey("qkv_kernel")),
+                qkv) == P("fsdp", "tp")
+    emb = np.zeros((100, 64), dtype="float32")
+    assert rule((FakeKey("token_embeddings"),), emb) == P("tp", None)
+    # non-divisible tp dim falls back to replicated on that axis
+    odd = np.zeros((63, 64), dtype="float32")
+    spec = rule((FakeKey("token_embeddings"),), odd)
+    assert spec == P(None, None) or spec == P()
+    bias = np.zeros((7,), dtype="float32")
+    assert rule((FakeKey("block0"), FakeKey("qkv_bias")), bias) == P()
+
+
+def test_transformer_lm_trains_on_multi_axis_mesh(zoo_ctx):
+    """The full dryrun path: dp/fsdp/tp/sp sharded train step executes and the
+    loss decreases over steps."""
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(os.path.dirname(__file__), "..",
+                                    "__graft_entry__.py"))
+    ge = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ge)
+    ge.dryrun_multichip(8)
+
+
+def test_transformer_lm_loss_decreases(zoo_ctx):
+    from analytics_zoo_tpu.common import TrainConfig
+    from analytics_zoo_tpu.engine import Estimator
+    from analytics_zoo_tpu.models.transformer import TransformerLM, lm_loss
+    from analytics_zoo_tpu.nn.optimizers import Adam
+
+    model = TransformerLM(vocab=32, hidden_size=32, n_block=1, n_head=2,
+                          seq_len=16, attn_strategy="full")
+    est = Estimator(model, optimizer=Adam(lr=0.01), loss=lm_loss,
+                    mesh=zoo_ctx.mesh)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 32, size=(256, 16)).astype("int32")
+    y = np.roll(x, -1, axis=1)  # learnable copy task
+    est.fit((x, y), batch_size=64, epochs=1)
+    first = est.trainer_state.last_loss
+    est.fit((x, y), batch_size=64, epochs=6)
+    assert est.trainer_state.last_loss < first

@@ -89,7 +89,7 @@ def test_weight_sharing_in_graph(zoo_ctx):
 
     x = np.random.default_rng(0).normal(size=(5, 6)).astype("float32")
     y, _ = model.apply(params, {}, [x, x])
-    direct, _ = shared.apply(params[shared.name], {}, x)
+    direct, _ = shared.apply(params[model.slot(shared)], {}, x)
     np.testing.assert_allclose(np.asarray(y), 2 * np.asarray(direct), rtol=1e-5)
 
 
