@@ -91,11 +91,16 @@ def leave_one_out_eval_sets(pairs: np.ndarray, n_items: int, n_negatives: int = 
     for k, u in enumerate(users):
         seen = set(by_user[u])
         pos = by_user[u][-1]
-        negs = []
-        while len(negs) < n_negatives:
-            cand = int(rng.integers(1, n_items + 1))
-            if cand not in seen:
-                negs.append(cand)
+        # sample WITHOUT replacement from the unseen pool: duplicates would skew
+        # HR@10, and rejection sampling never terminates when seen == all items
+        unseen = np.setdiff1d(np.arange(1, n_items + 1, dtype="int64"),
+                              np.fromiter(seen, dtype="int64"))
+        if len(unseen) >= n_negatives:
+            negs = rng.choice(unseen, size=n_negatives, replace=False)
+        else:  # degenerate tiny-catalog case: pad by cycling the unseen pool
+            reps = int(np.ceil(n_negatives / max(len(unseen), 1)))
+            negs = np.tile(unseen, reps)[:n_negatives] if len(unseen) else \
+                np.full(n_negatives, pos, dtype="int64")
         out[k, 0] = (u, pos)
         out[k, 1:, 0] = u
         out[k, 1:, 1] = negs

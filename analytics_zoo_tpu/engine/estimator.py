@@ -141,9 +141,10 @@ class Estimator:
             in_shape = [tuple(xi.shape[1:]) for xi in x]
         rng = jax.random.PRNGKey(seed)
         k_init, k_train = jax.random.split(rng)
-        params, mstate = self.model.build(k_init, in_shape)
         if self.initial_weights is not None:
             params, mstate = self.initial_weights
+        else:
+            params, mstate = self.model.build(k_init, in_shape)
         opt_state = self.tx.init(params)
         state = {
             "params": params,

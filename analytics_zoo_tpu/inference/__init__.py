@@ -1,0 +1,11 @@
+"""Inference engine — load models and serve low-latency predictions.
+
+Parity: /root/reference/zoo/src/main/scala/com/intel/analytics/zoo/pipeline/
+inference/ (InferenceModel.scala, InferenceModelFactory.scala,
+AbstractInferenceModel.java, InferenceSummary.scala).
+"""
+
+from .inference_model import InferenceModel
+from .summary import InferenceSummary, timing
+
+__all__ = ["InferenceModel", "InferenceSummary", "timing"]

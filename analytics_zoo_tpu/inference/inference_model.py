@@ -1,0 +1,253 @@
+"""InferenceModel — multi-backend, concurrency-bounded predictor.
+
+Parity: /root/reference/zoo/src/main/scala/com/intel/analytics/zoo/pipeline/
+inference/InferenceModel.scala:33-499 — the reference keeps a
+``LinkedBlockingQueue`` pool of model replicas (default ``concurrentNum=20``),
+borrows one per ``doPredict`` call, and auto-scales by cloning on demand; loaders
+cover BigDL/Caffe/OpenVINO/TF/PyTorch formats.
+
+TPU-native design
+-----------------
+* One set of weights lives in device HBM; XLA executables are reentrant, so
+  "replicas" collapse to a single compiled program guarded by a semaphore that
+  reproduces the reference's bounded-concurrency semantics (and its pool
+  metrics) without duplicating memory.
+* ``jit`` specialises on shape. To keep latency predictable under ragged request
+  sizes, inputs are padded up to a small ladder of batch buckets (1,2,4,...,
+  ``max_batch``) so at most ``log2(max_batch)+1`` executables ever compile;
+  outputs are sliced back. This replaces the reference's per-replica TF/OpenVINO
+  sessions with AOT-warmed XLA programs.
+* The OpenVINO-Int8 capability (InferenceModel.doLoadOpenVINOInt8) maps to
+  weight-only int8 quantization: per-output-channel symmetric scales on matmul
+  weights, dequantised on the fly inside the compiled program (HBM footprint
+  /4; bandwidth-bound layers speed up).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .summary import InferenceSummary, timing
+
+
+def _buckets(max_batch: int) -> List[int]:
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return out
+
+
+def _pad_to(arr: np.ndarray, n: int) -> np.ndarray:
+    if arr.shape[0] == n:
+        return arr
+    pad = [(0, n - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
+def _quantize_leaf(w: np.ndarray) -> Dict[str, np.ndarray]:
+    """Per-output-channel symmetric int8 (channels = last dim)."""
+    scale = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    scale = np.maximum(scale, 1e-8) / 127.0
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return {"q": q, "scale": scale.astype(np.float32)}
+
+
+class InferenceModel:
+    """Bounded-concurrency predictor over a jit-compiled forward.
+
+    Usage::
+
+        im = InferenceModel(supported_concurrent_num=4)
+        im.load_zoo("/path/to/bundle")     # .analytics-zoo-style dir bundle
+        out = im.predict(np.array(...))    # thread-safe
+
+    ``load(module, params, state)`` accepts any live module (e.g. a fitted
+    ``Sequential``/``Model``/zoo model) directly.
+    """
+
+    def __init__(self, supported_concurrent_num: int = 20,
+                 max_batch_size: int = 1024,
+                 summary: Optional[InferenceSummary] = None):
+        if supported_concurrent_num < 1:
+            raise ValueError("supported_concurrent_num must be >= 1")
+        self.concurrent_num = supported_concurrent_num
+        self.max_batch_size = max_batch_size
+        self._sem = threading.Semaphore(supported_concurrent_num)
+        self._lock = threading.Lock()
+        self._apply = None          # (params, state, x) -> y
+        self._params = None
+        self._state = None
+        self._compiled: Dict[Tuple, Any] = {}
+        self._quantized = False
+        self.summary = summary
+        # pool metrics (InferenceModel.scala keeps originalModel + clones count)
+        self.borrowed_peak = 0
+        self._borrowed = 0
+
+    # ------------------------------------------------------------------ loading
+
+    def load(self, module, params=None, state=None) -> "InferenceModel":
+        """Load from a live module. If ``module`` is a compiled KerasNet/zoo
+        model with trained state, params/state default to it."""
+        if params is None:
+            est = getattr(module, "estimator", None)
+            if est is not None and est.train_state is not None:
+                params = est.train_state["params"]
+                state = est.train_state["model_state"]
+            elif est is not None and getattr(est, "initial_weights", None):
+                params, state = est.initial_weights
+            else:
+                raise ValueError("module has no trained state; pass params=")
+        self._apply = lambda p, s, x, m=module: m.apply(p, s, x, training=False)[0]
+        self._params = jax.device_put(params)
+        self._state = jax.device_put(state if state is not None else {})
+        self._compiled.clear()
+        return self
+
+    def load_zoo(self, path: str, model_class=None) -> "InferenceModel":
+        """Load a ``.analytics-zoo``-style directory bundle saved by
+        ``ZooModel.save_model`` (InferenceModel.doLoadBigDL parity: rebuild
+        architecture + weights, ready to predict)."""
+        from ..models.common.zoo_model import load_model_bundle
+
+        model, _cfg = load_model_bundle(path, model=None if model_class is None
+                                        else model_class())
+        # Bundle restore defers weights to compile; force materialisation now.
+        if getattr(model, "estimator", None) is None:
+            model.compile(optimizer="sgd", loss="mse")
+        return self.load(model)
+
+    def load_fn(self, fn, params, state=None) -> "InferenceModel":
+        """Load a bare ``fn(params, state, x) -> y`` (escape hatch for imported
+        graphs — the TFNet/TorchNet capability lands here via importers)."""
+        self._apply = fn
+        self._params = jax.device_put(params)
+        self._state = jax.device_put(state if state is not None else {})
+        self._compiled.clear()
+        return self
+
+    # ------------------------------------------------------------- quantization
+
+    def quantize_int8(self, min_elements: int = 4096) -> "InferenceModel":
+        """Weight-only int8 for matmul-shaped leaves (>=2D, >= ``min_elements``).
+
+        InferenceModel.doLoadOpenVINOInt8 capability: the reference delegates
+        int8 to OpenVINO's calibrated IR; here matmul weights store as int8 +
+        per-channel scale and dequantise inside the compiled program.
+        """
+        if self._params is None:
+            raise RuntimeError("load a model before quantizing")
+        flat, treedef = jax.tree_util.tree_flatten(self._params)
+        packed = []
+        for leaf in flat:
+            arr = np.asarray(jax.device_get(leaf))
+            if arr.ndim >= 2 and arr.size >= min_elements and \
+                    np.issubdtype(arr.dtype, np.floating):
+                packed.append(_quantize_leaf(arr))
+            else:
+                packed.append(arr)
+        inner_apply = self._apply
+
+        def dequant(p):
+            flat_q, td = jax.tree_util.tree_flatten(
+                p, is_leaf=lambda x: isinstance(x, dict) and "q" in x)
+            deq = [x["q"].astype(jnp.float32) * x["scale"]
+                   if isinstance(x, dict) and "q" in x else x for x in flat_q]
+            return jax.tree_util.tree_unflatten(td, deq)
+
+        self._apply = lambda p, s, x: inner_apply(dequant(p), s, x)
+        self._params = jax.device_put(jax.tree_util.tree_unflatten(treedef, packed))
+        self._compiled.clear()
+        self._quantized = True
+        return self
+
+    # ---------------------------------------------------------------- predicting
+
+    def _executable(self, key: Tuple):
+        exe = self._compiled.get(key)
+        if exe is None:
+            with self._lock:
+                exe = self._compiled.get(key)
+                if exe is None:
+                    exe = jax.jit(self._apply)
+                    self._compiled[key] = exe
+        return exe
+
+    def _bucket(self, n: int) -> int:
+        for b in _buckets(self.max_batch_size):
+            if n <= b:
+                return b
+        return self.max_batch_size
+
+    def predict(self, inputs, batch_first: bool = True):
+        """Thread-safe bounded-concurrency predict (doPredict parity).
+
+        ``inputs``: ndarray or list/tuple of ndarrays (multi-input models).
+        Requests larger than ``max_batch_size`` are chunked.
+        """
+        if self._apply is None:
+            raise RuntimeError("no model loaded (call load/load_zoo first)")
+        multi = isinstance(inputs, (list, tuple))
+        arrs = [np.asarray(a) for a in (inputs if multi else [inputs])]
+        n = arrs[0].shape[0]
+        if any(a.shape[0] != n for a in arrs):
+            raise ValueError("all inputs must share the batch dimension")
+
+        t0 = time.perf_counter()
+        with self._sem:
+            with self._lock:
+                self._borrowed += 1
+                self.borrowed_peak = max(self.borrowed_peak, self._borrowed)
+            try:
+                outs = []
+                for lo in range(0, n, self.max_batch_size):
+                    hi = min(lo + self.max_batch_size, n)
+                    bucket = self._bucket(hi - lo)
+                    padded = [_pad_to(a[lo:hi], bucket) for a in arrs]
+                    x = padded if multi else padded[0]
+                    key = (bucket,) + tuple((a.shape[1:], str(a.dtype))
+                                            for a in padded)
+                    with timing("inference.forward"):
+                        y = self._executable(key)(self._params, self._state, x)
+                    y = jax.tree_util.tree_map(
+                        lambda a: np.asarray(jax.device_get(a))[:hi - lo], y)
+                    outs.append(y)
+            finally:
+                with self._lock:
+                    self._borrowed -= 1
+        if len(outs) == 1:
+            result = outs[0]
+        else:
+            result = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs, axis=0), *outs)
+        if self.summary is not None:
+            self.summary.add_batch(n, time.perf_counter() - t0)
+        return result
+
+    # ------------------------------------------------------------------- warmup
+
+    def warm_up(self, example_inputs) -> None:
+        """Compile the bucket ladder ahead of traffic (AOT; replaces the
+        reference's replica-clone prefill)."""
+        multi = isinstance(example_inputs, (list, tuple))
+        arrs = [np.asarray(a) for a in
+                (example_inputs if multi else [example_inputs])]
+        for b in _buckets(self.max_batch_size):
+            padded = [_pad_to(a[:1], b) for a in arrs]
+            self.predict(padded if multi else padded[0])
+
+    @property
+    def is_quantized(self) -> bool:
+        return self._quantized
+
+    def __repr__(self):
+        return (f"InferenceModel(concurrent_num={self.concurrent_num}, "
+                f"loaded={self._apply is not None}, int8={self._quantized})")

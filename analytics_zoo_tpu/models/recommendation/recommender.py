@@ -41,15 +41,6 @@ class Recommender(Model):
         return [UserItemPrediction(int(u), int(i), int(c) + 1, float(p[c]))
                 for (u, i), c, p in zip(pairs, cls, probs)]
 
-    def _score_matrix(self, users: np.ndarray, items: np.ndarray,
-                      batch_size: int = 4096) -> np.ndarray:
-        """P(max class) for the cross of aligned user/item id arrays."""
-        pairs = np.stack([users, items], axis=1).astype("int32")
-        probs = self.predict(pairs, batch_size=batch_size)
-        # expected-rating style score: probability-weighted class index
-        classes = np.arange(1, probs.shape[-1] + 1, dtype="float32")
-        return (probs * classes).sum(-1)
-
     def recommend_for_user(self, user_item_pairs: np.ndarray, max_items: int
                            ) -> List[UserItemPrediction]:
         """Top-``max_items`` per user among the candidate pairs given
@@ -61,7 +52,10 @@ class Recommender(Model):
             by_user.setdefault(p.user_id, []).append(p)
         out: List[UserItemPrediction] = []
         for u in sorted(by_user):
-            ranked = sorted(by_user[u], key=lambda p: -p.probability)
+            # Recommender.scala:55 orders by (-prediction, -probability): the
+            # predicted rating class ranks first, confidence breaks ties.
+            ranked = sorted(by_user[u],
+                            key=lambda p: (-p.prediction, -p.probability))
             out.extend(ranked[:max_items])
         return out
 
@@ -75,6 +69,7 @@ class Recommender(Model):
             by_item.setdefault(p.item_id, []).append(p)
         out: List[UserItemPrediction] = []
         for i in sorted(by_item):
-            ranked = sorted(by_item[i], key=lambda p: -p.probability)
+            ranked = sorted(by_item[i],
+                            key=lambda p: (-p.prediction, -p.probability))
             out.extend(ranked[:max_users])
         return out

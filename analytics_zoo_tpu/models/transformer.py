@@ -36,6 +36,8 @@ class TransformerLM(Layer, KerasNet):
         self.hidden_size = hidden_size
         self.n_block = n_block
         self.seq_len = seq_len
+        self.intermediate_size = intermediate_size
+        self.attn_strategy = attn_strategy
         self.remat = remat
         self.blocks = [
             TransformerLayer(hidden_size, n_head, intermediate_size, causal=True,
@@ -96,7 +98,9 @@ class TransformerLM(Layer, KerasNet):
     def constructor_config(self):
         return dict(vocab=self.vocab, hidden_size=self.hidden_size,
                     n_block=self.n_block, n_head=self.blocks[0].attn.n_head,
-                    seq_len=self.seq_len)
+                    seq_len=self.seq_len,
+                    intermediate_size=self.intermediate_size,
+                    attn_strategy=self.attn_strategy, remat=self.remat)
 
 
 def lm_loss(y_true, logits):

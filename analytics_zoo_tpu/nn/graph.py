@@ -175,9 +175,18 @@ class SequentialModule(Layer):
         self.layers.append(layer)
         return self
 
+    @staticmethod
+    def _slot_key(i: int, layer: Layer) -> str:
+        return f"{i}_{type(layer).__name__.lower()}"
+
     def slot(self, layer: Layer) -> str:
         """Deterministic positional param key (see GraphModule.slot)."""
-        return f"{self.layers.index(layer)}_{type(layer).__name__.lower()}"
+        hits = [i for i, l in enumerate(self.layers) if l is layer]
+        if len(hits) != 1:
+            raise ValueError(
+                f"layer {layer.name} appears {len(hits)} times in this "
+                "Sequential; address its params by position instead")
+        return self._slot_key(hits[0], layer)
 
     @property
     def input_shape(self):
@@ -199,7 +208,7 @@ class SequentialModule(Layer):
         rngs = split_rng(rng, len(self.layers))
         for i, (r, layer) in enumerate(zip(rngs, self.layers)):
             p, s = layer.build(r, shape)
-            key = f"{i}_{type(layer).__name__.lower()}"
+            key = self._slot_key(i, layer)
             if p:
                 params[key] = p
             if s:
@@ -211,7 +220,7 @@ class SequentialModule(Layer):
         new_state = dict(state)
         rngs = iter(split_rng(rng, len(self.layers)))
         for i, layer in enumerate(self.layers):
-            key = f"{i}_{type(layer).__name__.lower()}"
+            key = self._slot_key(i, layer)
             p = params.get(key, {})
             s = new_state.get(key, {})
             x, s2 = layer.apply(p, s, x, training=training, rng=next(rngs))

@@ -60,6 +60,11 @@ class KerasNet:
             params, state = _load(path, self, cur["p"], cur["s"])
             est.train_state["params"] = est._place_state(params)
             est.train_state["model_state"] = est._place_state(state)
+            # stale Adam moments/step belong to the pre-load weights; restart
+            # the optimizer so the first post-load updates are correctly scaled
+            est.train_state["opt_state"] = est._place_state(
+                est.tx.init(jax.device_get(est.train_state["params"])))
+            est.train_state["step"] = jax.numpy.zeros((), jax.numpy.int32)
         else:
             params_t, state_t = self.build(jax.random.PRNGKey(0))
             params, state = _load(path, self, params_t, state_t)

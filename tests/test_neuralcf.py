@@ -55,19 +55,22 @@ def test_fit_and_recommend(small_ncf):
     assert all(1 <= p.prediction <= 5 for p in preds)
     assert all(0.0 <= p.probability <= 1.0 for p in preds)
 
+    # Recommender.scala:55 ranking: predicted rating desc, probability tiebreak
     recs = small_ncf.recommend_for_user(xte, max_items=3)
     by_user = {}
     for r in recs:
-        by_user.setdefault(r.user_id, []).append(r.probability)
-    for probs in by_user.values():
-        assert len(probs) <= 3
-        assert probs == sorted(probs, reverse=True)
+        by_user.setdefault(r.user_id, []).append((-r.prediction, -r.probability))
+    for keys in by_user.values():
+        assert len(keys) <= 3
+        assert keys == sorted(keys)
 
     recs_i = small_ncf.recommend_for_item(xte, max_users=2)
     by_item = {}
     for r in recs_i:
-        by_item.setdefault(r.item_id, []).append(r)
-    assert all(len(v) <= 2 for v in by_item.values())
+        by_item.setdefault(r.item_id, []).append((-r.prediction, -r.probability))
+    for keys in by_item.values():
+        assert len(keys) <= 2
+        assert keys == sorted(keys)
 
 
 def test_hitrate_eval_layout(small_ncf):
