@@ -1,0 +1,22 @@
+"""Cluster Serving — streaming inference over a queue fabric.
+
+Parity: /root/reference/zoo/src/main/scala/com/intel/analytics/zoo/serving/
+(ClusterServing.scala, engine/FlinkRedisSource.scala, engine/FlinkInference.scala,
+engine/FlinkRedisSink.scala, http/FrontEndApp.scala) and the python client
+/root/reference/pyzoo/zoo/serving/client.py.
+
+The reference's fabric is Redis streams + a Flink map job + an akka-http gateway.
+The TPU-native rebuild keeps the same client-visible contract (``InputQueue.
+enqueue`` / ``OutputQueue.query``/``dequeue``, streaming micro-batches, topN
+post-processing, HTTP predict endpoint) over a self-contained TCP stream broker
+and a pipelined Python engine feeding XLA-compiled predict.
+"""
+
+from .broker import QueueBroker, start_broker
+from .client import InputQueue, OutputQueue
+from .config import ServingConfig
+from .engine import ClusterServing
+from .http_frontend import FrontEndApp
+
+__all__ = ["QueueBroker", "start_broker", "InputQueue", "OutputQueue",
+           "ServingConfig", "ClusterServing", "FrontEndApp"]

@@ -1,0 +1,212 @@
+"""QueueBroker — a self-contained stream broker (the Redis-streams equivalent).
+
+Parity: the reference fronts serving with Redis: clients ``XADD`` requests onto a
+stream, the Flink source consumes via a consumer group (``xgroupCreate`` +
+``xreadGroup`` — /root/reference/zoo/.../serving/engine/FlinkRedisSource.scala:
+44-59), and results land in per-request hashes read by ``OutputQueue``
+(client.py:277-300). This broker provides exactly those primitives over a
+length-prefixed-JSON TCP protocol:
+
+    XADD stream payload              -> id
+    XREADGROUP stream group n block  -> [(id, payload), ...]   (each entry to ONE consumer)
+    HSET key mapping / HGET key / HDEL key
+    LEN stream / PING / SHUTDOWN
+
+It runs in-process (``start_broker()`` returns a served port) or standalone
+(``python -m analytics_zoo_tpu.serving.broker --port 6380``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+_HDR = struct.Struct(">I")
+MAX_MSG = 512 * 1024 * 1024
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    data = json.dumps(obj).encode("utf-8")
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, _HDR.size)
+    (n,) = _HDR.unpack(hdr)
+    if n > MAX_MSG:
+        raise ValueError(f"message of {n} bytes exceeds limit")
+    return json.loads(_recv_exact(sock, n).decode("utf-8"))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class _Store:
+    """Streams (bounded lists w/ per-group cursors) + hashes, one lock.
+
+    Streams are trimmed like Redis ``XADD MAXLEN ~``: beyond ``maxlen`` entries
+    the oldest are dropped and every group cursor shifts accordingly, so a
+    long-running deployment holds bounded memory.
+    """
+
+    def __init__(self, maxlen: int = 65536):
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.maxlen = maxlen
+        self.streams: Dict[str, List[Tuple[str, Any]]] = collections.defaultdict(list)
+        self.cursors: Dict[Tuple[str, str], int] = collections.defaultdict(int)
+        self.trimmed: Dict[str, int] = collections.defaultdict(int)
+        self.hashes: Dict[str, Any] = {}
+        self._seq = 0
+
+    def xadd(self, stream: str, payload: Any) -> str:
+        with self.cond:
+            self._seq += 1
+            entry_id = f"{self._seq}-0"
+            entries = self.streams[stream]
+            entries.append((entry_id, payload))
+            overflow = len(entries) - self.maxlen
+            if overflow > 0:
+                del entries[:overflow]
+                self.trimmed[stream] += overflow
+                for key in self.cursors:
+                    if key[0] == stream:
+                        self.cursors[key] = max(0, self.cursors[key] - overflow)
+            self.cond.notify_all()
+            return entry_id
+
+    def xgroupcreate(self, stream: str, group: str, start: str = "$") -> None:
+        """Register a consumer group. ``start='$'`` = only entries added after
+        this call (Redis tail semantics); ``'0'`` = replay from the beginning.
+        No-op when the group exists (cursor preserved across job restarts)."""
+        with self.cond:
+            key = (stream, group)
+            if key not in self.cursors:
+                self.cursors[key] = (len(self.streams[stream])
+                                     if start == "$" else 0)
+
+    def xreadgroup(self, stream: str, group: str, count: int,
+                   block_ms: int) -> List[Tuple[str, Any]]:
+        deadline = None if block_ms <= 0 else block_ms / 1e3
+        with self.cond:
+            key = (stream, group)
+
+            def pending():
+                return len(self.streams[stream]) - self.cursors[key]
+
+            if pending() == 0 and deadline:
+                self.cond.wait(timeout=deadline)
+            take = min(count, pending())
+            if take <= 0:
+                return []
+            start = self.cursors[key]
+            self.cursors[key] = start + take
+            return self.streams[stream][start:start + take]
+
+    def hset(self, key: str, mapping: Any) -> None:
+        with self.cond:
+            self.hashes[key] = mapping
+            self.cond.notify_all()
+
+    def hget(self, key: str, block_ms: int = 0) -> Any:
+        deadline = None if block_ms <= 0 else block_ms / 1e3
+        with self.cond:
+            if key not in self.hashes and deadline:
+                self.cond.wait_for(lambda: key in self.hashes, timeout=deadline)
+            return self.hashes.get(key)
+
+    def hdel(self, key: str) -> None:
+        with self.cond:
+            self.hashes.pop(key, None)
+
+    def slen(self, stream: str) -> int:
+        with self.cond:
+            return len(self.streams[stream])
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        store: _Store = self.server.store  # type: ignore[attr-defined]
+        try:
+            while True:
+                req = recv_msg(self.request)
+                cmd = req[0]
+                if cmd == "XADD":
+                    resp = store.xadd(req[1], req[2])
+                elif cmd == "XGROUPCREATE":
+                    store.xgroupcreate(req[1], req[2],
+                                       req[3] if len(req) > 3 else "$")
+                    resp = "OK"
+                elif cmd == "XREADGROUP":
+                    resp = store.xreadgroup(req[1], req[2], req[3], req[4])
+                elif cmd == "HSET":
+                    store.hset(req[1], req[2])
+                    resp = "OK"
+                elif cmd == "HGET":
+                    resp = store.hget(req[1], req[2] if len(req) > 2 else 0)
+                elif cmd == "HDEL":
+                    store.hdel(req[1])
+                    resp = "OK"
+                elif cmd == "LEN":
+                    resp = store.slen(req[1])
+                elif cmd == "PING":
+                    resp = "PONG"
+                elif cmd == "SHUTDOWN":
+                    send_msg(self.request, "OK")
+                    threading.Thread(target=self.server.shutdown,
+                                     daemon=True).start()
+                    return
+                else:
+                    resp = {"error": f"unknown command {cmd!r}"}
+                send_msg(self.request, resp)
+        except (ConnectionError, OSError):
+            return
+
+
+class QueueBroker(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _Handler)
+        self.store = _Store()
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+def start_broker(host: str = "127.0.0.1", port: int = 0) -> QueueBroker:
+    """Start a broker on a daemon thread; returns it (``.port`` is bound)."""
+    broker = QueueBroker(host, port)
+    threading.Thread(target=broker.serve_forever, daemon=True,
+                     name="zoo-queue-broker").start()
+    return broker
+
+
+def main():  # pragma: no cover - exercised as a subprocess
+    ap = argparse.ArgumentParser(description="analytics_zoo_tpu queue broker")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=6380)
+    args = ap.parse_args()
+    broker = QueueBroker(args.host, args.port)
+    print(f"queue broker listening on {args.host}:{broker.port}", flush=True)
+    broker.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,110 @@
+"""Serving client — ``InputQueue`` / ``OutputQueue``.
+
+Parity: /root/reference/pyzoo/zoo/serving/client.py — ``InputQueue.enqueue(uri,
+**data)`` (ndarray → arrow → base64 → Redis XADD, :99-181) and ``OutputQueue.
+query(uri)`` / ``dequeue()`` (:273-300). Same API over the TPU rebuild's broker.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .broker import recv_msg, send_msg
+from .schema import decode_payload, encode_payload
+
+INPUT_STREAM = "serving_stream"
+RESULT_PREFIX = "result:"
+
+
+class _Conn:
+    """One broker connection; a lock serialises request/response pairs."""
+
+    def __init__(self, host: str, port: int, timeout: Optional[float] = None):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.lock = threading.Lock()
+
+    def call(self, *req) -> Any:
+        with self.lock:
+            send_msg(self.sock, list(req))
+            return recv_msg(self.sock)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class InputQueue:
+    """Producer side: enqueue named tensors for the serving job."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6380,
+                 stream: str = INPUT_STREAM):
+        self.stream = stream
+        self._conn = _Conn(host, port)
+
+    def enqueue(self, uri: Optional[str] = None, **data) -> str:
+        """Enqueue one record. ``data``: name → ndarray (or scalars/str).
+        Returns the record uri (auto-generated when not given)."""
+        if not data:
+            raise ValueError("enqueue needs at least one named tensor")
+        uri = uri or uuid.uuid4().hex
+        payload = {"uri": uri, "data": encode_payload(
+            {k: np.asarray(v) if not isinstance(v, (str, bytes)) else v
+             for k, v in data.items()})}
+        self._conn.call("XADD", self.stream, payload)
+        return uri
+
+    def __len__(self) -> int:
+        return int(self._conn.call("LEN", self.stream))
+
+    def close(self):
+        self._conn.close()
+
+
+class OutputQueue:
+    """Consumer side: fetch results by uri or drain everything available."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6380):
+        self._conn = _Conn(host, port)
+        self._known: List[str] = []
+
+    def register(self, uri: str) -> None:
+        self._known.append(uri)
+
+    def query(self, uri: str, timeout_s: float = 30.0) -> Any:
+        """Blocking fetch of one result (client.py:277 parity)."""
+        resp = self._conn.call("HGET", RESULT_PREFIX + uri,
+                               int(timeout_s * 1000))
+        if resp is None:
+            raise TimeoutError(f"no result for {uri!r} within {timeout_s}s")
+        self._conn.call("HDEL", RESULT_PREFIX + uri)
+        decoded = decode_payload(resp)
+        if "error" in decoded:
+            raise RuntimeError(f"serving error for {uri!r}: {decoded['error']}")
+        return decoded["value"]
+
+    def dequeue(self) -> Dict[str, Any]:
+        """Fetch all registered results that are READY — a non-blocking scan
+        like the reference's key scan (client.py:293). Errored records come
+        back as ``{"error": ...}`` dicts (and leave the registry) instead of
+        aborting the whole drain."""
+        out: Dict[str, Any] = {}
+        for uri in list(self._known):
+            try:
+                out[uri] = self.query(uri, timeout_s=0)
+                self._known.remove(uri)
+            except TimeoutError:
+                continue  # not ready yet; stays registered
+            except RuntimeError as e:
+                out[uri] = {"error": str(e)}
+                self._known.remove(uri)
+        return out
+
+    def close(self):
+        self._conn.close()

@@ -1,0 +1,55 @@
+"""Serving configuration.
+
+Parity: /root/reference/scripts/cluster-serving/config.yaml parsed by
+/root/reference/zoo/.../serving/utils/ClusterServingHelper.scala — model path,
+batch size, thread/parallelism knobs, queue endpoint, top-N post-processing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    model_path: str = ""
+    batch_size: int = 32                 # micro-batch cap (params/batchSize)
+    batch_timeout_ms: int = 5            # max wait to fill a micro-batch
+                                         # (0 = non-blocking poll, never coerced)
+    concurrent_num: int = 4              # inference concurrency (params/coreNum)
+    queue_host: str = "127.0.0.1"        # redis/host parity
+    queue_port: int = 6380               # redis/port parity
+    top_n: Optional[int] = None          # postprocessing topN
+    int8: bool = False                   # OpenVINO-int8 capability
+    log_dir: Optional[str] = None        # InferenceSummary TB dir
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ServingConfig":
+        """Accepts both this framework's flat keys and the reference's nested
+        config.yaml layout (model/path, params/batchSize, redis/host...)."""
+        import yaml
+
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        flat = {}
+        model = raw.get("model") or {}
+        params = raw.get("params") or {}
+        redis = raw.get("redis") or raw.get("queue") or {}
+        post = raw.get("postprocessing") or {}
+        flat["model_path"] = raw.get("model_path", model.get("path", ""))
+        flat["batch_size"] = int(raw.get("batch_size",
+                                         params.get("batchSize", 32)))
+        flat["concurrent_num"] = int(raw.get("concurrent_num",
+                                             params.get("coreNum", 4)))
+        if "batch_timeout_ms" in raw:
+            flat["batch_timeout_ms"] = int(raw["batch_timeout_ms"])
+        flat["queue_host"] = raw.get("queue_host",
+                                     redis.get("host", "127.0.0.1"))
+        flat["queue_port"] = int(raw.get("queue_port",
+                                         redis.get("port", 6380)))
+        tn = raw.get("top_n", post.get("topN"))
+        flat["top_n"] = int(tn) if tn is not None else None
+        flat["int8"] = bool(raw.get("int8", model.get("int8", False)))
+        flat["log_dir"] = raw.get("log_dir")
+        return cls(**flat)

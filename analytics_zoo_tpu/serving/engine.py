@@ -1,0 +1,232 @@
+"""ClusterServing engine — source → batched inference → sink, pipelined.
+
+Parity: /root/reference/zoo/.../serving/ClusterServing.scala:33-51 assembles
+``FlinkRedisSource → FlinkInference → FlinkRedisSink``; FlinkInference
+(engine/FlinkInference.scala:28-62) batches up to ``coreNum`` records and runs
+the InferenceModel replica pool; PostProcessing applies topN.
+
+Here the three stages are daemon threads joined by bounded queues, so decode,
+XLA execution and result writing overlap exactly like Flink operator chaining.
+Inference itself is the bucketed jit executable of
+:class:`analytics_zoo_tpu.inference.InferenceModel` — one compiled program,
+MXU-batched across the micro-batch.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..inference import InferenceModel, InferenceSummary
+from .client import INPUT_STREAM, RESULT_PREFIX, _Conn
+from .config import ServingConfig
+from .schema import decode_payload, encode_payload
+
+logger = logging.getLogger("analytics_zoo_tpu.serving")
+
+
+class ClusterServing:
+    """Streaming inference job.
+
+    ``model`` may be an :class:`InferenceModel`, a live compiled module, or
+    ``None`` with ``config.model_path`` pointing at a zoo bundle.
+    """
+
+    def __init__(self, model=None, config: Optional[ServingConfig] = None,
+                 group: str = "serving"):
+        self.config = config or ServingConfig()
+        self.group = group
+        self.summary = (InferenceSummary(self.config.log_dir, "serving")
+                        if self.config.log_dir else None)
+        if isinstance(model, InferenceModel):
+            self.model = model
+        elif model is not None:
+            self.model = InferenceModel(
+                supported_concurrent_num=self.config.concurrent_num,
+                max_batch_size=max(self.config.batch_size, 1),
+                summary=self.summary).load(model)
+        else:
+            if not self.config.model_path:
+                raise ValueError("pass a model or set config.model_path")
+            self.model = InferenceModel(
+                supported_concurrent_num=self.config.concurrent_num,
+                max_batch_size=max(self.config.batch_size, 1),
+                summary=self.summary).load_zoo(self.config.model_path)
+        if self.config.int8 and not self.model.is_quantized:
+            self.model.quantize_int8()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # bounded hand-off queues = operator-chain backpressure
+        self._infer_q: "queue.Queue" = queue.Queue(maxsize=8)
+        self._sink_q: "queue.Queue" = queue.Queue(maxsize=32)
+        self._inflight = 0              # batches popped but not yet sunk
+        self._inflight_lock = threading.Lock()
+        self.served = 0
+
+    # ------------------------------------------------------------------ stages
+
+    def _connect(self) -> Optional[_Conn]:
+        """Connect to the broker, retrying until up or the job stops."""
+        while not self._stop.is_set():
+            try:
+                return _Conn(self.config.queue_host, self.config.queue_port)
+            except OSError:
+                logger.warning("broker unreachable; retrying")
+                time.sleep(0.2)
+        return None
+
+    def _source_loop(self):
+        conn = self._connect()
+        cfg = self.config
+        while not self._stop.is_set() and conn is not None:
+            try:
+                entries = conn.call("XREADGROUP", INPUT_STREAM, self.group,
+                                    cfg.batch_size, cfg.batch_timeout_ms)
+            except (OSError, ConnectionError):
+                conn.close()
+                conn = self._connect()
+                continue
+            if not entries:
+                if cfg.batch_timeout_ms <= 0:
+                    time.sleep(0.005)  # non-blocking poll: avoid busy spin
+                continue
+            batch, bad = [], []
+            for _id, payload in entries:
+                try:
+                    batch.append((payload["uri"], decode_payload(payload["data"])))
+                except Exception as e:  # malformed record: report, keep running
+                    logger.exception("malformed record %s", _id)
+                    uri = payload.get("uri") if isinstance(payload, dict) else None
+                    if uri:
+                        bad.append((uri, {"error": f"malformed payload: {e}"}))
+            if bad:
+                self._sink_q.put(bad)
+            if batch:
+                with self._inflight_lock:
+                    self._inflight += 1
+                self._infer_q.put(batch)
+        if conn is not None:
+            conn.close()
+
+    def _collate(self, batch: List[Tuple[str, Dict[str, np.ndarray]]]):
+        """Stack per-record tensors into batched arrays (FlinkInference batches
+        records before predict). Records must share input names/shapes."""
+        names = list(batch[0][1].keys())
+        arrays = []
+        for name in names:
+            arrays.append(np.stack([rec[name] for _, rec in batch], axis=0))
+        return arrays[0] if len(arrays) == 1 else arrays
+
+    def _infer_loop(self):
+        while not self._stop.is_set():
+            try:
+                batch = self._infer_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            uris = [u for u, _ in batch]
+            try:
+                x = self._collate(batch)
+                y = self.model.predict(x)
+                outs = self._postprocess(y)
+                self._sink_q.put([(u, {"value": o}) for u, o in zip(uris, outs)])
+            except Exception as e:  # one bad record must not kill the job
+                logger.exception("inference batch failed")
+                self._sink_q.put([(u, {"error": str(e)}) for u in uris])
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
+
+    def _postprocess(self, y) -> List[Any]:
+        """Split batch back into per-record results; apply topN
+        (serving/PostProcessing.scala parity)."""
+        if isinstance(y, (list, tuple)):
+            per_rec = [[np.asarray(o[i]) for o in y] for i in range(len(y[0]))]
+        else:
+            y = np.asarray(y)
+            per_rec = [y[i] for i in range(y.shape[0])]
+        if self.config.top_n is None:
+            return per_rec
+        n = self.config.top_n
+        out = []
+        for r in per_rec:
+            flat = np.asarray(r).ravel()
+            idx = np.argsort(-flat)[:n]
+            out.append(np.stack([idx.astype(np.float32), flat[idx]], axis=1))
+        return out
+
+    def _sink_loop(self):
+        conn = self._connect()
+        # keep draining after _stop so results already computed still land
+        while conn is not None:
+            try:
+                results = self._sink_q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    break
+                continue
+            for uri, value in results:
+                while True:
+                    try:
+                        conn.call("HSET", RESULT_PREFIX + uri,
+                                  encode_payload(value))
+                        self.served += 1
+                        break
+                    except (OSError, ConnectionError):
+                        conn.close()
+                        conn = self._connect()
+                        if conn is None:  # stopping and broker gone: give up
+                            return
+        if conn is not None:
+            conn.close()
+
+    # ----------------------------------------------------------------- control
+
+    def start(self) -> "ClusterServing":
+        """Start the pipeline (non-blocking; threads are daemons)."""
+        self._stop.clear()
+        # Register the consumer group at the stream TAIL before consuming
+        # (FlinkRedisSource.scala:44 xgroupCreate parity): a fresh job sees
+        # only traffic from now on; a restarted job (same group) resumes its
+        # preserved cursor, picking up records enqueued while it was down.
+        conn = self._connect()
+        if conn is not None:
+            conn.call("XGROUPCREATE", INPUT_STREAM, self.group, "$")
+            conn.close()
+        for name, fn in (("source", self._source_loop),
+                         ("infer", self._infer_loop),
+                         ("sink", self._sink_loop)):
+            t = threading.Thread(target=fn, daemon=True, name=f"serving-{name}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def run(self):  # pragma: no cover - interactive entry (ClusterServing.run)
+        self.start()
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            self.stop()
+
+    def stop(self, drain_s: float = 1.0):
+        deadline = time.time() + drain_s
+
+        def busy():  # queued OR currently inside predict (between queues)
+            with self._inflight_lock:
+                inflight = self._inflight
+            return inflight > 0 or not (self._infer_q.empty()
+                                        and self._sink_q.empty())
+
+        while time.time() < deadline and busy():
+            time.sleep(0.01)
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+        if self.summary is not None:
+            self.summary.close()

@@ -1,0 +1,130 @@
+"""HTTP frontend — REST gateway in front of the serving queue.
+
+Parity: /root/reference/zoo/.../serving/http/FrontEndApp.scala:45-220 — an
+akka-http app exposing ``PUT/POST predict``: serialise the request onto the
+Redis stream, await the result hash, respond; plus liveness + metrics routes.
+Here: stdlib ``ThreadingHTTPServer`` (one thread per in-flight request replaces
+the actor round-trip).
+
+Routes:
+    GET  /                 -> liveness ("welcome to analytics zoo web serving")
+    POST /predict          -> {"instances":[{name: tensor-as-nested-list, ...}]}
+    GET  /metrics          -> timing stats JSON
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from ..inference.summary import timing, timing_stats
+from .client import InputQueue, OutputQueue
+from .config import ServingConfig
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _respond(self, code: int, obj) -> None:
+        data = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            self._respond(200, timing_stats())
+        else:
+            self._respond(200, {"message":
+                                "welcome to analytics zoo web serving"})
+
+    def do_POST(self):
+        if self.path not in ("/predict", "/models/predict"):
+            self._respond(404, {"error": f"no route {self.path}"})
+            return
+        app: "FrontEndApp" = self.server.app  # type: ignore[attr-defined]
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            instances = body.get("instances")
+            if not isinstance(instances, list) or not instances:
+                raise ValueError('body must contain non-empty "instances"')
+            with timing("http.predict"):
+                preds = app.predict_instances(instances,
+                                              timeout_s=app.timeout_s)
+            self._respond(200, {"predictions": preds})
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            self._respond(400, {"error": str(e)})
+        except TimeoutError as e:
+            self._respond(504, {"error": str(e)})
+        except Exception as e:  # pragma: no cover
+            self._respond(500, {"error": str(e)})
+
+
+class FrontEndApp:
+    """REST gateway. ``serve()`` blocks; ``start()`` runs on a daemon thread."""
+
+    def __init__(self, config: Optional[ServingConfig] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 timeout_s: float = 30.0):
+        self.config = config or ServingConfig()
+        self.timeout_s = timeout_s
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.app = self  # type: ignore[attr-defined]
+        self._input = InputQueue(self.config.queue_host, self.config.queue_port)
+        # ThreadingHTTPServer spawns a fresh thread per request, so cache broker
+        # connections in a pool rather than thread-locals (which would never hit)
+        self._oq_pool: "queue.LifoQueue[OutputQueue]" = queue.LifoQueue()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @contextlib.contextmanager
+    def _output(self):
+        try:
+            oq = self._oq_pool.get_nowait()
+        except queue.Empty:
+            oq = OutputQueue(self.config.queue_host, self.config.queue_port)
+        try:
+            yield oq
+        except (OSError, ConnectionError):
+            oq.close()  # broken connection: don't return it to the pool
+            raise
+        else:
+            self._oq_pool.put(oq)
+
+    def predict_instances(self, instances, timeout_s: float = 30.0):
+        uris = []
+        for inst in instances:
+            if not isinstance(inst, dict) or not inst:
+                raise ValueError("each instance must be a non-empty object")
+            tensors = {k: np.asarray(v) for k, v in inst.items()}
+            uris.append(self._input.enqueue(None, **tensors))
+        out = []
+        with self._output() as oq:
+            for uri in uris:
+                val = oq.query(uri, timeout_s=timeout_s)
+                out.append(val.tolist() if isinstance(val, np.ndarray) else val)
+        return out
+
+    def start(self) -> "FrontEndApp":
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="serving-http").start()
+        return self
+
+    def serve(self):  # pragma: no cover
+        self._server.serve_forever()
+
+    def stop(self):
+        self._server.shutdown()
+        self._input.close()

@@ -1,0 +1,52 @@
+"""Payload encoding for queue transport.
+
+Parity: /root/reference/pyzoo/zoo/serving/client.py:99-181 — the reference
+serialises ndarrays/images to Arrow record batches then base64 for Redis.
+Here tensors ride as raw ``.npy`` bytes (dtype+shape self-describing) base64'd
+into the JSON envelope — same wire-safety property, zero extra deps.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+from typing import Any, Dict
+
+import numpy as np
+
+
+def encode_ndarray(arr: np.ndarray) -> str:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def decode_ndarray(s: str) -> np.ndarray:
+    return np.load(io.BytesIO(base64.b64decode(s.encode("ascii"))),
+                   allow_pickle=False)
+
+
+def encode_payload(data: Dict[str, Any]) -> Dict[str, Any]:
+    """ndarrays → tagged base64; scalars/strings pass through."""
+    out: Dict[str, Any] = {}
+    for k, v in data.items():
+        if isinstance(v, np.ndarray):
+            out[k] = {"__ndarray__": encode_ndarray(v)}
+        elif isinstance(v, (list, tuple)) and v and \
+                all(isinstance(x, np.ndarray) for x in v):
+            out[k] = {"__ndarray_list__": [encode_ndarray(x) for x in v]}
+        else:
+            out[k] = v
+    return out
+
+
+def decode_payload(data: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in data.items():
+        if isinstance(v, dict) and "__ndarray__" in v:
+            out[k] = decode_ndarray(v["__ndarray__"])
+        elif isinstance(v, dict) and "__ndarray_list__" in v:
+            out[k] = [decode_ndarray(x) for x in v["__ndarray_list__"]]
+        else:
+            out[k] = v
+    return out

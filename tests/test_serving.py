@@ -1,0 +1,217 @@
+"""Cluster-serving tests: broker primitives, client enqueue/dequeue round-trip,
+the streaming engine end-to-end, topN post-processing, and the HTTP frontend.
+
+Mirrors the reference serving specs (zoo/src/test/.../serving/) on a single box.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn import layers as L
+from analytics_zoo_tpu.serving import (ClusterServing, FrontEndApp, InputQueue,
+                                       OutputQueue, ServingConfig, start_broker)
+from analytics_zoo_tpu.serving.schema import decode_payload, encode_payload
+
+
+@pytest.fixture(scope="module")
+def broker():
+    b = start_broker()
+    yield b
+    b.shutdown()
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    model = Sequential([L.Dense(16, activation="relu", input_shape=(8,)),
+                        L.Dense(4, activation="softmax")])
+    model.compile(optimizer="adam", loss="categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    model.fit(x, y, batch_size=16, nb_epoch=1)
+    return model, x
+
+
+def test_payload_roundtrip():
+    data = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "s": "hello", "n": 3}
+    back = decode_payload(json.loads(json.dumps(encode_payload(data))))
+    np.testing.assert_array_equal(back["a"], data["a"])
+    assert back["s"] == "hello" and back["n"] == 3
+
+
+def test_broker_stream_and_hash(broker):
+    from analytics_zoo_tpu.serving.client import _Conn
+
+    c = _Conn("127.0.0.1", broker.port)
+    c.call("XADD", "s1", {"v": 1})
+    c.call("XADD", "s1", {"v": 2})
+    got = c.call("XREADGROUP", "s1", "g1", 10, 100)
+    assert [p["v"] for _, p in got] == [1, 2]
+    # consumer-group semantics: a second read from the same group gets nothing
+    assert c.call("XREADGROUP", "s1", "g1", 10, 10) == []
+    # ... but a different group replays from the start
+    got2 = c.call("XREADGROUP", "s1", "g2", 10, 100)
+    assert len(got2) == 2
+    c.call("HSET", "k", {"x": 5})
+    assert c.call("HGET", "k", 0) == {"x": 5}
+    c.call("HDEL", "k")
+    assert c.call("HGET", "k", 0) is None
+    c.close()
+
+
+def test_serving_end_to_end(zoo_ctx, broker, fitted):
+    model, x = fitted
+    cfg = ServingConfig(batch_size=8, concurrent_num=2,
+                        queue_port=broker.port)
+    job = ClusterServing(model, cfg).start()
+    try:
+        iq = InputQueue(port=broker.port)
+        oq = OutputQueue(port=broker.port)
+        uris = [iq.enqueue(None, input=x[i]) for i in range(20)]
+        want = model.predict(x[:20])
+        for i, uri in enumerate(uris):
+            got = oq.query(uri, timeout_s=30)
+            np.testing.assert_allclose(got, want[i], rtol=1e-4, atol=1e-5)
+        # sink increments `served` just after the HSET a query saw: poll briefly
+        import time
+        t0 = time.time()
+        while job.served < 20 and time.time() - t0 < 5:
+            time.sleep(0.01)
+        assert job.served >= 20
+        iq.close(); oq.close()
+    finally:
+        job.stop()
+
+
+def test_serving_topn(zoo_ctx, broker, fitted):
+    model, x = fitted
+    cfg = ServingConfig(batch_size=4, queue_port=broker.port, top_n=2)
+    job = ClusterServing(model, cfg, group="topn").start()
+    try:
+        iq = InputQueue(port=broker.port)
+        oq = OutputQueue(port=broker.port)
+        uri = iq.enqueue(None, input=x[0])
+        res = oq.query(uri, timeout_s=30)
+        assert res.shape == (2, 2)  # (index, value) pairs
+        probs = model.predict(x[:1])[0]
+        assert int(res[0, 0]) == int(np.argmax(probs))
+        assert res[0, 1] >= res[1, 1]
+        iq.close(); oq.close()
+    finally:
+        job.stop()
+
+
+def test_serving_bad_record_reports_error(zoo_ctx, broker, fitted):
+    model, _ = fitted
+    cfg = ServingConfig(batch_size=4, queue_port=broker.port)
+    job = ClusterServing(model, cfg, group="errs").start()
+    try:
+        iq = InputQueue(port=broker.port)
+        oq = OutputQueue(port=broker.port)
+        uri = iq.enqueue(None, input=np.zeros((3,), np.float32))  # wrong shape
+        with pytest.raises(RuntimeError, match="serving error"):
+            oq.query(uri, timeout_s=30)
+        iq.close(); oq.close()
+    finally:
+        job.stop()
+
+
+def test_dequeue_scan_and_malformed_record(zoo_ctx, broker, fitted):
+    from analytics_zoo_tpu.serving.client import _Conn
+
+    model, x = fitted
+    cfg = ServingConfig(batch_size=4, queue_port=broker.port)
+    job = ClusterServing(model, cfg, group="scan").start()
+    try:
+        iq = InputQueue(port=broker.port)
+        oq = OutputQueue(port=broker.port)
+        # a malformed record must not kill the source loop
+        raw = _Conn("127.0.0.1", broker.port)
+        raw.call("XADD", "serving_stream",
+                 {"uri": "bad1", "data": {"input": {"__ndarray__": "!!notb64"}}})
+        raw.close()
+        good = [iq.enqueue(None, input=x[i]) for i in range(3)]
+        for u in good:
+            oq.register(u)
+        oq.register("bad1")
+        deadline = 30
+        import time
+        got = {}
+        t0 = time.time()
+        while len(got) < 4 and time.time() - t0 < deadline:
+            got.update(oq.dequeue())   # non-blocking scan
+            time.sleep(0.05)
+        assert set(got) == set(good) | {"bad1"}
+        assert isinstance(got["bad1"], dict) and "error" in got["bad1"]
+        want = model.predict(x[:3])
+        for i, u in enumerate(good):
+            np.testing.assert_allclose(got[u], want[i], rtol=1e-4, atol=1e-5)
+        iq.close(); oq.close()
+    finally:
+        job.stop()
+
+
+def test_broker_stream_trimming():
+    from analytics_zoo_tpu.serving.broker import _Store
+
+    st = _Store(maxlen=10)
+    for i in range(25):
+        st.xadd("s", {"v": i})
+    assert st.slen("s") == 10
+    got = st.xreadgroup("s", "g", 100, 0)
+    assert [p["v"] for _, p in got] == list(range(15, 25))
+
+
+def test_http_frontend(zoo_ctx, broker, fitted):
+    model, x = fitted
+    cfg = ServingConfig(batch_size=8, queue_port=broker.port)
+    job = ClusterServing(model, cfg, group="http").start()
+    app = FrontEndApp(cfg, port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{app.port}/predict",
+            data=json.dumps({"instances": [
+                {"input": x[0].tolist()}, {"input": x[1].tolist()}
+            ]}).encode(), headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = json.loads(r.read())
+        preds = np.asarray(body["predictions"])
+        np.testing.assert_allclose(preds, model.predict(x[:2]),
+                                   rtol=1e-4, atol=1e-5)
+        # liveness + metrics
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/", timeout=10) as r:
+            assert "welcome" in json.loads(r.read())["message"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/metrics", timeout=10) as r:
+            assert "http.predict" in json.loads(r.read())
+    finally:
+        app.stop()
+        job.stop()
+
+
+def test_config_yaml_reference_layout(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text("""
+model:
+  path: /models/ncf
+params:
+  batchSize: 64
+  coreNum: 8
+redis:
+  host: 1.2.3.4
+  port: 9999
+postprocessing:
+  topN: 5
+""")
+    cfg = ServingConfig.from_yaml(str(p))
+    assert cfg.model_path == "/models/ncf"
+    assert cfg.batch_size == 64 and cfg.concurrent_num == 8
+    assert cfg.queue_host == "1.2.3.4" and cfg.queue_port == 9999
+    assert cfg.top_n == 5
