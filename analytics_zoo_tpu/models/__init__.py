@@ -1,5 +1,7 @@
 """Built-in model zoo (reference: zoo/.../models/, pyzoo/zoo/models/)."""
 
-from . import common, recommendation
+from . import (anomalydetection, common, recommendation, seq2seq,
+               textclassification, textmatching)
 
-__all__ = ["common", "recommendation"]
+__all__ = ["anomalydetection", "common", "recommendation", "seq2seq",
+           "textclassification", "textmatching"]

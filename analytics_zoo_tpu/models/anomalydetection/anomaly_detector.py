@@ -1,0 +1,129 @@
+"""AnomalyDetector — stacked-LSTM forecaster; anomalies = largest forecast errors.
+
+Parity: /root/reference/pyzoo/zoo/models/anomalydetection/anomaly_detector.py:30-184
+and .../models/anomalydetection/AnomalyDetector.scala — stacked LSTM + dropout →
+Dense(1), with the ``unroll`` / ``detect_anomalies`` / ``train_test_split`` helpers.
+
+The reference's helpers run as RDD jobs; here they are vectorized numpy (host) —
+unrolling a series is a stride trick, not a cluster job.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...nn import layers as L
+from ...nn.topology import Sequential
+from ..common.zoo_model import register_model
+
+
+@register_model("AnomalyDetector")
+class AnomalyDetector(Sequential):
+    """LSTM anomaly detector (anomaly_detector.py:40-75 parity).
+
+    Args:
+        feature_shape: (unroll_length, feature_size).
+        hidden_layers: LSTM widths per layer.
+        dropouts: dropout fraction after each LSTM.
+    """
+
+    def __init__(self, feature_shape: Sequence[int],
+                 hidden_layers: Sequence[int] = (8, 32, 15),
+                 dropouts: Sequence[float] = (0.2, 0.2, 0.2)):
+        assert len(hidden_layers) == len(dropouts), \
+            "sizes of dropouts and hidden_layers should be equal"
+        super().__init__(name="anomaly_detector")
+        self.feature_shape = tuple(int(s) for s in feature_shape)
+        self.hidden_layers = [int(u) for u in hidden_layers]
+        self.dropouts = [float(d) for d in dropouts]
+
+        self.add(L.InputLayer(self.feature_shape))
+        self.add(L.LSTM(self.hidden_layers[0], return_sequences=True,
+                        input_shape=self.feature_shape))
+        for h, d in zip(self.hidden_layers[1:-1], self.dropouts[1:-1]):
+            self.add(L.LSTM(h, return_sequences=True))
+            self.add(L.Dropout(d))
+        self.add(L.LSTM(self.hidden_layers[-1], return_sequences=False))
+        self.add(L.Dropout(self.dropouts[-1]))
+        self.add(L.Dense(1))
+
+    def constructor_config(self) -> dict:
+        return dict(feature_shape=list(self.feature_shape),
+                    hidden_layers=self.hidden_layers, dropouts=self.dropouts)
+
+    def save_model(self, path: str):
+        from ..common.zoo_model import save_model_bundle
+
+        save_model_bundle(path, self, config=self.constructor_config())
+
+    @classmethod
+    def load_model(cls, path: str) -> "AnomalyDetector":
+        from ..common.zoo_model import load_model_bundle
+
+        model, _ = load_model_bundle(path)
+        return model
+
+    # ---- reference static helpers (anomaly_detector.py:105-150) --------------
+    unroll = staticmethod(lambda data, unroll_length, predict_step=1: unroll(
+        data, unroll_length, predict_step))
+    detect_anomalies = staticmethod(lambda y_truth, y_predict, anomaly_size:
+                                    detect_anomalies(y_truth, y_predict, anomaly_size))
+
+    @staticmethod
+    def standard_scale(data: np.ndarray) -> np.ndarray:
+        return standard_scale(data)
+
+    @staticmethod
+    def train_test_split(x: np.ndarray, y: np.ndarray, test_size: int):
+        """Chronological split — LAST ``test_size`` rows become test
+        (anomaly_detector.py:146 parity: cut at count - test_size)."""
+        cut = len(x) - int(test_size)
+        return (x[:cut], y[:cut]), (x[cut:], y[cut:])
+
+
+def unroll(data: np.ndarray, unroll_length: int,
+           predict_step: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+    """Sliding-window unroll of a series into (features, labels)
+    (anomaly_detector.py:105-127 parity: data (1..6), len 2, step 1 →
+    features [[1,2],[2,3],...], labels [3,4,...]).
+
+    Returns ``x: (N, unroll_length, F)`` and ``y: (N,)`` (first feature column is
+    the prediction target, matching the reference example pipelines).
+    """
+    data = np.asarray(data, dtype="float32")
+    if data.ndim == 1:
+        data = data[:, None]
+    n = len(data) - unroll_length - predict_step + 1
+    if n <= 0:
+        raise ValueError("series too short for the requested unroll_length")
+    idx = np.arange(unroll_length)[None, :] + np.arange(n)[:, None]
+    x = data[idx]
+    y = data[np.arange(n) + unroll_length + predict_step - 1, 0]
+    return x, y
+
+
+def standard_scale(data: np.ndarray) -> np.ndarray:
+    """Column-wise standardization (``standardScaleDF`` parity)."""
+    data = np.asarray(data, dtype="float32")
+    mean = data.mean(axis=0, keepdims=True)
+    std = data.std(axis=0, keepdims=True)
+    return (data - mean) / np.where(std == 0, 1.0, std)
+
+
+def detect_anomalies(y_truth: np.ndarray, y_predict: np.ndarray,
+                     anomaly_size: int) -> np.ndarray:
+    """Flag the ``anomaly_size`` points with largest |truth - prediction|
+    (anomaly_detector.py:129-138 / AnomalyDetector.scala detectAnomalies parity).
+
+    Returns an array of (y_truth, y_predict, anomaly) where ``anomaly`` is NaN for
+    normal points and equals ``y_truth`` at anomalies.
+    """
+    y_truth = np.asarray(y_truth, dtype="float32").reshape(-1)
+    y_predict = np.asarray(y_predict, dtype="float32").reshape(-1)
+    err = np.abs(y_truth - y_predict)
+    threshold_idx = np.argsort(-err)[:int(anomaly_size)]
+    anomaly = np.full_like(y_truth, np.nan)
+    anomaly[threshold_idx] = y_truth[threshold_idx]
+    return np.stack([y_truth, y_predict, anomaly], axis=1)

@@ -1,0 +1,47 @@
+"""Ranker — NDCG / MAP evaluation mixin for ranking models.
+
+Parity: /root/reference/pyzoo/zoo/models/common/ranker.py:28-63 (``evaluate_ndcg``,
+``evaluate_map``) and .../models/common/Ranker.scala:81-99. The reference evaluates
+over a TextSet of per-query batches; here each "query group" is one batch of
+(features, labels) and scoring is a single device sweep.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence, Tuple
+
+import numpy as np
+
+from ...nn.metrics import map_at_k, ndcg_at_k
+
+
+class Ranker:
+    """Mixin for models whose ``predict`` scores query/candidate batches."""
+
+    def _group_scores(self, groups: Iterable[Tuple[np.ndarray, np.ndarray]]):
+        for x, labels in groups:
+            scores = np.asarray(self.predict(x)).reshape(-1)
+            yield np.asarray(labels, dtype="float32").reshape(-1), scores
+
+    def evaluate_ndcg(self, groups, k: int, threshold: float = 0.0) -> float:
+        """Mean NDCG@k over query groups (Ranker.scala:99 parity).
+
+        ``groups``: iterable of (features, labels) — one entry per query. Labels
+        ≤ ``threshold`` contribute zero gain; graded labels keep their grade
+        (gain ``2^label``, Ranker.scala:134).
+        """
+        vals = [ndcg_at_k(np.where(labels > threshold, labels, 0.0), scores, k)
+                for labels, scores in self._group_scores(groups)]
+        if not vals:
+            raise ValueError("no query groups to evaluate")
+        return float(np.mean(vals))
+
+    def evaluate_map(self, groups, threshold: float = 0.0) -> float:
+        """Mean average precision over query groups (Ranker.scala:81 parity)."""
+        vals = []
+        for labels, scores in self._group_scores(groups):
+            rel = (labels > threshold).astype("float32")
+            vals.append(map_at_k(rel, scores, len(scores)))
+        if not vals:
+            raise ValueError("no query groups to evaluate")
+        return float(np.mean(vals))

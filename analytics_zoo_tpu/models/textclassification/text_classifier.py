@@ -1,0 +1,91 @@
+"""TextClassifier — word embedding + (CNN | LSTM | GRU) encoder + dense head.
+
+Parity: /root/reference/pyzoo/zoo/models/textclassification/text_classifier.py:29-176
+and .../models/textclassification/TextClassifier.scala — WordEmbedding first layer,
+then Convolution1D+GlobalMaxPooling1D / LSTM / GRU, Dense(128)+Dropout+ReLU,
+softmax head.
+
+The reference *requires* a GloVe ``embedding_file``; here the embedding may also be
+a trainable random table (``vocab_size``/``embed_dim``) so the model is usable
+without a 2GB download — pass ``embedding_file`` for exact reference behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ...nn import layers as L
+from ...nn.topology import Sequential
+from ..common.zoo_model import register_model
+
+
+@register_model("TextClassifier")
+class TextClassifier(Sequential):
+    """Args mirror text_classifier.py:53-73: ``class_num``, ``embedding_file``,
+    ``word_index``, ``sequence_length``, ``encoder``, ``encoder_output_dim``;
+    plus ``vocab_size``/``embed_dim`` for the file-less path."""
+
+    def __init__(self, class_num: int, embedding_file: Optional[str] = None,
+                 word_index: Optional[Dict[str, int]] = None,
+                 sequence_length: int = 500, encoder: str = "cnn",
+                 encoder_output_dim: int = 256, vocab_size: Optional[int] = None,
+                 embed_dim: int = 200, frozen_embedding: Optional[bool] = None):
+        super().__init__(name="text_classifier")
+        self.class_num = int(class_num)
+        self.sequence_length = int(sequence_length)
+        self.encoder = encoder.lower()
+        self.encoder_output_dim = int(encoder_output_dim)
+        self.embed_dim = int(embed_dim)
+
+        if embedding_file is not None:
+            if word_index is None:
+                raise ValueError("word_index is required with embedding_file "
+                                 "(use TextSet.get_word_index())")
+            embedding = L.WordEmbedding.from_glove(embedding_file, word_index,
+                                                   output_dim=embed_dim)
+            self.vocab_size = embedding.input_dim
+            self.frozen_embedding = True
+        else:
+            if vocab_size is None:
+                vocab_size = (max(word_index.values()) + 1) if word_index else 20000
+            self.vocab_size = int(vocab_size)
+            self.frozen_embedding = bool(frozen_embedding)
+            if self.frozen_embedding:
+                # frozen table restored from a saved bundle (load_model path)
+                embedding = L.WordEmbedding(self.vocab_size, embed_dim)
+            else:
+                embedding = L.Embedding(self.vocab_size, embed_dim, init="uniform")
+        embedding.input_shape_hint = (self.sequence_length,)
+
+        self.add(embedding)
+        if self.encoder == "cnn":
+            self.add(L.Convolution1D(self.encoder_output_dim, 5, activation="relu"))
+            self.add(L.GlobalMaxPooling1D())
+        elif self.encoder == "lstm":
+            self.add(L.LSTM(self.encoder_output_dim))
+        elif self.encoder == "gru":
+            self.add(L.GRU(self.encoder_output_dim))
+        else:
+            raise ValueError(f"Unsupported encoder for TextClassifier: {encoder}")
+        self.add(L.Dense(128))
+        self.add(L.Dropout(0.2))
+        self.add(L.Activation("relu"))
+        self.add(L.Dense(self.class_num, activation="softmax"))
+
+    def constructor_config(self) -> dict:
+        return dict(class_num=self.class_num, sequence_length=self.sequence_length,
+                    encoder=self.encoder, encoder_output_dim=self.encoder_output_dim,
+                    vocab_size=self.vocab_size, embed_dim=self.embed_dim,
+                    frozen_embedding=self.frozen_embedding)
+
+    def save_model(self, path: str):
+        from ..common.zoo_model import save_model_bundle
+
+        save_model_bundle(path, self, config=self.constructor_config())
+
+    @classmethod
+    def load_model(cls, path: str) -> "TextClassifier":
+        from ..common.zoo_model import load_model_bundle
+
+        model, _ = load_model_bundle(path)
+        return model

@@ -1,0 +1,4 @@
+from .knrm import KNRM
+from .text_matcher import TextMatcher
+
+__all__ = ["KNRM", "TextMatcher"]

@@ -1,0 +1,116 @@
+"""KNRM — Kernel-pooling Neural Ranking Model (https://arxiv.org/abs/1706.06613).
+
+Parity: /root/reference/pyzoo/zoo/models/textmatching/knrm.py:32-139 and
+.../models/textmatching/KNRM.scala — shared embedding over the concatenated
+(query ++ doc) token sequence, translation matrix Q·Dᵀ, RBF kernel pooling,
+linear (ranking) or sigmoid (classification) head.
+
+TPU-native: the reference loops over kernels building one autograd graph each
+(knrm.py:104-116); here ALL kernels evaluate as one vectorized ``(B,Q,D,K)``
+broadcast that XLA fuses into the batched matmul epilogue — kernel pooling costs
+one HBM pass instead of K.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn import layers as L
+from ...nn.graph import Input
+from ..common.zoo_model import register_model
+from .text_matcher import TextMatcher
+
+
+@register_model("KNRM")
+class KNRM(TextMatcher):
+    """Args mirror knrm.py:67-76: ``text1_length``, ``text2_length``,
+    ``embedding_file``/``word_index`` (or ``vocab_size``/``embed_size`` for the
+    file-less path), ``train_embed``, ``kernel_num``, ``sigma``, ``exact_sigma``,
+    ``target_mode``."""
+
+    def __init__(self, text1_length: int, text2_length: int,
+                 embedding_file: Optional[str] = None,
+                 word_index: Optional[Dict[str, int]] = None,
+                 train_embed: bool = True, kernel_num: int = 21, sigma: float = 0.1,
+                 exact_sigma: float = 0.001, target_mode: str = "ranking",
+                 vocab_size: Optional[int] = None, embed_size: int = 300):
+        assert kernel_num > 1, "kernel_num must be an int larger than 1"
+        if embedding_file is not None:
+            if word_index is None:
+                raise ValueError("word_index is required with embedding_file")
+            # prepare_embedding(randomize_unknown=True, normalize=True) parity
+            # (knrm.py:70-71)
+            from ...nn.layers.embedding import load_glove_table
+
+            table = load_glove_table(embedding_file, word_index, embed_size,
+                                     randomize_unknown=True, normalize=True)
+            vocab_size, embed_size = table.shape
+        else:
+            vocab_size = int(vocab_size or ((max(word_index.values()) + 1)
+                                            if word_index else 20000))
+            table = None
+        self._init_matcher(text1_length, vocab_size, embed_size, table,
+                           train_embed, target_mode)
+        self.text2_length = int(text2_length)
+        self.kernel_num = int(kernel_num)
+        self.sigma = float(sigma)
+        self.exact_sigma = float(exact_sigma)
+
+        # kernel centers: mu_i = 1/(K-1) + 2i/(K-1) - 1, exact-match kernel at 1.0
+        # (knrm.py:105-110)
+        mus, sigmas = [], []
+        for i in range(self.kernel_num):
+            mu = 1.0 / (self.kernel_num - 1) + (2.0 * i) / (self.kernel_num - 1) - 1.0
+            if mu > 1.0:
+                mus.append(1.0)
+                sigmas.append(self.exact_sigma)
+            else:
+                mus.append(mu)
+                sigmas.append(self.sigma)
+        mu_arr = np.asarray(mus, dtype="float32")
+        sigma_arr = np.asarray(sigmas, dtype="float32")
+        t1 = self.text1_length
+
+        def kernel_pooling(embed):
+            # embed: (B, Q+D, E) → Phi: (B, K)   [all kernels in one broadcast]
+            q, d = embed[:, :t1, :], embed[:, t1:, :]
+            mm = jnp.einsum("bqe,bde->bqd", q, d)  # translation matrix
+            diff = mm[..., None] - mu_arr          # (B, Q, D, K)
+            mm_exp = jnp.exp(-0.5 * diff * diff / (sigma_arr * sigma_arr))
+            mm_doc_sum = jnp.sum(mm_exp, axis=2)   # soft-TF per query term
+            mm_log = jnp.log1p(mm_doc_sum)
+            return jnp.sum(mm_log, axis=1)         # (B, K)
+
+        inp = Input((self.text1_length + self.text2_length,), name="input")
+        embedding = L.Embedding(self.vocab_size, self.embed_size,
+                                weights=self.embed_weights,
+                                trainable=self.train_embed, init="uniform")(inp)
+        phi = L.Lambda(kernel_pooling,
+                       output_shape_fn=lambda s: (self.kernel_num,))(embedding)
+        if target_mode == "ranking":
+            out = L.Dense(1, init="uniform")(phi)
+        else:
+            out = L.Dense(1, init="uniform", activation="sigmoid")(phi)
+        super().__init__(inp, out, name="knrm")
+
+    def constructor_config(self) -> dict:
+        return dict(text1_length=self.text1_length, text2_length=self.text2_length,
+                    train_embed=self.train_embed, kernel_num=self.kernel_num,
+                    sigma=self.sigma, exact_sigma=self.exact_sigma,
+                    target_mode=self.target_mode, vocab_size=self.vocab_size,
+                    embed_size=self.embed_size)
+
+    def save_model(self, path: str):
+        from ..common.zoo_model import save_model_bundle
+
+        save_model_bundle(path, self, config=self.constructor_config())
+
+    @classmethod
+    def load_model(cls, path: str) -> "KNRM":
+        from ..common.zoo_model import load_model_bundle
+
+        model, _ = load_model_bundle(path)
+        return model

@@ -1,0 +1,31 @@
+"""TextMatcher base class for text-matching/ranking models.
+
+Parity: /root/reference/pyzoo/zoo/models/textmatching/text_matcher.py:23-40 —
+holds (text1_length, vocab_size, embed_size, embed_weights, train_embed,
+target_mode) and mixes in Ranker evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...nn.topology import Model
+from ..common.ranker import Ranker
+
+
+class TextMatcher(Model, Ranker):
+    """Base for matching models; subclasses build the scoring graph."""
+
+    def _init_matcher(self, text1_length: int, vocab_size: int, embed_size: int = 300,
+                      embed_weights: Optional[np.ndarray] = None,
+                      train_embed: bool = True, target_mode: str = "ranking"):
+        assert target_mode in ("ranking", "classification"), \
+            "target_mode should be either ranking or classification"
+        self.text1_length = int(text1_length)
+        self.vocab_size = int(vocab_size)
+        self.embed_size = int(embed_size)
+        self.embed_weights = embed_weights
+        self.train_embed = bool(train_embed)
+        self.target_mode = target_mode

@@ -74,12 +74,42 @@ class WordEmbedding(Embedding):
     def from_glove(path: str, word_index: dict, output_dim: int = 100):
         """Build a frozen table from a GloVe text file + word index
         (WordEmbedding.scala companion loader parity)."""
-        vocab = max(word_index.values()) + 1
-        table = np.random.RandomState(0).normal(0, 0.05, (vocab, output_dim)).astype("float32")
-        with open(path, "r", encoding="utf-8") as f:
-            for line in f:
-                parts = line.rstrip().split(" ")
-                w, vec = parts[0], parts[1:]
-                if w in word_index and len(vec) == output_dim:
-                    table[word_index[w]] = np.asarray(vec, dtype="float32")
-        return WordEmbedding(vocab, output_dim, weights=table)
+        table = load_glove_table(path, word_index, output_dim)
+        return WordEmbedding(table.shape[0], output_dim, weights=table)
+
+
+def load_glove_table(path: str, word_index: dict, output_dim: int,
+                     randomize_unknown: bool = False,
+                     normalize: bool = False) -> np.ndarray:
+    """Parse a GloVe text file into a ``(vocab, output_dim)`` table.
+
+    Parity: ``prepare_embedding`` (/root/reference/pyzoo/zoo/pipeline/api/keras/
+    layers/embeddings.py usage in knrm.py:70-71) — ``randomize_unknown`` draws
+    unknown rows from U(-0.25, 0.25) instead of N(0, 0.05), ``normalize``
+    L2-normalizes every row. Raises if the file's vector width never matches
+    ``output_dim`` (a silent mismatch would train on an all-random table).
+    """
+    vocab = max(word_index.values()) + 1
+    rng = np.random.RandomState(0)
+    if randomize_unknown:
+        table = rng.uniform(-0.25, 0.25, (vocab, output_dim)).astype("float32")
+        table[0] = 0.0
+    else:
+        table = rng.normal(0, 0.05, (vocab, output_dim)).astype("float32")
+    matched, widths = 0, set()
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip().split(" ")
+            w, vec = parts[0], parts[1:]
+            widths.add(len(vec))
+            if w in word_index and len(vec) == output_dim:
+                table[word_index[w]] = np.asarray(vec, dtype="float32")
+                matched += 1
+    if matched == 0:
+        raise ValueError(
+            f"no embedding in {path} matched output_dim={output_dim} "
+            f"(file vector widths seen: {sorted(widths)}) for the given word_index")
+    if normalize:
+        norms = np.linalg.norm(table, axis=1, keepdims=True)
+        table = table / np.where(norms == 0, 1.0, norms)
+    return table

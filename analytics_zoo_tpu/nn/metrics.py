@@ -191,15 +191,24 @@ class NDCG(Metric):
 
 
 def ndcg_at_k(y_true_relevance, y_score, k: int) -> float:
-    """Listwise NDCG over relevance-labelled candidates (Ranker.evaluateNDCG)."""
+    """Listwise NDCG over relevance-labelled candidates (Ranker.evaluateNDCG).
+
+    Gain is exponential — ``2^rel`` for rel > 0, else 0 — matching the reference
+    (.../models/common/Ranker.scala:132-141: ``pow(2.0, g) / log(2.0 + i)``), so
+    graded labels rank correctly; for binary labels this reduces to the linear form.
+    """
     y_true_relevance = jnp.asarray(y_true_relevance, jnp.float32)
     y_score = jnp.asarray(y_score, jnp.float32)
+
+    def gain(rel):
+        return jnp.where(rel > 0, jnp.exp2(rel), 0.0)
+
     order = jnp.argsort(-y_score, axis=-1)[..., :k]
     rel = jnp.take_along_axis(y_true_relevance, order, axis=-1)
     discounts = 1.0 / jnp.log2(jnp.arange(2, k + 2, dtype=jnp.float32))
-    dcg = jnp.sum(rel * discounts, axis=-1)
+    dcg = jnp.sum(gain(rel) * discounts, axis=-1)
     ideal = jnp.sort(y_true_relevance, axis=-1)[..., ::-1][..., :k]
-    idcg = jnp.sum(ideal * discounts, axis=-1)
+    idcg = jnp.sum(gain(ideal) * discounts, axis=-1)
     return float(jnp.mean(jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-9), 0.0)))
 
 
