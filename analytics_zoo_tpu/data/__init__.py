@@ -1,5 +1,8 @@
 """Data layer: FeatureSet cache tiers, XShards, image/text pipelines."""
 
 from .featureset import FeatureSet, MemoryType, device_prefetch
+from .image import ImageFeature, ImageSet
+from .text import Relation, TextFeature, TextSet
 
-__all__ = ["FeatureSet", "MemoryType", "device_prefetch"]
+__all__ = ["FeatureSet", "ImageFeature", "ImageSet", "MemoryType", "Relation",
+           "TextFeature", "TextSet", "device_prefetch"]

@@ -120,6 +120,7 @@ class KerasNet:
         self._require_compiled()
         from ..data.featureset import FeatureSet
 
+        x, y = _unwrap_dataset(x, y)
         if isinstance(x, FeatureSet):
             data = x
         else:
@@ -130,7 +131,10 @@ class KerasNet:
             if isinstance(validation_data, FeatureSet):
                 val = validation_data
             else:
-                vx, vy = validation_data
+                if _is_dataset(validation_data):
+                    vx, vy = _unwrap_dataset(validation_data, None)
+                else:
+                    vx, vy = validation_data
                 vxs = tuple(vx) if isinstance(vx, (list, tuple)) else vx
                 val = FeatureSet.from_numpy(vxs, vy)
         self.estimator.fit(data, batch_size=batch_size, epochs=nb_epoch,
@@ -143,6 +147,7 @@ class KerasNet:
         self._require_compiled()
         from ..data.featureset import FeatureSet
 
+        x, y = _unwrap_dataset(x, y)
         if isinstance(x, FeatureSet):
             data = x
         else:
@@ -154,6 +159,7 @@ class KerasNet:
 
     def predict(self, x, batch_size: int = 256, distributed: bool = True) -> np.ndarray:
         self._require_compiled()
+        x, _ = _unwrap_dataset(x, None)
         return self.estimator.predict(x, batch_size=batch_size)
 
     def predict_classes(self, x, batch_size: int = 256, zero_based_label=True):
@@ -172,6 +178,22 @@ class KerasNet:
     def parameters(self):
         self._require_compiled()
         return self.estimator.params
+
+
+def _is_dataset(x) -> bool:
+    from ..data.image import ImageSet
+    from ..data.text import TextSet
+
+    return isinstance(x, (TextSet, ImageSet))
+
+
+def _unwrap_dataset(x, y):
+    """Accept TextSet/ImageSet wherever arrays are accepted (the reference's
+    textClassifierFit/imageFit take the Set types directly)."""
+    if _is_dataset(x):
+        xs, ys = x.to_arrays()
+        return xs, (ys if y is None else y)
+    return x, y
 
 
 class Sequential(SequentialModule, KerasNet):

@@ -1,0 +1,232 @@
+"""Text + image pipeline tests.
+
+Mirrors the reference's feature specs (/root/reference/zoo/src/test/.../feature/
+text/ and .../image/): transform-chain semantics, word-index round-trips,
+relation-pair construction, and numeric properties of each image stage.
+"""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data import image as I
+from analytics_zoo_tpu.data.text import (Normalizer, Relation, SequenceShaper,
+                                         TextFeature, TextSet, Tokenizer,
+                                         WordIndexer)
+
+
+# ----------------------------------------------------------------------- text
+
+def _corpus():
+    return TextSet.from_texts(
+        ["Hello world, the cat sat on the mat!",
+         "The dog ate the cat food 42 times.",
+         "hello hello dog"],
+        labels=[0, 1, 0])
+
+
+def test_tokenize_normalize_word2idx_shape():
+    ts = _corpus().tokenize().normalize()
+    assert ts.features[0].get_tokens()[:2] == ["hello", "world"]
+    assert all(t.isalpha() for f in ts.features for t in f.get_tokens())
+
+    ts = ts.word2idx(min_freq=1)
+    vocab = ts.get_word_index()
+    assert min(vocab.values()) == 1  # 1-based, 0 reserved for padding
+    # most frequent word gets index 1 ("the" appears 5x)
+    assert vocab["the"] == 1
+
+    ts = ts.shape_sequence(len=6).generate_sample()
+    xs, ys = ts.to_arrays()
+    assert xs.shape == (3, 6) and ys.tolist() == [0, 1, 0]
+    # short text padded with 0s at the end
+    assert xs[2, 3:].tolist() == [0, 0, 0]
+
+
+def test_word2idx_options():
+    ts = _corpus().tokenize().normalize()
+    out = ts.word2idx(remove_topN=1, max_words_num=3)
+    vocab = out.get_word_index()
+    assert "the" not in vocab  # top-1 removed
+    assert len(vocab) == 3
+
+
+def test_sequence_shaper_trunc_modes():
+    f = TextFeature("x")
+    f["indexedTokens"] = [1, 2, 3, 4, 5]
+    pre = SequenceShaper(3, "pre").transform(f)["indexedTokens"]
+    assert pre == [3, 4, 5]
+    f["indexedTokens"] = [1, 2, 3, 4, 5]
+    post = SequenceShaper(3, "post").transform(f)["indexedTokens"]
+    assert post == [1, 2, 3]
+
+
+def test_word_index_save_load(tmp_path):
+    ts = _corpus().tokenize().normalize().word2idx()
+    p = str(tmp_path / "vocab.txt")
+    ts.save_word_index(p)
+    ts2 = TextSet.from_texts(["the cat"]).load_word_index(p)
+    assert ts2.get_word_index() == ts.get_word_index()
+
+
+def test_random_split():
+    ts = TextSet.from_texts([f"t {i}" for i in range(100)], labels=list(range(100)))
+    a, b = ts.random_split([0.7, 0.3])
+    assert len(a) + len(b) == 100
+    assert abs(len(a) - 70) <= 2
+
+
+def test_read_dir_and_csv(tmp_path):
+    (tmp_path / "sports").mkdir()
+    (tmp_path / "tech").mkdir()
+    (tmp_path / "sports" / "a.txt").write_text("ball game")
+    (tmp_path / "tech" / "b.txt").write_text("chip wafer")
+    ts = TextSet.read(str(tmp_path))
+    assert ts.get_labels() == [0, 1]
+
+    csv = tmp_path / "c.csv"
+    csv.write_text("id1,some text\nid2,other text\n")
+    ts2 = TextSet.read_csv(str(csv))
+    assert ts2.get_uris() == ["id1", "id2"]
+
+
+def test_from_relation_pairs_and_lists():
+    corpus1 = TextSet.from_texts(["query one", "query two"])
+    corpus2 = TextSet.from_texts(["doc a", "doc b", "doc c"])
+    for ts, uris in ((corpus1, ["q1", "q2"]), (corpus2, ["d1", "d2", "d3"])):
+        for f, u in zip(ts.features, uris):
+            f["uri"] = u
+    corpus1 = corpus1.tokenize().word2idx().shape_sequence(3)
+    corpus2 = corpus2.tokenize().word2idx(existing_map=corpus1.get_word_index()) \
+                     .shape_sequence(4)
+    rels = [Relation("q1", "d1", 1), Relation("q1", "d2", 0),
+            Relation("q2", "d3", 1), Relation("q2", "d1", 0)]
+
+    pairs = TextSet.from_relation_pairs(rels, corpus1, corpus2)
+    assert len(pairs) == 2
+    x, y = pairs.features[0].get_sample()
+    assert x.shape == (2, 7) and y.tolist() == [1, 0]  # pos row then neg row
+
+    lists = TextSet.from_relation_lists(rels, corpus1, corpus2)
+    assert len(lists) == 2
+    x, y = lists.features[0].get_sample()
+    assert x.shape == (2, 7) and y.shape == (2, 1)
+
+
+# ---------------------------------------------------------------------- image
+
+def test_resize_crop_flip():
+    img = np.arange(8 * 10 * 3, dtype="float32").reshape(8, 10, 3)
+    s = I.ImageSet.from_arrays(img[None], [7])
+    out = s.transform(I.ImageResize(4, 5) >> I.ImageCenterCrop(2, 2))
+    assert out.get_images()[0].shape == (2, 2, 3)
+
+    flipped = s.transform(I.ImageHFlip()).get_images()[0]
+    np.testing.assert_array_equal(flipped, img[:, ::-1])
+
+
+def test_bilinear_resize_identity_and_values():
+    img = np.ones((4, 4, 3), dtype="float32") * 5
+    out = I._bilinear_resize(img, 8, 8)
+    np.testing.assert_allclose(out, 5.0)
+    assert I._bilinear_resize(img, 4, 4) is img  # no-op shortcut
+
+
+def test_color_stages_deterministic_with_seed():
+    img = np.full((4, 4, 3), 100.0, dtype="float32")
+    s = I.ImageSet.from_arrays(img[None], seed=42)
+    a = s.transform(I.ImageBrightness(-10, 10)).get_images()[0]
+    b = I.ImageSet.from_arrays(img[None], seed=42) \
+        .transform(I.ImageBrightness(-10, 10)).get_images()[0]
+    np.testing.assert_array_equal(a, b)
+    assert not np.allclose(a, img)  # delta applied
+    assert np.ptp(a - img) < 1e-5  # uniform shift
+
+
+def test_channel_normalize_and_order():
+    img = np.dstack([np.full((2, 2), 10.0), np.full((2, 2), 20.0),
+                     np.full((2, 2), 30.0)]).astype("float32")
+    out = I.ImageChannelNormalize(10, 20, 30, 2, 2, 2).apply_image(img, None)
+    np.testing.assert_allclose(out, 0.0)
+    bgr = I.ImageChannelOrder().apply_image(img, None)
+    np.testing.assert_allclose(bgr[..., 0], 30.0)
+
+
+def test_hue_preserves_gray():
+    gray = np.full((3, 3, 3), 128.0, dtype="float32")
+    out = I.ImageHue(30, 30).apply_image(gray, np.random.default_rng(0))
+    np.testing.assert_allclose(out, 128.0, atol=0.5)
+
+
+def test_expand_and_filler():
+    img = np.zeros((4, 4, 3), dtype="float32")
+    rng = np.random.default_rng(0)
+    big = I.ImageExpand(max_expand_ratio=2.0).apply_image(img, rng)
+    assert big.shape[0] >= 4 and big.shape[1] >= 4
+    filled = I.ImageFiller(0, 0, 0.5, 0.5, value=9).apply_image(img, rng)
+    assert filled[0, 0, 0] == 9 and filled[3, 3, 0] == 0
+
+
+def test_random_preprocessing_prob():
+    img = np.arange(12, dtype="float32").reshape(2, 2, 3)
+    s = I.ImageSet.from_arrays(np.stack([img] * 50), seed=3)
+    out = s.transform(I.ImageRandomPreprocessing(I.ImageHFlip(), prob=0.5))
+    flips = sum(not np.allclose(o, img) for o in out.get_images())
+    assert 10 < flips < 40  # ~half flipped
+
+
+def test_mat_to_tensor_and_sample():
+    img = np.zeros((2, 3, 3), dtype="float32")
+    s = I.ImageSet.from_arrays(img[None], [4])
+    chw = s.transform(I.ImageMatToTensor("NCHW")).get_images()[0]
+    assert chw.shape == (3, 2, 3)
+    sampled = s.transform(I.ImageSetToSample())
+    x, y = sampled.features[0]["sample"]
+    assert x.shape == (2, 3, 3) and int(y) == 4
+
+
+def test_imageset_read(tmp_path):
+    from PIL import Image
+
+    (tmp_path / "cats").mkdir()
+    (tmp_path / "dogs").mkdir()
+    Image.fromarray(np.zeros((6, 6, 3), "uint8")).save(tmp_path / "cats" / "a.png")
+    Image.fromarray(np.ones((6, 6, 3), "uint8") * 255).save(tmp_path / "dogs" / "b.png")
+    s = I.ImageSet.read(str(tmp_path), with_label=True)
+    xs, ys = s.to_arrays()
+    assert xs.shape == (2, 6, 6, 3) and ys.tolist() == [0, 1]
+
+
+def test_3d_transforms():
+    vol = np.zeros((6, 6, 6), dtype="float32")
+    vol[2:4, 2:4, 2:4] = 1.0
+    c = I.Crop3D((1, 1, 1), (4, 4, 4)).apply_image(vol, None)
+    assert c.shape == (4, 4, 4)
+    rc = I.RandomCrop3D((3, 3, 3)).apply_image(vol, np.random.default_rng(0))
+    assert rc.shape == (3, 3, 3)
+    # full-turn rotation ≈ identity
+    rot = I.Rotate3D((2 * np.pi, 0, 0)).apply_image(vol, None)
+    np.testing.assert_allclose(rot, vol, atol=1e-4)
+    ident = I.AffineTransform3D(np.eye(3)).apply_image(vol, None)
+    np.testing.assert_allclose(ident, vol, atol=1e-6)
+
+
+# --------------------------------------------- end-to-end: TextSet → model fit
+
+def test_text_classifier_on_textset(zoo_ctx):
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+
+    texts = [f"good great fine nice {i}" for i in range(20)] + \
+            [f"bad awful poor sad {i}" for i in range(20)]
+    ts = TextSet.from_texts(texts, labels=[0] * 20 + [1] * 20)
+    ts = ts.tokenize().normalize().word2idx().shape_sequence(6).generate_sample()
+    train, _ = ts.random_split([0.8, 0.2])
+    vocab = ts.get_word_index()
+    model = TextClassifier(class_num=2, sequence_length=6, encoder="cnn",
+                           encoder_output_dim=8,
+                           vocab_size=max(vocab.values()) + 1, embed_dim=8)
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(train, batch_size=16, nb_epoch=3)
+    res = model.evaluate(ts)
+    assert res["sparse_categorical_accuracy"] > 0.8  # separable vocab
+    assert model.predict(ts).shape == (40, 2)
