@@ -1,0 +1,35 @@
+"""AutoML subsystem — hyperparameter search over time-series (and generic) models.
+
+Capability parity with the reference's ``pyzoo/zoo/automl/`` (SURVEY.md §2.7):
+``TimeSequencePredictor.fit`` (regression/time_sequence_predictor.py:37) drives a
+search engine over trial configs drawn from a ``Recipe`` search space, each trial
+training a ``TimeSequenceModel`` on features produced by
+``TimeSequenceFeatureTransformer`` (feature/time_sequence.py:30), and returns a
+``TimeSequencePipeline`` (pipeline/time_sequence.py:28).
+
+TPU-native redesign: trials are plain Python objects driven by a deterministic
+in-process :class:`SearchEngine` (no Ray) — each trial's train step is a jitted
+XLA program, so trial concurrency is a scheduling detail (threads share the one
+chip) rather than a cluster service. Median-stopping replaces Ray Tune's
+schedulers.
+"""
+
+from .space import Choice, Uniform, LogUniform, RandInt, QUniform, GridSearch, sample_config
+from .metrics import Evaluator
+from .feature import TimeSequenceFeatureTransformer
+from .models import VanillaLSTM, TSSeq2Seq, MTNet, TimeSequenceModel
+from .search import SearchEngine, Trial, TrialResult
+from .recipe import (Recipe, SmokeRecipe, LSTMRandomGridRecipe, MTNetSmokeRecipe,
+                     MTNetRandomGridRecipe, Seq2SeqRandomRecipe, RandomRecipe)
+from .pipeline import TimeSequencePipeline, load_ts_pipeline
+from .predictor import TimeSequencePredictor
+
+__all__ = [
+    "Choice", "Uniform", "LogUniform", "RandInt", "QUniform", "GridSearch",
+    "sample_config", "Evaluator", "TimeSequenceFeatureTransformer",
+    "VanillaLSTM", "TSSeq2Seq", "MTNet", "TimeSequenceModel",
+    "SearchEngine", "Trial", "TrialResult",
+    "Recipe", "SmokeRecipe", "LSTMRandomGridRecipe", "MTNetSmokeRecipe",
+    "MTNetRandomGridRecipe", "Seq2SeqRandomRecipe", "RandomRecipe",
+    "TimeSequencePipeline", "load_ts_pipeline", "TimeSequencePredictor",
+]

@@ -1,0 +1,385 @@
+"""AutoML time-series models — parity with the reference model set
+(``pyzoo/zoo/automl/model/``: VanillaLSTM.py, Seq2Seq.py, MTNet_keras.py,
+time_sequence.py ``TimeSequenceModel``).
+
+All models share the trial-facing protocol the search engine drives:
+``fit_eval(x, y, validation_data, **config) -> val_metric``, ``evaluate``,
+``predict``, ``predict_with_uncertainty`` (MC dropout — the reference's ``mc``
+mode), ``save``/``restore``.
+
+TPU notes: every model compiles to one XLA program via the shared Estimator.
+MTNet folds its ``long_num + 1`` memory blocks into the batch dimension so the
+CNN/GRU encoder runs as one large batched matmul on the MXU instead of a
+per-block Python loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn import layers as L
+from ..nn.module import Layer, get_initializer, param_dtype, split_rng
+from ..nn.topology import Sequential
+from .metrics import Evaluator
+
+
+class BaseTSModel:
+    """Shared trial protocol (reference model/abstract.py BaseModel parity)."""
+
+    default_config: Dict = {}
+
+    def __init__(self, future_seq_len: int = 1):
+        self.future_seq_len = int(future_seq_len)
+        self.model: Optional[Sequential] = None
+        self.config: Dict = {}
+
+    # -- subclass hook ---------------------------------------------------------
+    def _build(self, input_shape: Tuple[int, int], config: Dict) -> Sequential:
+        raise NotImplementedError
+
+    # -- trial protocol --------------------------------------------------------
+    def build(self, input_shape: Tuple[int, int], **config):
+        cfg = dict(self.default_config)
+        cfg.update(config)
+        self.config = cfg
+        self.config["input_shape"] = [int(s) for s in input_shape]
+        self.model = self._build(tuple(input_shape), cfg)
+        self.model.compile(optimizer=self._optimizer(cfg), loss="mse")
+        return self
+
+    def _optimizer(self, cfg):
+        from ..nn.optimizers import Adam
+
+        return Adam(lr=float(cfg.get("lr", 1e-3)))
+
+    def fit_eval(self, x: np.ndarray, y: np.ndarray, validation_data=None,
+                 metric: str = "mse", epochs: Optional[int] = None,
+                 **config) -> float:
+        """Train for ``config['epochs']`` and return the validation metric
+        (model/VanillaLSTM.py fit_eval parity: validation defaults to train tail)."""
+        if y.ndim == 1:
+            y = y[:, None]
+        if self.model is None:
+            self.build((x.shape[1], x.shape[2]), **config)
+        cfg = self.config
+        n_epochs = int(epochs if epochs is not None else cfg.get("epochs", 1))
+        batch_size = int(cfg.get("batch_size", 32))
+        batch_size = max(1, min(batch_size, len(x)))
+        self.model.fit(x, y, batch_size=batch_size, nb_epoch=n_epochs)
+        vx, vy = (x, y) if validation_data is None else validation_data
+        if vy.ndim == 1:
+            vy = vy[:, None]
+        return Evaluator.evaluate(metric, vy, self.predict(vx))
+
+    def evaluate(self, x, y, metrics: List[str] = ("mse",)) -> List[float]:
+        y = np.asarray(y)
+        if y.ndim == 1:
+            y = y[:, None]
+        pred = self.predict(x)
+        return [Evaluator.evaluate(m, y, pred) for m in metrics]
+
+    def predict(self, x) -> np.ndarray:
+        if self.model is None:
+            raise RuntimeError("model not built; call fit_eval or restore first")
+        return np.asarray(self.model.predict(x))
+
+    def predict_with_uncertainty(self, x, n_iter: int = 20):
+        """MC-dropout predictive mean + epistemic std (reference ``mc=True``)."""
+        est = self.model.estimator
+        if est.train_state is None:
+            raise RuntimeError("model not trained")
+        params = est.train_state["params"]
+        mstate = est.train_state["model_state"]
+        xj = jnp.asarray(x)
+
+        @jax.jit
+        def mc_pass(rng):
+            y, _ = self.model.apply(params, mstate, xj, training=True, rng=rng)
+            return y
+
+        keys = jax.random.split(jax.random.PRNGKey(0), n_iter)
+        preds = np.stack([np.asarray(mc_pass(k)) for k in keys])
+        return preds.mean(axis=0), preds.std(axis=0)
+
+    # -- persistence -----------------------------------------------------------
+    def save(self, model_path: str, config_path: Optional[str] = None):
+        from ..models.common.zoo_model import save_weights
+
+        os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+        est = self.model.estimator
+        save_weights(model_path, self.model, est.train_state["params"],
+                     est.train_state["model_state"])
+        cfg = {k: v for k, v in self.config.items()}
+        cfg["future_seq_len"] = self.future_seq_len
+        with open(config_path or model_path + ".config.json", "w") as f:
+            json.dump(cfg, f)
+
+    def restore(self, model_path: str, config_path: Optional[str] = None, **config):
+        from ..models.common.zoo_model import load_weights
+
+        with open(config_path or model_path + ".config.json") as f:
+            cfg = json.load(f)
+        cfg.update(config)
+        self.future_seq_len = int(cfg.pop("future_seq_len", self.future_seq_len))
+        in_shape = tuple(cfg.pop("input_shape"))
+        self.build(in_shape, **cfg)
+        est = self.model.estimator
+        dummy = (np.zeros((1,) + in_shape, dtype="float32"),
+                 np.zeros((1, self.future_seq_len), dtype="float32"))
+        est.train_state = est._init_state(dummy)
+        cur = jax.device_get({"p": est.train_state["params"],
+                              "s": est.train_state["model_state"]})
+        params, mstate = load_weights(model_path, self.model, cur["p"], cur["s"])
+        est.train_state["params"] = jax.device_put(params)
+        est.train_state["model_state"] = jax.device_put(mstate)
+        return self
+
+
+class VanillaLSTM(BaseTSModel):
+    """Two stacked LSTMs + dropout + Dense head (model/VanillaLSTM.py parity;
+    config keys lstm_1_units/dropout_1/lstm_2_units/dropout_2/lr/batch_size)."""
+
+    default_config = dict(lstm_1_units=32, dropout_1=0.2, lstm_2_units=32,
+                          dropout_2=0.2, lr=1e-3, batch_size=64, epochs=1)
+
+    def _build(self, input_shape, cfg):
+        m = Sequential(name="vanilla_lstm")
+        m.add(L.InputLayer(input_shape))
+        m.add(L.LSTM(int(cfg["lstm_1_units"]), return_sequences=True))
+        m.add(L.Dropout(float(cfg["dropout_1"])))
+        m.add(L.LSTM(int(cfg["lstm_2_units"]), return_sequences=False))
+        m.add(L.Dropout(float(cfg["dropout_2"])))
+        m.add(L.Dense(self.future_seq_len))
+        return m
+
+
+class TSSeq2Seq(BaseTSModel):
+    """Encoder/decoder LSTM forecaster (model/Seq2Seq.py parity): the encoder
+    consumes the past window; the decoder is unrolled ``future_seq_len`` steps
+    feeding back its own output (inference-mode decoding — avoids the reference's
+    separate teacher-forcing graph while matching its predict behavior)."""
+
+    default_config = dict(latent_dim=64, dropout=0.2, lr=1e-3, batch_size=64,
+                          epochs=1)
+
+    def _build(self, input_shape, cfg):
+        m = Sequential(name="ts_seq2seq")
+        m.add(L.InputLayer(input_shape))
+        m.add(_Seq2SeqCore(int(cfg["latent_dim"]), self.future_seq_len,
+                           float(cfg["dropout"])))
+        return m
+
+
+class _Seq2SeqCore(Layer):
+    def __init__(self, latent_dim: int, future_seq_len: int, dropout: float,
+                 name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.latent = latent_dim
+        self.future = future_seq_len
+        self.dropout = dropout
+        self.encoder = L.LSTM(latent_dim, return_sequences=False)
+        self.head = L.Dense(1)
+
+    def build(self, rng, input_shape):
+        k_enc, k_dec, k_head = jax.random.split(rng, 3)
+        enc_p, _ = self.encoder.build(k_enc, input_shape)
+        # decoder LSTM cell params: input is the previous scalar prediction
+        self.decoder = L.LSTM(self.latent, return_sequences=False)
+        dec_p, _ = self.decoder.build(k_dec, (self.future, 1))
+        head_p, _ = self.head.build(k_head, (self.latent,))
+        return {"enc": enc_p, "dec": dec_p, "head": head_p}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        k_enc, k_drop = split_rng(rng, 2)
+        h_seq, _ = self.encoder.apply(params["enc"], {}, x, training=training,
+                                      rng=k_enc)
+        batch = x.shape[0]
+        h = h_seq
+        c = jnp.zeros_like(h)
+        if training and self.dropout > 0 and k_drop is not None:
+            keep = 1.0 - self.dropout
+            h = h * jax.random.bernoulli(k_drop, keep, h.shape) / keep
+
+        dec = self.decoder
+        y0 = jnp.zeros((batch, 1), h.dtype)
+
+        def step(carry, _):
+            h_t, c_t, y_prev = carry
+            (h2, c2), _out = dec.step(params["dec"], (h_t, c_t), y_prev)
+            y, _ = self.head.apply(params["head"], {}, h2)
+            return (h2, c2, y), y
+
+        (_, _, _), ys = jax.lax.scan(step, (h, c, y0), None, length=self.future)
+        return jnp.swapaxes(ys[..., 0], 0, 1), state  # (B, future)
+
+    def compute_output_shape(self, input_shape):
+        return (self.future,)
+
+
+class MTNet(BaseTSModel):
+    """Memory Time-series Network (model/MTNet_keras.py capability parity).
+
+    Input ``(B, (long_num + 1) * time_step, F)``: ``long_num`` long-term memory
+    blocks plus the short-term block. Encoder = Conv(time, cnn_height) + dropout +
+    GRU. Attention over encoded memories selects context; concat with the query
+    encoding feeds the head; an autoregressive linear term on the last
+    ``ar_window`` target values is added (the Lin/AR component).
+    """
+
+    default_config = dict(time_step=4, long_num=3, cnn_height=2, cnn_hid_size=16,
+                          rnn_hid_size=16, ar_window=2, cnn_dropout=0.2,
+                          rnn_dropout=0.2, lr=1e-3, batch_size=64, epochs=1)
+
+    def _build(self, input_shape, cfg):
+        m = Sequential(name="mtnet")
+        m.add(L.InputLayer(input_shape))
+        m.add(_MTNetCore(time_step=int(cfg["time_step"]),
+                         long_num=int(cfg["long_num"]),
+                         cnn_height=int(cfg["cnn_height"]),
+                         cnn_hid=int(cfg["cnn_hid_size"]),
+                         rnn_hid=int(cfg["rnn_hid_size"]),
+                         ar_window=int(cfg["ar_window"]),
+                         cnn_dropout=float(cfg["cnn_dropout"]),
+                         rnn_dropout=float(cfg["rnn_dropout"]),
+                         future=self.future_seq_len))
+        return m
+
+
+class _MTNetCore(Layer):
+    def __init__(self, *, time_step, long_num, cnn_height, cnn_hid, rnn_hid,
+                 ar_window, cnn_dropout, rnn_dropout, future, name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.time_step = time_step
+        self.long_num = long_num
+        self.cnn_height = min(cnn_height, time_step)
+        self.cnn_hid = cnn_hid
+        self.rnn_hid = rnn_hid
+        self.ar_window = ar_window
+        self.cnn_dropout = cnn_dropout
+        self.rnn_dropout = rnn_dropout
+        self.future = future
+        self.gru = L.GRU(rnn_hid, return_sequences=False)
+
+    def build(self, rng, input_shape):
+        total_t, feat = input_shape
+        need = (self.long_num + 1) * self.time_step
+        if total_t < need:
+            raise ValueError(
+                f"MTNet needs past_seq_len >= (long_num+1)*time_step = {need}, "
+                f"got {total_t}")
+        k_conv, k_gru, k_att, k_head, k_ar = jax.random.split(rng, 5)
+        dt = param_dtype()
+        init = get_initializer("glorot_uniform")
+        conv_k = init(k_conv, (self.cnn_height, feat, self.cnn_hid), dt)
+        gru_p, _ = self.gru.build(
+            k_gru, (self.time_step - self.cnn_height + 1, self.cnn_hid))
+        att_w = init(k_att, (self.rnn_hid, self.rnn_hid), dt)
+        head_w = init(k_head, (2 * self.rnn_hid, self.future), dt)
+        head_b = jnp.zeros((self.future,), dt)
+        ar_w = init(k_ar, (self.ar_window, self.future), dt)
+        return {"conv": conv_k, "gru": gru_p, "att": att_w,
+                "head_w": head_w, "head_b": head_b, "ar": ar_w}, {}
+
+    def _encode(self, params, blocks, training, rng):
+        """blocks: (N, time_step, F) -> (N, rnn_hid). One batched conv+GRU."""
+        k_drop, k_gru = split_rng(rng, 2)
+        # valid 1D conv over time: (N, T, F) x (H, F, C) -> (N, T-H+1, C)
+        z = jax.lax.conv_general_dilated(
+            blocks, params["conv"], window_strides=(1,), padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        z = jax.nn.relu(z)
+        if training and self.cnn_dropout > 0 and k_drop is not None:
+            keep = 1.0 - self.cnn_dropout
+            z = z * jax.random.bernoulli(k_drop, keep, z.shape) / keep
+        h, _ = self.gru.apply(params["gru"], {}, z, training=training, rng=k_gru)
+        return h
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        k_mem, k_q, k_drop = split_rng(rng, 3)
+        B = x.shape[0]
+        need = (self.long_num + 1) * self.time_step
+        x = x[:, -need:, :]
+        blocks = x.reshape(B, self.long_num + 1, self.time_step, x.shape[-1])
+        mem_blocks = blocks[:, :-1].reshape(B * self.long_num, self.time_step, -1)
+        q_block = blocks[:, -1]
+
+        mem = self._encode(params, mem_blocks, training, k_mem)
+        mem = mem.reshape(B, self.long_num, self.rnn_hid)
+        u = self._encode(params, q_block, training, k_q)
+
+        # attention over memories: score_i = m_i^T W u
+        scores = jnp.einsum("bnh,hk,bk->bn", mem, params["att"], u)
+        alpha = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(u.dtype)
+        ctx = jnp.einsum("bn,bnh->bh", alpha, mem)
+
+        feat = jnp.concatenate([u, ctx], axis=-1)
+        if training and self.rnn_dropout > 0 and k_drop is not None:
+            keep = 1.0 - self.rnn_dropout
+            feat = feat * jax.random.bernoulli(k_drop, keep, feat.shape) / keep
+        y = feat @ params["head_w"] + params["head_b"]
+        # AR component on the raw target column (col 0) of the last ar_window steps
+        ar = jnp.einsum("bw,wf->bf", x[:, -self.ar_window:, 0], params["ar"])
+        return y + ar, state
+
+    def compute_output_shape(self, input_shape):
+        return (self.future,)
+
+
+MODEL_REGISTRY = {"LSTM": VanillaLSTM, "Seq2Seq": TSSeq2Seq, "MTNet": MTNet}
+
+
+class TimeSequenceModel:
+    """Dispatches to LSTM vs Seq2Seq vs MTNet from the trial config's ``model``
+    key (reference model/time_sequence.py TimeSequenceModel parity; the default
+    choice is LSTM for future_seq_len == 1 else Seq2Seq —
+    time_sequence_predictor.py:83 docstring)."""
+
+    def __init__(self, future_seq_len: int = 1):
+        self.future_seq_len = int(future_seq_len)
+        self.inner: Optional[BaseTSModel] = None
+        self.model_name: Optional[str] = None
+
+    def _select(self, config) -> str:
+        if "model" in config:
+            return config["model"]
+        return "LSTM" if self.future_seq_len == 1 else "Seq2Seq"
+
+    def fit_eval(self, x, y, validation_data=None, metric="mse", **config):
+        name = self._select(config)
+        if self.inner is None or name != self.model_name:
+            self.model_name = name
+            self.inner = MODEL_REGISTRY[name](future_seq_len=self.future_seq_len)
+        cfg = {k: v for k, v in config.items() if k != "model"}
+        return self.inner.fit_eval(x, y, validation_data=validation_data,
+                                   metric=metric, **cfg)
+
+    def evaluate(self, x, y, metrics=("mse",)):
+        return self.inner.evaluate(x, y, metrics)
+
+    def predict(self, x):
+        return self.inner.predict(x)
+
+    def predict_with_uncertainty(self, x, n_iter: int = 20):
+        return self.inner.predict_with_uncertainty(x, n_iter)
+
+    def save(self, model_path, config_path=None):
+        self.inner.config["model"] = self.model_name
+        self.inner.save(model_path, config_path)
+
+    def restore(self, model_path, config_path=None, **config):
+        with open(config_path or model_path + ".config.json") as f:
+            saved = json.load(f)
+        saved.update(config)
+        name = saved.pop("model", self._select(saved))
+        self.model_name = name
+        self.inner = MODEL_REGISTRY[name](
+            future_seq_len=saved.get("future_seq_len", self.future_seq_len))
+        self.inner.restore(model_path, config_path)
+        return self

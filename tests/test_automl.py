@@ -1,0 +1,219 @@
+"""AutoML subsystem tests (SURVEY.md §2.7 parity: search engine, recipes,
+feature transformer, TS models, predictor→pipeline round trip)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.automl import (
+    Choice, Evaluator, GridSearch, LSTMRandomGridRecipe, MTNet,
+    MTNetSmokeRecipe, RandomRecipe, SearchEngine, SmokeRecipe, TSSeq2Seq,
+    TimeSequenceFeatureTransformer, TimeSequencePredictor, Uniform,
+    VanillaLSTM, load_ts_pipeline, sample_config)
+from analytics_zoo_tpu.automl.space import grid_product
+
+
+def make_df(n=200, freq_hours=1):
+    import pandas as pd
+    dt = pd.date_range("2020-01-01", periods=n, freq=f"{freq_hours}h")
+    rng = np.random.default_rng(0)
+    value = np.sin(np.arange(n) / 10.0) + 0.1 * rng.standard_normal(n)
+    return pd.DataFrame({"datetime": dt, "value": value})
+
+
+# ------------------------------------------------------------------ space
+def test_sample_config_deterministic():
+    space = {"a": Choice([1, 2, 3]), "b": Uniform(0, 1), "c": "fixed"}
+    c1 = sample_config(space, np.random.default_rng(7))
+    c2 = sample_config(space, np.random.default_rng(7))
+    assert c1 == c2 and c1["c"] == "fixed" and c1["a"] in (1, 2, 3)
+
+
+def test_grid_product_expansion():
+    space = {"u": GridSearch([16, 32]), "v": GridSearch(["x", "y"]), "w": 1}
+    combos = grid_product(space)
+    assert len(combos) == 4
+    assert {"u": 16, "v": "x"} in combos
+
+
+# ------------------------------------------------------------------ metrics
+def test_evaluator_metrics():
+    y = np.array([1.0, 2.0, 3.0])
+    p = np.array([1.0, 2.0, 4.0])
+    assert Evaluator.evaluate("mse", y, p) == pytest.approx(1 / 3)
+    assert Evaluator.evaluate("mae", y, p) == pytest.approx(1 / 3)
+    assert Evaluator.evaluate("r_square", y, y) == pytest.approx(1.0, abs=1e-6)
+    assert Evaluator.reward("mse", 2.0) == -2.0
+    assert Evaluator.reward("r2", 0.5) == 0.5
+    with pytest.raises(ValueError):
+        Evaluator.check_metric("nope")
+
+
+# ------------------------------------------------------------------ features
+def test_feature_transformer_shapes_and_unscale():
+    df = make_df(50)
+    ft = TimeSequenceFeatureTransformer(future_seq_len=2)
+    feats = ft.get_feature_list(df)
+    x, y = ft.fit_transform(df, past_seq_len=5,
+                            selected_features=json.dumps(feats))
+    assert x.shape == (50 - 5 - 2 + 1, 5, 1 + len(feats))
+    assert y.shape == (x.shape[0], 2)
+    # unscale inverts the target scaling
+    back = ft.unscale(y)
+    total = 5 + 2
+    expect = df["value"].to_numpy()[np.arange(y.shape[0])[:, None]
+                                    + 5 + np.arange(2)[None, :]]
+    np.testing.assert_allclose(back, expect, atol=1e-8)
+
+
+def test_feature_transformer_save_restore(tmp_path):
+    df = make_df(30)
+    ft = TimeSequenceFeatureTransformer(future_seq_len=1)
+    x, y = ft.fit_transform(df, past_seq_len=4)
+    p = str(tmp_path / "ft.json")
+    ft.save(p)
+    ft2 = TimeSequenceFeatureTransformer().restore(p)
+    x2, y2 = ft2.transform(df, is_train=True)
+    np.testing.assert_allclose(x, x2)
+    np.testing.assert_allclose(y, y2)
+
+
+def test_feature_transformer_predict_mode():
+    df = make_df(30)
+    ft = TimeSequenceFeatureTransformer(future_seq_len=1)
+    ft.fit_transform(df, past_seq_len=4)
+    x, y = ft.transform(df, is_train=False)
+    assert y is None and x.shape[0] == 30 - 4 + 1
+    out = ft.post_processing(df, np.zeros((x.shape[0], 1)), is_train=False)
+    assert len(out) == x.shape[0] and "value" in out.columns
+    # forecast timestamp = last window datetime + one period (not the window end)
+    import pandas as pd
+    assert out["datetime"].iloc[0] == pd.Timestamp("2020-01-01") + pd.Timedelta(hours=4)
+    assert out["datetime"].iloc[-1] == pd.Timestamp("2020-01-01") + pd.Timedelta(hours=30)
+
+
+# ------------------------------------------------------------------ models
+def test_vanilla_lstm_fit_predict(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 4, 3)).astype("float32")
+    y = x[:, -1, :1]
+    m = VanillaLSTM(future_seq_len=1)
+    val = m.fit_eval(x, y, lstm_1_units=8, lstm_2_units=8, epochs=2,
+                     batch_size=32)
+    assert np.isfinite(val)
+    pred = m.predict(x)
+    assert pred.shape == (64, 1)
+    mean, std = m.predict_with_uncertainty(x, n_iter=3)
+    assert mean.shape == (64, 1) and std.shape == (64, 1)
+    # save/restore round trip
+    mp = str(tmp_path / "m")
+    m.save(mp)
+    m2 = VanillaLSTM().restore(mp)
+    np.testing.assert_allclose(pred, m2.predict(x), atol=1e-5)
+
+
+def test_seq2seq_multistep():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 6, 2)).astype("float32")
+    y = rng.standard_normal((32, 3)).astype("float32")
+    m = TSSeq2Seq(future_seq_len=3)
+    m.fit_eval(x, y, latent_dim=8, epochs=1, batch_size=16)
+    assert m.predict(x).shape == (32, 3)
+
+
+def test_mtnet_shapes():
+    rng = np.random.default_rng(0)
+    # (long_num+1)*time_step = 4*3 = 12
+    x = rng.standard_normal((16, 12, 2)).astype("float32")
+    y = rng.standard_normal((16, 1)).astype("float32")
+    m = MTNet(future_seq_len=1)
+    val = m.fit_eval(x, y, time_step=3, long_num=3, cnn_height=2,
+                     cnn_hid_size=8, rnn_hid_size=8, ar_window=2, epochs=1,
+                     batch_size=8)
+    assert np.isfinite(val)
+    assert m.predict(x).shape == (16, 1)
+
+
+def test_mtnet_rejects_short_window():
+    m = MTNet(future_seq_len=1)
+    x = np.zeros((4, 5, 2), dtype="float32")
+    y = np.zeros((4, 1), dtype="float32")
+    with pytest.raises(ValueError):
+        m.fit_eval(x, y, time_step=3, long_num=3, epochs=1)
+
+
+# ------------------------------------------------------------------ search
+def test_search_engine_picks_best_and_median_stops():
+    calls = {}
+
+    def trainable(config, trial_seed=0):
+        quality = config["q"]
+
+        def round_fn():
+            calls[quality] = calls.get(quality, 0) + 1
+            return 1.0 / quality  # mse-like: larger q => better
+
+        return round_fn
+
+    eng = SearchEngine(trainable, metric="mse", num_samples=1,
+                       training_iteration=4, grace_rounds=1, seed=0)
+    best = eng.run({"q": GridSearch([1, 2, 3, 4])})
+    assert best.config["q"] == 4
+    assert best.metric == pytest.approx(0.25)
+    # the worst trial should have been median-stopped before 4 rounds
+    assert any(r.stopped_early for r in eng.results)
+
+
+def test_search_engine_survives_failing_trial():
+    def trainable(config, trial_seed=0):
+        if config["q"] == 2:
+            raise RuntimeError("boom")
+        return lambda: float(config["q"])
+
+    eng = SearchEngine(trainable, metric="mse", training_iteration=1)
+    best = eng.run({"q": GridSearch([1, 2, 3])})
+    assert best.config["q"] == 1  # smallest mse among survivors
+    assert sum(1 for r in eng.results if r.error) == 1
+
+
+def test_search_engine_all_fail():
+    def trainable(config, trial_seed=0):
+        raise RuntimeError("nope")
+
+    eng = SearchEngine(trainable, metric="mse")
+    with pytest.raises(RuntimeError, match="all .* trials failed"):
+        eng.run({"q": 1})
+
+
+# ------------------------------------------------------------------ recipes
+def test_recipes_produce_valid_spaces():
+    feats = ["HOUR", "IS_WEEKEND"]
+    for recipe in (SmokeRecipe(), LSTMRandomGridRecipe(), MTNetSmokeRecipe(),
+                   RandomRecipe()):
+        space = recipe.search_space(feats)
+        rng = np.random.default_rng(0)
+        for grid_part in grid_product(space)[:2]:
+            cfg = sample_config(space, rng, fixed=grid_part)
+            assert "model" in cfg
+            sel = json.loads(cfg["selected_features"])
+            assert isinstance(sel, list)
+
+
+# ------------------------------------------------------------------ end to end
+def test_time_sequence_predictor_end_to_end(tmp_path):
+    df = make_df(60)
+    tsp = TimeSequencePredictor(future_seq_len=1)
+    pipeline = tsp.fit(df, metric="mse", recipe=SmokeRecipe())
+    ev = pipeline.evaluate(df, metrics=["mse", "smape"])
+    assert len(ev) == 2 and all(np.isfinite(v) for v in ev)
+    out = tsp.predict(df)
+    assert "value" in out.columns and len(out) > 0
+    # save / load round trip
+    pdir = str(tmp_path / "pipe")
+    pipeline.save(pdir)
+    loaded = load_ts_pipeline(pdir)
+    out2 = loaded.predict(df)
+    np.testing.assert_allclose(out["value"].to_numpy(),
+                               out2["value"].to_numpy(), atol=1e-5)
