@@ -121,23 +121,13 @@ class BaseTSModel:
             json.dump(cfg, f)
 
     def restore(self, model_path: str, config_path: Optional[str] = None, **config):
-        from ..models.common.zoo_model import load_weights
-
         with open(config_path or model_path + ".config.json") as f:
             cfg = json.load(f)
         cfg.update(config)
         self.future_seq_len = int(cfg.pop("future_seq_len", self.future_seq_len))
         in_shape = tuple(cfg.pop("input_shape"))
-        self.build(in_shape, **cfg)
-        est = self.model.estimator
-        dummy = (np.zeros((1,) + in_shape, dtype="float32"),
-                 np.zeros((1, self.future_seq_len), dtype="float32"))
-        est.train_state = est._init_state(dummy)
-        cur = jax.device_get({"p": est.train_state["params"],
-                              "s": est.train_state["model_state"]})
-        params, mstate = load_weights(model_path, self.model, cur["p"], cur["s"])
-        est.train_state["params"] = jax.device_put(params)
-        est.train_state["model_state"] = jax.device_put(mstate)
+        self.build(in_shape, **cfg)          # compiles a fresh estimator
+        self.model.load_weights(model_path)  # single restore path (topology.py)
         return self
 
 
@@ -237,13 +227,14 @@ class MTNet(BaseTSModel):
                           rnn_dropout=0.2, lr=1e-3, batch_size=64, epochs=1)
 
     def _build(self, input_shape, cfg):
+        rnn_sizes = cfg.get("rnn_hid_sizes") or [int(cfg["rnn_hid_size"])]
         m = Sequential(name="mtnet")
         m.add(L.InputLayer(input_shape))
         m.add(_MTNetCore(time_step=int(cfg["time_step"]),
                          long_num=int(cfg["long_num"]),
                          cnn_height=int(cfg["cnn_height"]),
                          cnn_hid=int(cfg["cnn_hid_size"]),
-                         rnn_hid=int(cfg["rnn_hid_size"]),
+                         rnn_hids=[int(s) for s in rnn_sizes],
                          ar_window=int(cfg["ar_window"]),
                          cnn_dropout=float(cfg["cnn_dropout"]),
                          rnn_dropout=float(cfg["rnn_dropout"]),
@@ -252,7 +243,7 @@ class MTNet(BaseTSModel):
 
 
 class _MTNetCore(Layer):
-    def __init__(self, *, time_step, long_num, cnn_height, cnn_hid, rnn_hid,
+    def __init__(self, *, time_step, long_num, cnn_height, cnn_hid, rnn_hids,
                  ar_window, cnn_dropout, rnn_dropout, future, name=None,
                  input_shape=None):
         super().__init__(name=name, input_shape=input_shape)
@@ -260,12 +251,14 @@ class _MTNetCore(Layer):
         self.long_num = long_num
         self.cnn_height = min(cnn_height, time_step)
         self.cnn_hid = cnn_hid
-        self.rnn_hid = rnn_hid
+        self.rnn_hids = list(rnn_hids)
+        self.rnn_hid = self.rnn_hids[-1]
         self.ar_window = ar_window
         self.cnn_dropout = cnn_dropout
         self.rnn_dropout = rnn_dropout
         self.future = future
-        self.gru = L.GRU(rnn_hid, return_sequences=False)
+        self.grus = [L.GRU(h, return_sequences=(i < len(self.rnn_hids) - 1))
+                     for i, h in enumerate(self.rnn_hids)]
 
     def build(self, rng, input_shape):
         total_t, feat = input_shape
@@ -274,31 +267,39 @@ class _MTNetCore(Layer):
             raise ValueError(
                 f"MTNet needs past_seq_len >= (long_num+1)*time_step = {need}, "
                 f"got {total_t}")
-        k_conv, k_gru, k_att, k_head, k_ar = jax.random.split(rng, 5)
+        keys = jax.random.split(rng, 4 + len(self.grus))
+        k_conv, k_att, k_head, k_ar = keys[:4]
         dt = param_dtype()
         init = get_initializer("glorot_uniform")
         conv_k = init(k_conv, (self.cnn_height, feat, self.cnn_hid), dt)
-        gru_p, _ = self.gru.build(
-            k_gru, (self.time_step - self.cnn_height + 1, self.cnn_hid))
+        gru_ps = []
+        t_len = self.time_step - self.cnn_height + 1
+        in_dim = self.cnn_hid
+        for gru, k in zip(self.grus, keys[4:]):
+            p, _ = gru.build(k, (t_len, in_dim))
+            gru_ps.append(p)
+            in_dim = gru.output_dim
         att_w = init(k_att, (self.rnn_hid, self.rnn_hid), dt)
         head_w = init(k_head, (2 * self.rnn_hid, self.future), dt)
         head_b = jnp.zeros((self.future,), dt)
         ar_w = init(k_ar, (self.ar_window, self.future), dt)
-        return {"conv": conv_k, "gru": gru_p, "att": att_w,
+        return {"conv": conv_k, "grus": gru_ps, "att": att_w,
                 "head_w": head_w, "head_b": head_b, "ar": ar_w}, {}
 
     def _encode(self, params, blocks, training, rng):
-        """blocks: (N, time_step, F) -> (N, rnn_hid). One batched conv+GRU."""
-        k_drop, k_gru = split_rng(rng, 2)
+        """blocks: (N, time_step, F) -> (N, rnn_hid). One batched conv+GRU stack."""
+        ks = split_rng(rng, 1 + len(self.grus))
         # valid 1D conv over time: (N, T, F) x (H, F, C) -> (N, T-H+1, C)
         z = jax.lax.conv_general_dilated(
             blocks, params["conv"], window_strides=(1,), padding="VALID",
             dimension_numbers=("NWC", "WIO", "NWC"))
         z = jax.nn.relu(z)
-        if training and self.cnn_dropout > 0 and k_drop is not None:
+        if training and self.cnn_dropout > 0 and ks[0] is not None:
             keep = 1.0 - self.cnn_dropout
-            z = z * jax.random.bernoulli(k_drop, keep, z.shape) / keep
-        h, _ = self.gru.apply(params["gru"], {}, z, training=training, rng=k_gru)
+            z = z * jax.random.bernoulli(ks[0], keep, z.shape) / keep
+        h = z
+        for gru, p, k in zip(self.grus, params["grus"], ks[1:]):
+            h, _ = gru.apply(p, {}, h, training=training, rng=k)
         return h
 
     def apply(self, params, state, x, *, training=False, rng=None):

@@ -11,7 +11,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional
 
-from .space import Choice, GridSearch, QUniform, RandInt, Sampler, Uniform
+from .space import Choice, GridSearch, Sampler, Uniform
 
 
 class Recipe:

@@ -21,7 +21,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from .metrics import Evaluator
-from .space import GridSearch, grid_product, sample_config
+from .space import grid_product, sample_config
 
 log = logging.getLogger("analytics_zoo_tpu.automl")
 

@@ -1,0 +1,18 @@
+"""Zouwu — the user-facing time-series toolkit (reference ``pyzoo/zoo/zouwu/``).
+
+Two entry styles, matching the reference:
+* AutoML-driven: :class:`~analytics_zoo_tpu.zouwu.autots.forecast.AutoTSTrainer`
+  → :class:`TSPipeline` (zouwu/autots/forecast.py:22,81).
+* Standalone forecasters: ``LSTMForecaster`` / ``MTNetForecaster`` /
+  ``Seq2SeqForecaster`` / ``TCMFForecaster`` (zouwu/model/forecast.py) and
+  anomaly detectors (zouwu/model/anomaly.py).
+"""
+
+from .autots.forecast import AutoTSTrainer, TSPipeline
+from .model.forecast import (Forecaster, LSTMForecaster, MTNetForecaster,
+                             Seq2SeqForecaster, TCMFForecaster)
+from .model.anomaly import ThresholdEstimator, ThresholdDetector, AEDetector
+
+__all__ = ["AutoTSTrainer", "TSPipeline", "Forecaster", "LSTMForecaster",
+           "MTNetForecaster", "Seq2SeqForecaster", "TCMFForecaster",
+           "ThresholdEstimator", "ThresholdDetector", "AEDetector"]

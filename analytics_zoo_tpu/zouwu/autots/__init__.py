@@ -1,0 +1,3 @@
+from .forecast import AutoTSTrainer, TSPipeline
+
+__all__ = ["AutoTSTrainer", "TSPipeline"]

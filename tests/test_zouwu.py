@@ -1,0 +1,134 @@
+"""Zouwu time-series toolkit tests (SURVEY.md §2.7 zouwu parity)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.zouwu import (AEDetector, AutoTSTrainer, LSTMForecaster,
+                                     MTNetForecaster, Seq2SeqForecaster,
+                                     TCMFForecaster, ThresholdDetector,
+                                     ThresholdEstimator, TSPipeline)
+from analytics_zoo_tpu.automl.recipe import SmokeRecipe
+
+
+def make_df(n=80):
+    import pandas as pd
+    dt = pd.date_range("2020-01-01", periods=n, freq="1h")
+    value = np.sin(np.arange(n) / 6.0)
+    return pd.DataFrame({"datetime": dt, "value": value})
+
+
+def test_autots_trainer_end_to_end(tmp_path):
+    df = make_df(60)
+    trainer = AutoTSTrainer(horizon=1)
+    ppl = trainer.fit(df, metric="mse", recipe=SmokeRecipe())
+    pred = ppl.predict(df)
+    assert "value" in pred.columns
+    ev = ppl.evaluate(df, metrics=["mse"])
+    assert np.isfinite(ev[0])
+    p = str(tmp_path / "ts")
+    ppl.save(p)
+    loaded = TSPipeline.load(p)
+    pred2 = loaded.predict(df)
+    np.testing.assert_allclose(pred["value"].to_numpy(),
+                               pred2["value"].to_numpy(), atol=1e-5)
+    # incremental fit through the zouwu wrapper
+    loaded.fit(df, epochs=1)
+
+
+def test_lstm_forecaster():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((48, 5, 2)).astype("float32")
+    y = x[:, -1, :1]
+    f = LSTMForecaster(target_dim=1, lstm_1_units=8, lstm_2_units=8)
+    f.fit(x, y, epochs=2, batch_size=16)
+    assert f.predict(x).shape == (48, 1)
+    mse = f.evaluate(x, y, metrics=["mse"])[0]
+    assert np.isfinite(mse)
+
+
+def test_mtnet_forecaster_stacked_rnn():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 8, 2)).astype("float32")  # (1+1)*4 = 8
+    y = rng.standard_normal((16, 1)).astype("float32")
+    f = MTNetForecaster(target_dim=1, long_series_num=1, series_length=4,
+                        ar_window_size=2, cnn_height=2, cnn_hid_size=8,
+                        rnn_hid_sizes=[8, 16])
+    f.fit(x, y, epochs=1, batch_size=8)
+    assert f.predict(x).shape == (16, 1)
+
+
+def test_seq2seq_forecaster_horizon():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((24, 6, 1)).astype("float32")
+    y = rng.standard_normal((24, 4)).astype("float32")
+    f = Seq2SeqForecaster(horizon=4, latent_dim=8)
+    f.fit(x, y, epochs=1, batch_size=8)
+    assert f.predict(x).shape == (24, 4)
+
+
+def test_tcmf_forecaster_recovers_low_rank():
+    rng = np.random.default_rng(0)
+    n, T, k = 12, 60, 3
+    F = rng.standard_normal((n, k))
+    t = np.arange(T + 8)
+    basis = np.stack([np.sin(t / 5), np.cos(t / 7), 0.01 * t])
+    Y_full = F @ basis
+    f = TCMFForecaster(rank=4, max_iter=400, ar_lags=6)
+    loss = f.fit(Y_full[:, :T])
+    assert loss < 0.05
+    pred = f.predict(horizon=8)
+    assert pred.shape == (n, 8)
+    mae = f.evaluate(Y_full[:, T:], metric=["mae"])[0]
+    # forecast should beat a naive flat-last-value baseline
+    naive = np.abs(Y_full[:, T:] - Y_full[:, T - 1:T]).mean()
+    assert mae < naive
+
+def test_tcmf_dict_input_and_incremental():
+    rng = np.random.default_rng(1)
+    y = rng.standard_normal((5, 30)).astype("float32")
+    f = TCMFForecaster(rank=2, max_iter=50)
+    f.fit({"id": np.arange(5), "y": y})
+    l2 = f.fit(y, incremental=True)
+    assert np.isfinite(l2)
+    # incremental with a LONGER series (new data arrived) must not crash
+    y_longer = np.concatenate([y, rng.standard_normal((5, 10)).astype("float32")], axis=1)
+    l3 = f.fit(y_longer, incremental=True)
+    assert np.isfinite(l3) and f.X.shape[1] == 40
+
+
+# ------------------------------------------------------------------ anomaly
+def test_threshold_estimator_and_detector():
+    rng = np.random.default_rng(0)
+    y = rng.standard_normal((100, 3))
+    yhat = y + 0.01 * rng.standard_normal((100, 3))
+    y[7] += 10.0  # inject anomaly
+    est = ThresholdEstimator()
+    th = est.fit(y, yhat, ratio=0.01)
+    found = ThresholdDetector().detect(y, yhat, threshold=th)
+    assert 7 in found and len(found) <= 3
+
+
+def test_threshold_detector_modes():
+    y = np.array([[0.0, 0.0], [1.0, 1.0], [5.0, 5.0]])
+    yhat = np.zeros_like(y)
+    d = ThresholdDetector()
+    assert d.detect(y, yhat, threshold=3.0) == [3 - 1]           # scalar
+    per_sample = np.array([10.0, 0.5, 10.0])
+    assert d.detect(y, yhat, threshold=per_sample) == [1]        # per-sample
+    assert d.detect(y, yhat, threshold=np.float32(3.0)) == [2]   # numpy scalar
+    per_dim = np.full_like(y, 2.0)
+    assert d.detect(y, yhat, threshold=per_dim) == [2]           # per-dim
+    lo, hi = np.full_like(y, -1.0), np.full_like(y, 2.0)
+    assert d.detect(y, threshold=(lo, hi)) == [2]                # range
+    with pytest.raises(ValueError):
+        d.detect(y, yhat=None, threshold=1.0)
+
+
+def test_ae_detector():
+    rng = np.random.default_rng(0)
+    y = rng.standard_normal((128, 6)).astype("float32") * 0.1
+    y[5] += 8.0
+    det = AEDetector(latent_dim=2, hidden=8, epochs=5, ratio=0.02)
+    det.fit(y)
+    found = det.detect(y)
+    assert 5 in found
