@@ -60,16 +60,20 @@ class BaseTSModel:
 
     def fit_eval(self, x: np.ndarray, y: np.ndarray, validation_data=None,
                  metric: str = "mse", epochs: Optional[int] = None,
-                 **config) -> float:
-        """Train for ``config['epochs']`` and return the validation metric
-        (model/VanillaLSTM.py fit_eval parity: validation defaults to train tail)."""
+                 batch_size: Optional[int] = None, **config) -> float:
+        """Train and return the validation metric (model/VanillaLSTM.py fit_eval
+        parity: validation defaults to the train set). ``epochs``/``batch_size``
+        are runtime knobs honored on EVERY call; structural hyperparameters in
+        ``config`` only take effect at first build."""
         if y.ndim == 1:
             y = y[:, None]
         if self.model is None:
             self.build((x.shape[1], x.shape[2]), **config)
         cfg = self.config
-        n_epochs = int(epochs if epochs is not None else cfg.get("epochs", 1))
-        batch_size = int(cfg.get("batch_size", 32))
+        n_epochs = int(epochs if epochs is not None else
+                       config.get("epochs", cfg.get("epochs", 1)))
+        batch_size = int(batch_size if batch_size is not None else
+                         config.get("batch_size", cfg.get("batch_size", 32)))
         batch_size = max(1, min(batch_size, len(x)))
         self.model.fit(x, y, batch_size=batch_size, nb_epoch=n_epochs)
         vx, vy = (x, y) if validation_data is None else validation_data
@@ -93,7 +97,9 @@ class BaseTSModel:
         """MC-dropout predictive mean + epistemic std (reference ``mc=True``)."""
         est = self.model.estimator
         if est.train_state is None:
-            raise RuntimeError("model not trained")
+            # restored-but-never-stepped model: materialize state through the
+            # standard lazy-init path (picks up est.initial_weights)
+            self.predict(np.asarray(x)[:1])
         params = est.train_state["params"]
         mstate = est.train_state["model_state"]
         xj = jnp.asarray(x)

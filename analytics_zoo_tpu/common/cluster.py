@@ -1,0 +1,164 @@
+"""Multi-host job bootstrap & worker lifecycle — RayOnSpark capability parity.
+
+The reference bootstraps a Ray cluster inside Spark executors
+(/root/reference/pyzoo/zoo/ray/raycontext.py:51-187: partition 0 starts the head,
+others join after a barrier) and guards against leaked worker processes
+(``JVMGuard.register_pids`` :30-48, ``ProcessMonitor`` ray/process.py).
+
+TPU-native redesign: a pod job is N identical host processes running
+``jax.distributed.initialize`` against a coordinator (no data-plane role for the
+launcher). This module provides:
+
+* :class:`ClusterLauncher` — spawn the N per-host worker processes locally
+  (single-machine simulation of a pod, or per-host agent on real machines),
+  with env injection (coordinator address, process id).
+* :class:`ProcessMonitor` — track children, detect failures, kill-on-exit
+  (the JVMGuard role, minus the JVM).
+* :func:`barrier` — a host-level sync over the jax.distributed client, used by
+  fault-recovery tests.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+@dataclass
+class WorkerProc:
+    rank: int
+    proc: subprocess.Popen
+    cmd: List[str]
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def returncode(self) -> Optional[int]:
+        return self.proc.poll()
+
+
+class ProcessMonitor:
+    """Tracks spawned workers; kills the whole group on exit or on first failure
+    (JVMGuard parity — no orphaned raylets/workers)."""
+
+    def __init__(self):
+        self.workers: List[WorkerProc] = []
+        self._registered = False
+        self._lock = threading.Lock()
+
+    def register(self, worker: WorkerProc):
+        with self._lock:
+            self.workers.append(worker)
+            if not self._registered:
+                atexit.register(self.kill_all)
+                self._registered = True
+
+    def poll(self) -> Dict[int, Optional[int]]:
+        return {w.rank: w.returncode() for w in self.workers}
+
+    def failed(self) -> List[WorkerProc]:
+        return [w for w in self.workers if w.returncode() not in (None, 0)]
+
+    def all_done(self) -> bool:
+        return all(not w.alive() for w in self.workers)
+
+    def kill_all(self, sig=signal.SIGTERM, grace_s: float = 3.0):
+        with self._lock:
+            for w in self.workers:
+                if w.alive():
+                    try:
+                        w.proc.send_signal(sig)
+                    except ProcessLookupError:
+                        pass
+            deadline = time.time() + grace_s
+            for w in self.workers:
+                while w.alive() and time.time() < deadline:
+                    time.sleep(0.05)
+                if w.alive():
+                    try:
+                        w.proc.kill()
+                    except ProcessLookupError:
+                        pass
+
+    def wait(self, timeout_s: Optional[float] = None,
+             on_failure: str = "kill") -> Dict[int, Optional[int]]:
+        """Block until all workers exit, a worker fails, or timeout.
+
+        ``on_failure='kill'``: first non-zero exit tears down the rest (fail-fast
+        — one lost host kills a pod job's collectives anyway, SURVEY.md §5.3).
+        """
+        deadline = None if timeout_s is None else time.time() + timeout_s
+        while True:
+            bad = self.failed()
+            if bad:
+                if on_failure == "kill":
+                    self.kill_all()
+                return self.poll()
+            if self.all_done():
+                return self.poll()
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(f"workers still running: "
+                                   f"{[w.rank for w in self.workers if w.alive()]}")
+            time.sleep(0.1)
+
+
+class ClusterLauncher:
+    """Spawn ``num_processes`` copies of a worker script, each with the env a
+    multi-host JAX job needs (coordinator address, process id/count).
+
+    Single-machine pods use distinct ``CUDA/TPU``-free CPU processes; on real
+    clusters run one launcher per host with ``process_id`` preassigned.
+    """
+
+    def __init__(self, num_processes: int, coordinator_port: int = 7877,
+                 env_extra: Optional[Dict[str, str]] = None,
+                 python: Optional[str] = None):
+        self.num_processes = int(num_processes)
+        self.coordinator = f"127.0.0.1:{coordinator_port}"
+        self.env_extra = dict(env_extra or {})
+        self.python = python or sys.executable
+        self.monitor = ProcessMonitor()
+
+    def worker_env(self, rank: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self.env_extra)
+        env.update({
+            "ZOO_TPU_COORDINATOR": self.coordinator,
+            "ZOO_TPU_NUM_PROCESSES": str(self.num_processes),
+            "ZOO_TPU_PROCESS_ID": str(rank),
+        })
+        return env
+
+    def launch(self, script: str, args: Sequence[str] = ()) -> ProcessMonitor:
+        for rank in range(self.num_processes):
+            cmd = [self.python, script, *map(str, args)]
+            proc = subprocess.Popen(cmd, env=self.worker_env(rank),
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT)
+            self.monitor.register(WorkerProc(rank=rank, proc=proc, cmd=cmd))
+        return self.monitor
+
+def barrier(name: str = "zoo_barrier", timeout_s: float = 120.0):
+    """Host-level barrier across the jax.distributed job (BarrierTaskContext
+    parity, raycontext.py:155-187). No-op single-process."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    # a tiny global psum forces a cross-host collective = barrier
+    import jax.numpy as jnp
+
+    jax.block_until_ready(
+        jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+            jnp.ones((jax.local_device_count(),))))

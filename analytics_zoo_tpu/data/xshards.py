@@ -17,6 +17,12 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 
+def _split_df(df, num_partitions: int) -> List[Any]:
+    """Row-split a DataFrame into partitions with clean local indices."""
+    idx = np.array_split(np.arange(len(df)), num_partitions)
+    return [df.iloc[i].reset_index(drop=True) for i in idx]
+
+
 class XShards:
     """A list of partitions, each an arbitrary python object (dict of ndarrays,
     pandas DataFrame, ...)."""
@@ -33,6 +39,8 @@ class XShards:
             n = len(data[keys[0]])
             splits = np.array_split(np.arange(n), num_partitions)
             return cls([{k: np.asarray(data[k])[idx] for k in keys} for idx in splits])
+        if hasattr(data, "iloc"):  # pandas DataFrame/Series: keep columns
+            return cls(_split_df(data, num_partitions))
         arr = np.asarray(data)
         return cls([np.ascontiguousarray(p) for p in np.array_split(arr, num_partitions)])
 
@@ -44,8 +52,7 @@ class XShards:
         files = sorted(_glob.glob(path)) if any(c in path for c in "*?[") else [path]
         frames = [pd.read_csv(f, **kw) for f in files]
         df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
-        idx = np.array_split(np.arange(len(df)), num_partitions)
-        return cls([df.iloc[i].reset_index(drop=True) for i in idx])
+        return cls(_split_df(df, num_partitions))
 
     @classmethod
     def read_json(cls, path: str, num_partitions: int = 4, **kw) -> "XShards":
@@ -54,16 +61,14 @@ class XShards:
         files = sorted(_glob.glob(path)) if any(c in path for c in "*?[") else [path]
         frames = [pd.read_json(f, **kw) for f in files]
         df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
-        idx = np.array_split(np.arange(len(df)), num_partitions)
-        return cls([df.iloc[i].reset_index(drop=True) for i in idx])
+        return cls(_split_df(df, num_partitions))
 
     @classmethod
     def read_parquet(cls, path: str, num_partitions: int = 4, **kw) -> "XShards":
         import pandas as pd
 
         df = pd.read_parquet(path, **kw)
-        idx = np.array_split(np.arange(len(df)), num_partitions)
-        return cls([df.iloc[i].reset_index(drop=True) for i in idx])
+        return cls(_split_df(df, num_partitions))
 
     # ------------------------------------------------------------------ ops
     def transform_shard(self, fn: Callable, *args) -> "XShards":

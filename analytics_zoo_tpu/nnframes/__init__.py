@@ -1,0 +1,22 @@
+"""NNFrames — DataFrame-native ML pipeline integration (SURVEY.md §2.5).
+
+Reference parity: ``zoo/.../nnframes/NNEstimator.scala:198`` (Spark-ML
+``Estimator``/``Transformer`` pair) and the python mirror
+``pyzoo/zoo/pipeline/nnframes/nn_classifier.py``:
+``NNEstimator(model, criterion).setBatchSize(..).setMaxEpoch(..).fit(df)`` →
+``NNModel.transform(df)`` appends a prediction column; ``NNClassifier`` /
+``NNClassifierModel`` for class labels; ``NNImageReader.readImages`` loads a
+directory of images into a DataFrame.
+
+TPU-native redesign: the "DataFrame" is pandas/pyarrow on the host — rows are
+marshalled once into contiguous numpy arrays (no per-row Sample objects, no
+py4j), then the shared Estimator drives the jitted train step. Spark's
+distribution role is covered by the data layer's sharding (per-host splits of
+the array batch dimension).
+"""
+
+from .nn_estimator import NNEstimator, NNModel, NNClassifier, NNClassifierModel
+from .nn_image_reader import NNImageReader
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "NNImageReader"]

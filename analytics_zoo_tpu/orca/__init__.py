@@ -1,0 +1,7 @@
+"""Orca — unified high-level Estimator + sharded data (reference
+``pyzoo/zoo/orca/``: orca/learn estimators over XShards, SURVEY.md §2.7)."""
+
+from ..data.xshards import XShards
+from .learn.estimator import Estimator
+
+__all__ = ["Estimator", "XShards"]

@@ -1,0 +1,3 @@
+from .estimator import Estimator
+
+__all__ = ["Estimator"]

@@ -132,18 +132,17 @@ class AEDetector(DetectorBase):
         m.fit(flat, flat, batch_size=min(self.batch_size, len(flat)),
               nb_epoch=self.epochs)
         self.model = m
-        recon = np.asarray(m.predict(flat))
-        err = np.linalg.norm(flat - recon, axis=1)
+        err = self.score(y)
         self.threshold_ = float(np.percentile(err, (1 - self.ratio) * 100))
         return self
 
     def score(self, y: np.ndarray) -> np.ndarray:
+        if self.model is None:
+            raise RuntimeError("AEDetector not fitted")
         flat = np.asarray(y, dtype=np.float32).reshape(len(y), -1)
         recon = np.asarray(self.model.predict(flat))
         return np.linalg.norm(flat - recon, axis=1)
 
     def detect(self, y, threshold: Optional[float] = None) -> List[int]:
-        if self.model is None:
-            raise RuntimeError("AEDetector not fitted")
         t = self.threshold_ if threshold is None else threshold
         return list(np.nonzero(self.score(y) >= t)[0])

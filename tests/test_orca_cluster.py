@@ -1,0 +1,120 @@
+"""Orca estimator + cluster launcher tests (SURVEY.md §2.2 RayOnSpark parity,
+§2.7 orca learn)."""
+
+import os
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.cluster import ClusterLauncher, ProcessMonitor
+from analytics_zoo_tpu.data.xshards import XShards
+from analytics_zoo_tpu.nn import layers as L
+from analytics_zoo_tpu.nn.topology import Sequential
+from analytics_zoo_tpu.orca import Estimator
+
+
+def mlp(in_dim=3, out_dim=1):
+    m = Sequential()
+    m.add(L.InputLayer((in_dim,)))
+    m.add(L.Dense(8, activation="relu"))
+    m.add(L.Dense(out_dim))
+    return m
+
+
+def test_orca_estimator_numpy_and_dict():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 3)).astype("float32")
+    y = x.sum(axis=1, keepdims=True)
+    est = Estimator.from_keras(mlp(), loss="mse", optimizer="adam")
+    est.fit({"x": x, "y": y}, epochs=3, batch_size=16)
+    ev = est.evaluate((x, y), metrics=["mse"])
+    assert np.isfinite(list(ev.values())[0])
+    pred = est.predict(x)
+    assert pred.shape == (64, 1)
+
+
+def test_orca_estimator_xshards_dataframe():
+    import pandas as pd
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({"f1": rng.standard_normal(80),
+                       "f2": rng.standard_normal(80)})
+    df["y"] = df["f1"] - df["f2"]
+    shards = XShards.partition(df, num_partitions=4)
+    est = Estimator.from_keras(mlp(2), loss="mse")
+    est.fit(shards, epochs=5, batch_size=16,
+            feature_cols=["f1", "f2"], label_cols=["y"])
+    out = est.predict(shards, feature_cols=["f1", "f2"])
+    assert isinstance(out, XShards) and out.num_partitions() == 4
+    total = sum(len(p) for p in out.collect())
+    assert total == 80
+
+
+def test_orca_estimator_save_load(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 3)).astype("float32")
+    y = x[:, :1]
+    est = Estimator.from_keras(mlp(), loss="mse")
+    est.fit((x, y), epochs=1)
+    p = str(tmp_path / "m")
+    est.save(p)
+    pred = est.predict(x)
+    est2 = Estimator.from_keras(mlp(), loss="mse")
+    est2.fit((x, y), epochs=0)  # compile + init without training steps
+    est2.load(p)
+    np.testing.assert_allclose(pred, est2.predict(x), atol=1e-5)
+
+
+# ------------------------------------------------------------------ cluster
+WORKER_OK = textwrap.dedent("""
+    import os, sys, time
+    rank = int(os.environ["ZOO_TPU_PROCESS_ID"])
+    n = int(os.environ["ZOO_TPU_NUM_PROCESSES"])
+    assert os.environ["ZOO_TPU_COORDINATOR"].startswith("127.0.0.1:")
+    print(f"worker {rank}/{n} ok", flush=True)
+""")
+
+WORKER_FAIL_RANK1 = textwrap.dedent("""
+    import os, sys, time
+    rank = int(os.environ["ZOO_TPU_PROCESS_ID"])
+    if rank == 1:
+        sys.exit(3)
+    time.sleep(30)  # would hang forever; fail-fast must kill us
+""")
+
+
+def test_cluster_launcher_all_ok(tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text(WORKER_OK)
+    launcher = ClusterLauncher(num_processes=3)
+    mon = launcher.launch(str(script))
+    codes = mon.wait(timeout_s=30)
+    assert codes == {0: 0, 1: 0, 2: 0}
+
+
+def test_cluster_launcher_fail_fast(tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text(WORKER_FAIL_RANK1)
+    launcher = ClusterLauncher(num_processes=3)
+    mon = launcher.launch(str(script))
+    t0 = time.time()
+    codes = mon.wait(timeout_s=60, on_failure="kill")
+    elapsed = time.time() - t0
+    assert codes[1] == 3
+    assert elapsed < 20, "fail-fast should not wait for the sleepers"
+    assert mon.all_done(), "surviving workers must be torn down"
+
+
+def test_process_monitor_kill_all(tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text("import time; time.sleep(60)")
+    launcher = ClusterLauncher(num_processes=2)
+    mon = launcher.launch(str(script))
+    assert not mon.all_done()
+    mon.kill_all()
+    deadline = time.time() + 10
+    while not mon.all_done() and time.time() < deadline:
+        time.sleep(0.1)
+    assert mon.all_done()

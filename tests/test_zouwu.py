@@ -31,6 +31,9 @@ def test_autots_trainer_end_to_end(tmp_path):
     pred2 = loaded.predict(df)
     np.testing.assert_allclose(pred["value"].to_numpy(),
                                pred2["value"].to_numpy(), atol=1e-5)
+    # uncertainty on a freshly-restored pipeline (regression: lazy state init)
+    mean_df, unc = loaded.predict_with_uncertainty(df, n_iter=2)
+    assert np.isfinite(unc).all()
     # incremental fit through the zouwu wrapper
     loaded.fit(df, epochs=1)
 
