@@ -108,8 +108,10 @@ class ProcessMonitor:
             if self.all_done():
                 return self.poll()
             if deadline is not None and time.time() > deadline:
-                raise TimeoutError(f"workers still running: "
-                                   f"{[w.rank for w in self.workers if w.alive()]}")
+                still = [w.rank for w in self.workers if w.alive()]
+                if on_failure == "kill":
+                    self.kill_all()  # no-orphans guarantee holds on timeout too
+                raise TimeoutError(f"workers still running: {still}")
             time.sleep(0.1)
 
 
@@ -140,12 +142,22 @@ class ClusterLauncher:
         })
         return env
 
-    def launch(self, script: str, args: Sequence[str] = ()) -> ProcessMonitor:
+    def launch(self, script: str, args: Sequence[str] = (),
+               log_dir: Optional[str] = None) -> ProcessMonitor:
+        """Workers log to ``log_dir/worker-<rank>.log`` (default: a tempdir) —
+        never a PIPE, which nobody drains and which would deadlock any worker
+        producing more than the OS pipe buffer."""
+        import tempfile
+
+        log_dir = log_dir or tempfile.mkdtemp(prefix="zoo_cluster_")
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_dir = log_dir
         for rank in range(self.num_processes):
             cmd = [self.python, script, *map(str, args)]
-            proc = subprocess.Popen(cmd, env=self.worker_env(rank),
-                                    stdout=subprocess.PIPE,
-                                    stderr=subprocess.STDOUT)
+            log_path = os.path.join(log_dir, f"worker-{rank}.log")
+            with open(log_path, "wb") as logf:
+                proc = subprocess.Popen(cmd, env=self.worker_env(rank),
+                                        stdout=logf, stderr=subprocess.STDOUT)
             self.monitor.register(WorkerProc(rank=rank, proc=proc, cmd=cmd))
         return self.monitor
 

@@ -1,0 +1,13 @@
+"""Image model zoo — classification backbones + SSD object detection
+(reference ``zoo/.../models/image/``: imageclassification/, objectdetection/,
+SURVEY.md §2.8)."""
+
+from .backbones import BACKBONES, build_backbone
+from .classification import ImageClassifier, ImagenetConfig
+from .objectdetection import (MeanAveragePrecision, ObjectDetector, SSDModel,
+                              decode_predictions, generate_anchors, multibox_loss,
+                              nms)
+
+__all__ = ["BACKBONES", "build_backbone", "ImageClassifier", "ImagenetConfig",
+           "MeanAveragePrecision", "ObjectDetector", "SSDModel",
+           "decode_predictions", "generate_anchors", "multibox_loss", "nms"]

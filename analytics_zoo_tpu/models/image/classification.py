@@ -1,0 +1,116 @@
+"""ImageClassifier — config-driven image classification
+(reference ``models/image/imageclassification/ImageClassifier.scala`` +
+``ImageClassificationConfig.scala``: named backbone + dataset preprocessing +
+label map, ``predictImageSet`` returning top-k classes).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...data.image import (ImageCenterCrop, ImageChannelNormalize, ImageResize,
+                           ImageSet)
+from ..common.zoo_model import save_model_bundle
+from .backbones import build_backbone
+
+
+class ImagenetConfig:
+    """Per-dataset preprocessing recipe (ImagenetConfig parity: resize 256 →
+    center-crop 224 → channel-mean normalize). ``resize`` defaults to the
+    standard 256/224 ratio of the crop size."""
+
+    MEANS = (123.68, 116.779, 103.939)
+
+    @staticmethod
+    def preprocessing(crop_h: int = 224, crop_w: int = 224,
+                      resize: Optional[int] = None):
+        if resize is None:
+            resize = max(crop_h, crop_w) * 256 // 224
+        return (ImageResize(resize, resize)
+                >> ImageCenterCrop(crop_h, crop_w)
+                >> ImageChannelNormalize(*ImagenetConfig.MEANS))
+
+
+class ImageClassifier:
+    """Named-backbone classifier with ImageSet predict
+    (ImageClassifier.scala ``predictImageSet``/``setTopN`` capability)."""
+
+    def __init__(self, model_name: str = "resnet-50",
+                 input_shape: Tuple[int, int, int] = (224, 224, 3),
+                 num_classes: int = 1000,
+                 label_map: Optional[Sequence[str]] = None,
+                 model=None):
+        self.model_name = model_name
+        self.input_shape = tuple(input_shape)
+        self.num_classes = int(num_classes)
+        self.label_map = list(label_map) if label_map is not None else None
+        self.model = model if model is not None else build_backbone(
+            model_name, self.input_shape, self.num_classes)
+        self.top_n = 5
+
+    def set_top_n(self, n: int) -> "ImageClassifier":
+        self.top_n = int(n)
+        return self
+
+    def compile(self, optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=("accuracy",), **kw):
+        self.model.compile(optimizer=optimizer, loss=loss,
+                           metrics=list(metrics), **kw)
+        return self
+
+    def fit(self, x, y=None, **kw):
+        self.model.fit(x, y, **kw)
+        return self
+
+    def fit_image_set(self, image_set: ImageSet, labels=None, **kw):
+        """Train with the SAME preprocessing chain predict_image_set applies —
+        use this (not raw-array fit) when predicting via predict_image_set."""
+        x = self._preprocess_set(image_set)
+        y = np.asarray(labels if labels is not None
+                       else image_set.get_labels(), dtype="int32")
+        self.model.fit(x, y, **kw)
+        return self
+
+    # ------------------------------------------------------------- prediction
+    def _preprocess_set(self, image_set: ImageSet) -> np.ndarray:
+        h, w, _ = self.input_shape
+        processed = image_set.transform(ImagenetConfig.preprocessing(h, w))
+        return np.stack([f.get_image().astype("float32")
+                         for f in processed.features])
+
+    def predict_image_set(self, image_set: ImageSet, batch_size: int = 32):
+        """Returns per-image list of (class_index_or_label, probability) top-n."""
+        x = self._preprocess_set(image_set)
+        probs = np.asarray(self.model.predict(x, batch_size=batch_size))
+        order = np.argsort(-probs, axis=1)[:, :self.top_n]
+        results = []
+        for row, idx in zip(probs, order):
+            labels = [self.label_map[i] if self.label_map else int(i) for i in idx]
+            results.append(list(zip(labels, row[idx].tolist())))
+        return results
+
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        return np.asarray(self.model.predict(np.asarray(x), batch_size=batch_size))
+
+    # ------------------------------------------------------------ persistence
+    def save_model(self, path: str):
+        save_model_bundle(path, self.model, config={
+            "model_name": self.model_name, "input_shape": list(self.input_shape),
+            "num_classes": self.num_classes, "label_map": self.label_map})
+
+    @classmethod
+    def load_model(cls, path: str) -> "ImageClassifier":
+        import json
+        import os
+
+        with open(os.path.join(path, "config.json")) as f:
+            config = json.load(f)["config"]
+        clf = cls(model_name=config["model_name"],
+                  input_shape=tuple(config["input_shape"]),
+                  num_classes=config["num_classes"],
+                  label_map=config.get("label_map"))
+        clf.compile()
+        clf.model.load_weights(path)
+        return clf

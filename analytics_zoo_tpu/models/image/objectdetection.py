@@ -1,0 +1,362 @@
+"""SSD object detection — reference ``models/image/objectdetection/``
+(``ObjectDetector.scala``, ssd/ graph + ``common/loss/MultiBoxLoss`` +
+``common/evaluation/MeanAveragePrecision.scala``, ``Postprocessor.scala``).
+
+TPU-native design:
+* anchors are generated once on the host per feature-map pyramid (static shapes);
+* the detection head emits one dense ``(B, num_anchors, 4 + num_classes)``
+  tensor — matching, loc smooth-L1, conf cross-entropy, and hard negative
+  mining are all fixed-shape vectorized ops (top-k replaces the reference's
+  sort-based mining loop), so the whole multibox loss jits into the train step;
+* decode+NMS runs host-side per image at predict time (variable-length output).
+
+Box convention: (cy, cx, h, w) normalized to [0, 1] for anchors; corner boxes
+(y1, x1, y2, x2) at the API edge. Class 0 is background.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...nn import layers as L
+from ...nn.graph import Input
+from ...nn.topology import Model
+
+# ----------------------------------------------------------------- anchors
+
+
+def generate_anchors(image_size: int, feature_sizes: Sequence[int],
+                     scales: Optional[Sequence[float]] = None,
+                     aspect_ratios: Sequence[float] = (1.0, 2.0, 0.5)) -> np.ndarray:
+    """Anchor pyramid (SSD Prior boxes): for each feature map cell, one anchor
+    per aspect ratio at that level's scale. Returns (A, 4) center-form
+    normalized (cy, cx, h, w)."""
+    n_levels = len(feature_sizes)
+    if scales is None:
+        scales = np.linspace(0.2, 0.9, n_levels)
+    out = []
+    for fs, scale in zip(feature_sizes, scales):
+        cy, cx = np.meshgrid(np.arange(fs), np.arange(fs), indexing="ij")
+        cy = (cy.reshape(-1) + 0.5) / fs
+        cx = (cx.reshape(-1) + 0.5) / fs
+        # cell-major, aspect-ratio-minor — MUST match the head's Reshape of the
+        # conv output (H, W, n_ar*(4+C)) → ((h*W+w)*n_ar + ar, 4+C), so that
+        # prediction slot i trains/decodes against the anchor at its own cell
+        per_cell = []
+        for ar in aspect_ratios:
+            h = scale / np.sqrt(ar)
+            w = scale * np.sqrt(ar)
+            per_cell.append(np.stack([cy, cx, np.full_like(cy, h),
+                                      np.full_like(cx, w)], axis=1))
+        level = np.stack(per_cell, axis=1)          # (cells, n_ar, 4)
+        out.append(level.reshape(-1, 4))
+    return np.concatenate(out, axis=0).astype("float32")
+
+
+def corner_to_center(boxes: np.ndarray) -> np.ndarray:
+    y1, x1, y2, x2 = np.moveaxis(boxes, -1, 0)
+    return np.stack([(y1 + y2) / 2, (x1 + x2) / 2, y2 - y1, x2 - x1], axis=-1)
+
+
+def center_to_corner(boxes: np.ndarray) -> np.ndarray:
+    cy, cx, h, w = np.moveaxis(boxes, -1, 0)
+    return np.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2], axis=-1)
+
+
+def iou_matrix(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """IoU of corner boxes a (N,4) × b (M,4) → (N, M)."""
+    a = a[:, None, :]
+    b = b[None, :, :]
+    inter_y1 = np.maximum(a[..., 0], b[..., 0])
+    inter_x1 = np.maximum(a[..., 1], b[..., 1])
+    inter_y2 = np.minimum(a[..., 2], b[..., 2])
+    inter_x2 = np.minimum(a[..., 3], b[..., 3])
+    ih = np.clip(inter_y2 - inter_y1, 0, None)
+    iw = np.clip(inter_x2 - inter_x1, 0, None)
+    inter = ih * iw
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    return inter / np.clip(area_a + area_b - inter, 1e-9, None)
+
+
+# ------------------------------------------------------------------ matching
+
+
+def match_anchors(anchors: np.ndarray, gt_boxes: np.ndarray,
+                  gt_labels: np.ndarray, iou_threshold: float = 0.5,
+                  variances=(0.1, 0.2)) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side target assignment (BboxUtil/MultiBoxLoss matching):
+    each anchor gets the best-overlapping gt (label 0 = background below
+    threshold); every gt's best anchor is force-matched. Returns
+    (loc_targets (A,4) encoded offsets, cls_targets (A,) int)."""
+    A = anchors.shape[0]
+    loc_t = np.zeros((A, 4), dtype="float32")
+    cls_t = np.zeros((A,), dtype="int32")
+    if len(gt_boxes) == 0:
+        return loc_t, cls_t
+    anchors_corner = center_to_corner(anchors)
+    ious = iou_matrix(anchors_corner, gt_boxes)       # (A, G)
+    best_gt = ious.argmax(axis=1)
+    best_iou = ious.max(axis=1)
+    # force-match each gt's best anchor
+    best_anchor_per_gt = ious.argmax(axis=0)
+    best_iou[best_anchor_per_gt] = 1.0
+    best_gt[best_anchor_per_gt] = np.arange(len(gt_boxes))
+    pos = best_iou >= iou_threshold
+    cls_t[pos] = gt_labels[best_gt[pos]]
+    matched = corner_to_center(gt_boxes[best_gt])
+    vc, vs = variances
+    loc = np.stack([
+        (matched[:, 0] - anchors[:, 0]) / anchors[:, 2] / vc,
+        (matched[:, 1] - anchors[:, 1]) / anchors[:, 3] / vc,
+        np.log(np.clip(matched[:, 2] / anchors[:, 2], 1e-9, None)) / vs,
+        np.log(np.clip(matched[:, 3] / anchors[:, 3], 1e-9, None)) / vs,
+    ], axis=1)
+    loc_t[pos] = loc[pos]
+    return loc_t, cls_t
+
+
+# ---------------------------------------------------------------- loss (jit)
+
+
+def multibox_loss(preds, loc_targets, cls_targets, num_classes: int,
+                  neg_pos_ratio: float = 3.0):
+    """MultiBoxLoss (common/loss/MultiBoxLoss capability): smooth-L1 on positive
+    anchors' offsets + softmax CE with hard negative mining at
+    ``neg_pos_ratio``. All fixed-shape jnp — jits into the train step.
+
+    preds: (B, A, 4 + C); loc_targets: (B, A, 4); cls_targets: (B, A) int.
+    """
+    loc_pred = preds[..., :4]
+    cls_pred = preds[..., 4:].astype(jnp.float32)
+    pos = (cls_targets > 0)
+    n_pos = jnp.maximum(pos.sum(), 1)
+
+    # smooth L1
+    diff = jnp.abs(loc_pred - loc_targets)
+    sl1 = jnp.where(diff < 1.0, 0.5 * diff ** 2, diff - 0.5).sum(-1)
+    loc_loss = jnp.where(pos, sl1, 0.0).sum() / n_pos
+
+    import jax.nn as jnn
+
+    log_probs = jnn.log_softmax(cls_pred, axis=-1)
+    ce = -jnp.take_along_axis(log_probs, cls_targets[..., None].astype(jnp.int32),
+                              axis=-1)[..., 0]
+    pos_ce = jnp.where(pos, ce, 0.0)
+    # hard negative mining: per-batch-row top-k negatives by loss
+    neg_ce = jnp.where(pos, -jnp.inf, ce)
+    k = jnp.minimum((neg_pos_ratio * pos.sum(axis=1)).astype(jnp.int32),
+                    jnp.asarray(neg_ce.shape[1] - 1, jnp.int32))
+    sorted_neg = jnp.sort(neg_ce, axis=1)[:, ::-1]     # descending
+    idx = jnp.arange(neg_ce.shape[1])[None, :]
+    neg_mask_sorted = idx < k[:, None]
+    neg_loss = jnp.where(neg_mask_sorted,
+                         jnp.where(jnp.isfinite(sorted_neg), sorted_neg, 0.0),
+                         0.0).sum()
+    cls_loss = (pos_ce.sum() + neg_loss) / n_pos
+    return loc_loss + cls_loss
+
+
+# ------------------------------------------------------------------- decode
+
+
+def decode_predictions(preds: np.ndarray, anchors: np.ndarray,
+                       variances=(0.1, 0.2)):
+    """(A, 4+C) raw preds → (corner_boxes (A,4), class_probs (A,C))."""
+    vc, vs = variances
+    loc = preds[:, :4]
+    cy = loc[:, 0] * vc * anchors[:, 2] + anchors[:, 0]
+    cx = loc[:, 1] * vc * anchors[:, 3] + anchors[:, 1]
+    h = np.exp(np.clip(loc[:, 2] * vs, -10, 10)) * anchors[:, 2]
+    w = np.exp(np.clip(loc[:, 3] * vs, -10, 10)) * anchors[:, 3]
+    boxes = center_to_corner(np.stack([cy, cx, h, w], axis=1))
+    logits = preds[:, 4:] - preds[:, 4:].max(axis=1, keepdims=True)
+    e = np.exp(logits)
+    probs = e / e.sum(axis=1, keepdims=True)
+    return boxes, probs
+
+
+def nms(boxes: np.ndarray, scores: np.ndarray, iou_threshold: float = 0.45,
+        top_k: int = 200) -> List[int]:
+    """Greedy per-class NMS (Postprocessor.scala parity), host side."""
+    order = np.argsort(-scores)[:top_k]
+    keep = []
+    while len(order) > 0:
+        i = order[0]
+        keep.append(int(i))
+        if len(order) == 1:
+            break
+        rest = order[1:]
+        ious = iou_matrix(boxes[i:i + 1], boxes[rest])[0]
+        order = rest[ious <= iou_threshold]
+    return keep
+
+
+# -------------------------------------------------------------------- model
+
+
+class SSDModel(Model):
+    """Small SSD graph: conv backbone with ``len(feature_sizes)`` detection
+    scales, each contributing ``len(aspect_ratios)`` anchors/cell. The head is
+    one conv per level emitting (4 + num_classes) per anchor — reshaped and
+    concatenated into the dense (B, A, 4+C) tensor the loss consumes."""
+
+    def __init__(self, num_classes: int, image_size: int = 96,
+                 aspect_ratios: Sequence[float] = (1.0, 2.0, 0.5),
+                 base_filters: int = 32):
+        self.num_classes_ = int(num_classes)
+        self.image_size = int(image_size)
+        self.aspect_ratios = tuple(aspect_ratios)
+        n_out = len(aspect_ratios) * (4 + num_classes)
+
+        inp = Input((image_size, image_size, 3))
+        x = inp
+        feature_sizes = []
+        heads = []
+        filters = base_filters
+        size = image_size
+        # downsample until the map is small; tap a head at each scale ≤ size/8
+        level = 0
+        while size > 2 and level < 6:
+            x = L.Convolution2D(filters, 3, 3, subsample=(2, 2),
+                                border_mode="same", use_bias=False)(x)
+            x = L.BatchNormalization()(x)
+            x = L.Activation("relu")(x)
+            size = -(-size // 2)
+            level += 1
+            if level >= 3:  # tap scales from stride-8 down
+                feature_sizes.append(size)
+                h = L.Convolution2D(n_out, 3, 3, border_mode="same")(x)
+                h = L.Reshape((size * size * len(aspect_ratios),
+                               4 + num_classes))(h)
+                heads.append(h)
+            filters = min(filters * 2, 256)
+        out = heads[0] if len(heads) == 1 else L.Merge(
+            mode="concat", concat_axis=0)(heads)
+        super().__init__(inp, out, name="ssd")
+        self.feature_sizes = feature_sizes
+        self.anchors = generate_anchors(image_size, feature_sizes,
+                                        aspect_ratios=self.aspect_ratios)
+
+
+class ObjectDetector:
+    """User-facing SSD detector (ObjectDetector.scala capability:
+    fit on (images, gt) and predictImageSet → [(label, score, box), ...])."""
+
+    def __init__(self, num_classes: int, image_size: int = 96,
+                 score_threshold: float = 0.3, iou_threshold: float = 0.45):
+        self.model = SSDModel(num_classes, image_size)
+        self.num_classes = int(num_classes)
+        self.image_size = int(image_size)
+        self.score_threshold = score_threshold
+        self.iou_threshold = iou_threshold
+
+    def compile(self, optimizer="adam", **kw):
+        anchors = self.model.anchors
+        C = self.num_classes
+
+        def loss(y_true, y_pred):
+            loc_t = y_true[..., :4]
+            cls_t = y_true[..., 4].astype(jnp.int32)
+            return multibox_loss(y_pred, loc_t, cls_t, C)
+
+        self.model.compile(optimizer=optimizer, loss=loss, **kw)
+        return self
+
+    def encode_targets(self, gt_boxes_list, gt_labels_list) -> np.ndarray:
+        """Per-image gt → dense (A, 5) targets [loc(4), cls(1)]."""
+        out = []
+        for boxes, labels in zip(gt_boxes_list, gt_labels_list):
+            loc_t, cls_t = match_anchors(self.model.anchors,
+                                         np.asarray(boxes, dtype="float32"),
+                                         np.asarray(labels, dtype="int32"))
+            out.append(np.concatenate([loc_t, cls_t[:, None].astype("float32")],
+                                      axis=1))
+        return np.stack(out)
+
+    def fit(self, images, gt_boxes_list, gt_labels_list, **kw):
+        targets = self.encode_targets(gt_boxes_list, gt_labels_list)
+        self.model.fit(np.asarray(images, dtype="float32"), targets, **kw)
+        return self
+
+    def predict(self, images, batch_size: int = 16):
+        """Returns per-image list of (class_id, score, (y1,x1,y2,x2))."""
+        raw = np.asarray(self.model.predict(np.asarray(images, dtype="float32"),
+                                            batch_size=batch_size))
+        results = []
+        for pred in raw:
+            boxes, probs = decode_predictions(pred, self.model.anchors)
+            dets = []
+            for c in range(1, self.num_classes):
+                scores = probs[:, c]
+                mask = scores >= self.score_threshold
+                if not mask.any():
+                    continue
+                kept = nms(boxes[mask], scores[mask], self.iou_threshold)
+                idx = np.nonzero(mask)[0][kept]
+                dets.extend((c, float(scores[i]), tuple(boxes[i].tolist()))
+                            for i in idx)
+            dets.sort(key=lambda d: -d[1])
+            results.append(dets)
+        return results
+
+
+# ---------------------------------------------------------------- evaluation
+
+
+class MeanAveragePrecision:
+    """VOC-style mAP (common/evaluation/MeanAveragePrecision.scala parity):
+    11-point interpolated AP per class over ranked detections."""
+
+    def __init__(self, num_classes: int, iou_threshold: float = 0.5):
+        self.num_classes = num_classes
+        self.iou_threshold = iou_threshold
+
+    def __call__(self, detections, gt_boxes_list, gt_labels_list) -> float:
+        aps = []
+        for c in range(1, self.num_classes):
+            aps.append(self._ap_for_class(c, detections, gt_boxes_list,
+                                          gt_labels_list))
+        aps = [a for a in aps if a is not None]
+        return float(np.mean(aps)) if aps else 0.0
+
+    def _ap_for_class(self, c, detections, gt_boxes_list, gt_labels_list):
+        scores, tps = [], []
+        n_gt = 0
+        for dets, gboxes, glabels in zip(detections, gt_boxes_list,
+                                         gt_labels_list):
+            gboxes = np.asarray(gboxes, dtype="float32").reshape(-1, 4)
+            glabels = np.asarray(glabels)
+            cls_gt = gboxes[glabels == c]
+            n_gt += len(cls_gt)
+            used = np.zeros(len(cls_gt), dtype=bool)
+            for (dc, score, box) in sorted([d for d in dets if d[0] == c],
+                                           key=lambda d: -d[1]):
+                scores.append(score)
+                hit = False
+                if len(cls_gt):
+                    ious = iou_matrix(np.asarray([box], dtype="float32"),
+                                      cls_gt)[0]
+                    j = int(ious.argmax())
+                    if ious[j] >= self.iou_threshold and not used[j]:
+                        used[j] = True
+                        hit = True
+                tps.append(hit)
+        if n_gt == 0:
+            return None
+        if not scores:
+            return 0.0
+        order = np.argsort(-np.asarray(scores))
+        tp = np.asarray(tps, dtype="float64")[order]
+        cum_tp = np.cumsum(tp)
+        recall = cum_tp / n_gt
+        precision = cum_tp / (np.arange(len(tp)) + 1)
+        ap = 0.0
+        for r in np.linspace(0, 1, 11):
+            p = precision[recall >= r]
+            ap += (p.max() if len(p) else 0.0) / 11
+        return float(ap)

@@ -4,7 +4,7 @@ from .core import (Activation, Dense, Dropout, ExpandDim, Flatten, GaussianDropo
                    GaussianNoise, InputLayer, Lambda, Masking, Narrow, Permute,
                    RepeatVector, Reshape, Select, SparseDense, Squeeze)
 from .convolution import (AveragePooling1D, AveragePooling2D, Convolution1D,
-                          Convolution2D, GlobalAveragePooling1D,
+                          Convolution2D, DepthwiseConv2D, GlobalAveragePooling1D,
                           GlobalAveragePooling2D, GlobalMaxPooling1D,
                           GlobalMaxPooling2D, MaxPooling1D, MaxPooling2D,
                           UpSampling2D, ZeroPadding2D)
@@ -19,7 +19,7 @@ Conv2D = Convolution2D
 __all__ = [
     "Activation", "AveragePooling1D", "AveragePooling2D", "BatchNormalization",
     "Bidirectional", "Conv1D", "Conv2D", "Convolution1D", "Convolution2D", "Dense",
-    "Dropout", "Embedding", "ExpandDim", "Flatten", "GRU", "GaussianDropout",
+    "DepthwiseConv2D", "Dropout", "Embedding", "ExpandDim", "Flatten", "GRU", "GaussianDropout",
     "GaussianNoise", "GlobalAveragePooling1D", "GlobalAveragePooling2D",
     "GlobalMaxPooling1D", "GlobalMaxPooling2D", "InputLayer", "LSTM", "Lambda",
     "LayerNormalization", "Masking", "MaxPooling1D", "MaxPooling2D", "Merge",

@@ -235,3 +235,55 @@ class ZeroPadding2D(Layer):
     def compute_output_shape(self, input_shape):
         h, w, c = input_shape
         return (h + 2 * self.pad[0], w + 2 * self.pad[1], c)
+
+
+class DepthwiseConv2D(Layer):
+    """Depthwise 2D conv (one filter per input channel × depth_multiplier) —
+    the MobileNet building block. NHWC; uses XLA's grouped convolution
+    (feature_group_count = in_channels), which the TPU compiler maps onto the
+    MXU without materializing the block-diagonal kernel."""
+
+    def __init__(self, kernel_size=(3, 3), depth_multiplier: int = 1,
+                 border_mode: str = "same", subsample=(1, 1),
+                 activation=None, init="glorot_uniform", use_bias: bool = False,
+                 name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.kernel_size = _pair(kernel_size)
+        self.depth_multiplier = int(depth_multiplier)
+        self.padding = border_mode.upper()
+        self.strides = _pair(subsample)
+        self.activation = get_activation(activation)
+        self.init = get_initializer(init)
+        self.use_bias = use_bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        kh, kw = self.kernel_size
+        # HWIO with I=1, O=in_ch*mult for grouped conv
+        params = {"kernel": self.init(
+            rng, (kh, kw, 1, in_ch * self.depth_multiplier), param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((in_ch * self.depth_multiplier,),
+                                       param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        kernel = jnp.asarray(params["kernel"], x.dtype)
+        y = jax.lax.conv_general_dilated(
+            x, kernel, window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=x.shape[-1])
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        if self.padding == "SAME":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        return (oh, ow, c * self.depth_multiplier)

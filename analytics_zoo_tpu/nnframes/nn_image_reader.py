@@ -33,11 +33,12 @@ class NNImageReader:
         for part in str(path).split(","):
             part = part.strip()
             if os.path.isdir(part):
-                for ext in _EXTS:
-                    files.extend(glob.glob(os.path.join(part, "**", f"*{ext}"),
-                                           recursive=True))
+                for root, _dirs, names in os.walk(part):
+                    files.extend(os.path.join(root, n) for n in names
+                                 if n.lower().endswith(_EXTS))
             else:
-                files.extend(glob.glob(part))
+                files.extend(f for f in glob.glob(part)
+                             if f.lower().endswith(_EXTS))
         files = sorted(set(files))
         if not files:
             raise FileNotFoundError(f"no images found under {path!r}")

@@ -107,6 +107,27 @@ def test_cluster_launcher_fail_fast(tmp_path):
     assert mon.all_done(), "surviving workers must be torn down"
 
 
+def test_cluster_launcher_timeout_kills(tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text("import time; time.sleep(60)")
+    launcher = ClusterLauncher(num_processes=2)
+    mon = launcher.launch(str(script), log_dir=str(tmp_path / "logs"))
+    with pytest.raises(TimeoutError):
+        mon.wait(timeout_s=1.0)
+    assert mon.all_done(), "timeout must tear workers down (no orphans)"
+
+
+def test_cluster_worker_logs_to_files(tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text("print('x' * 200000)")  # >64KB: would deadlock a PIPE
+    launcher = ClusterLauncher(num_processes=1)
+    mon = launcher.launch(str(script), log_dir=str(tmp_path / "logs"))
+    codes = mon.wait(timeout_s=30)
+    assert codes[0] == 0
+    log = (tmp_path / "logs" / "worker-0.log").read_text()
+    assert len(log) >= 200000
+
+
 def test_process_monitor_kill_all(tmp_path):
     script = tmp_path / "w.py"
     script.write_text("import time; time.sleep(60)")
