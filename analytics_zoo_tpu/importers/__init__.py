@@ -1,0 +1,16 @@
+"""Model importers — ONNX / torch checkpoint ingestion + unified ``Net.load``
+(reference ``pyzoo/zoo/pipeline/api/onnx/`` per-op mappers, ``api/net/``
+TorchNet/TFNet loaders, SURVEY.md §2.3/§2.5 Net loaders).
+
+TPU-native stance: no runtime embedding (no libtorch/JNI/TF session). ONNX
+graphs are decoded by a self-contained protobuf wire reader (no ``onnx``
+package needed) and executed as one jnp program; torch checkpoints are weight
+donors for framework-native models.
+"""
+
+from .net import Net
+from .onnx_loader import OnnxModel, load_onnx
+from .torch_loader import load_torch_state_dict, assign_torch_weights
+
+__all__ = ["Net", "OnnxModel", "load_onnx", "load_torch_state_dict",
+           "assign_torch_weights"]

@@ -1,0 +1,58 @@
+"""Unified ``Net.load*`` entry — reference ``pipeline/api/net/NetUtils.scala`` /
+``net_load.py``: one front door dispatching on artifact kind."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+class Net:
+    @staticmethod
+    def load(path: str, kind: Optional[str] = None):
+        """Auto-detecting loader:
+        * ``.onnx`` → :func:`load_onnx` (executable model)
+        * ``.pt``/``.pth`` → torch state_dict (weight donor dict)
+        * directory with ``config.json`` → zoo model bundle
+        """
+        kind = kind or Net._detect(path)
+        if kind == "onnx":
+            from .onnx_loader import load_onnx
+
+            return load_onnx(path)
+        if kind == "torch":
+            from .torch_loader import load_torch_state_dict
+
+            return load_torch_state_dict(path)
+        if kind == "zoo":
+            from ..models.common.zoo_model import load_model_bundle
+
+            model, _ = load_model_bundle(path)
+            return model
+        raise ValueError(f"cannot determine artifact kind for {path!r}; "
+                         f"pass kind='onnx'|'torch'|'zoo'")
+
+    @staticmethod
+    def _detect(path: str) -> Optional[str]:
+        low = path.lower()
+        if low.endswith(".onnx"):
+            return "onnx"
+        if low.endswith((".pt", ".pth")):
+            return "torch"
+        if os.path.isdir(path) and os.path.exists(
+                os.path.join(path, "config.json")):
+            return "zoo"
+        return None
+
+    # explicit entries (NetUtils.scala Net.loadBigDL/loadTF/loadTorch parity)
+    @staticmethod
+    def load_onnx(path: str):
+        return Net.load(path, kind="onnx")
+
+    @staticmethod
+    def load_torch(path: str) -> Dict:
+        return Net.load(path, kind="torch")
+
+    @staticmethod
+    def load_zoo(path: str):
+        return Net.load(path, kind="zoo")

@@ -1,0 +1,347 @@
+"""ONNX graph → executable JAX model.
+
+Reference parity: ``pyzoo/zoo/pipeline/api/onnx/onnx_loader.py`` + ``mapper/``
+(~40 per-op mappers onto zoo Keras layers). Redesign: instead of building layer
+objects per node, the graph executes directly as one traced jnp program inside a
+:class:`OnnxModel` Layer — initializers are the params pytree (trainable), and
+the node loop unrolls at trace time so XLA sees a flat fusable program.
+
+Supported ops (the reference mapper set minus deprecated ones): Conv, Gemm,
+MatMul, Add, Sub, Mul, Div, Neg, Abs, Exp, Log, Sqrt, Pow, Clip, Relu,
+LeakyRelu, Elu, Sigmoid, HardSigmoid, Tanh, Softmax, LogSoftmax,
+BatchNormalization, Dropout, Flatten, Reshape, Transpose, Concat, Squeeze,
+Unsqueeze, MaxPool, AveragePool, GlobalAveragePool, ReduceMean, ReduceSum,
+Gather, Shape, Constant, Identity.
+
+Layout note: ONNX is NCHW; compute stays NCHW inside the imported graph (XLA
+re-layouts for the MXU internally), so imported weights need no transposition.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.module import Layer
+from ..nn.topology import Sequential
+from .onnx_proto import Graph, Node, decode_model
+
+
+def _pads_to_jax(pads: Sequence[int], n_spatial: int):
+    """ONNX pads [b1..bn, e1..en] → [(b1,e1)...]; None → zeros."""
+    if not pads:
+        return [(0, 0)] * n_spatial
+    half = len(pads) // 2
+    return list(zip(pads[:half], pads[half:]))
+
+
+class _Executor:
+    """Single-node dispatch. ``env`` maps tensor name → traced array."""
+
+    def __init__(self, params: Dict[str, jnp.ndarray], training: bool, rng):
+        self.params = params
+        self.training = training
+        self.rng = rng
+        self._drop_count = 0
+
+    # every handler: (node, inputs: List[array]) -> List[array]
+    def run(self, node: Node, ins: List):
+        h = getattr(self, f"op_{node.op_type}", None)
+        if h is None:
+            raise NotImplementedError(
+                f"ONNX op {node.op_type!r} not supported (node {node.name!r})")
+        out = h(node, ins)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    # ------------------------------------------------------------- arithmetic
+    def op_Add(self, n, ins):
+        return ins[0] + ins[1]
+
+    def op_Sub(self, n, ins):
+        return ins[0] - ins[1]
+
+    def op_Mul(self, n, ins):
+        return ins[0] * ins[1]
+
+    def op_Div(self, n, ins):
+        return ins[0] / ins[1]
+
+    def op_Neg(self, n, ins):
+        return -ins[0]
+
+    def op_Abs(self, n, ins):
+        return jnp.abs(ins[0])
+
+    def op_Exp(self, n, ins):
+        return jnp.exp(ins[0])
+
+    def op_Log(self, n, ins):
+        return jnp.log(ins[0])
+
+    def op_Sqrt(self, n, ins):
+        return jnp.sqrt(ins[0])
+
+    def op_Pow(self, n, ins):
+        return jnp.power(ins[0], ins[1])
+
+    def op_Clip(self, n, ins):
+        lo = ins[1] if len(ins) > 1 and ins[1] is not None else n.attr("min", -jnp.inf)
+        hi = ins[2] if len(ins) > 2 and ins[2] is not None else n.attr("max", jnp.inf)
+        return jnp.clip(ins[0], lo, hi)
+
+    # ------------------------------------------------------------ activations
+    def op_Relu(self, n, ins):
+        return jax.nn.relu(ins[0])
+
+    def op_LeakyRelu(self, n, ins):
+        return jax.nn.leaky_relu(ins[0], n.attr("alpha", 0.01))
+
+    def op_Elu(self, n, ins):
+        return jax.nn.elu(ins[0], n.attr("alpha", 1.0))
+
+    def op_Sigmoid(self, n, ins):
+        return jax.nn.sigmoid(ins[0])
+
+    def op_HardSigmoid(self, n, ins):
+        a, b = n.attr("alpha", 0.2), n.attr("beta", 0.5)
+        return jnp.clip(a * ins[0] + b, 0.0, 1.0)
+
+    def op_Tanh(self, n, ins):
+        return jnp.tanh(ins[0])
+
+    def op_Softmax(self, n, ins):
+        return jax.nn.softmax(ins[0], axis=int(n.attr("axis", -1)))
+
+    def op_LogSoftmax(self, n, ins):
+        return jax.nn.log_softmax(ins[0], axis=int(n.attr("axis", -1)))
+
+    # ---------------------------------------------------------------- linear
+    def op_Gemm(self, n, ins):
+        a, b = ins[0], ins[1]
+        if int(n.attr("transA", 0)):
+            a = a.T
+        if int(n.attr("transB", 0)):
+            b = b.T
+        y = n.attr("alpha", 1.0) * (a @ b)
+        if len(ins) > 2 and ins[2] is not None:
+            y = y + n.attr("beta", 1.0) * ins[2]
+        return y
+
+    def op_MatMul(self, n, ins):
+        return ins[0] @ ins[1]
+
+    # ------------------------------------------------------------------ conv
+    def op_Conv(self, n, ins):
+        x, w = ins[0], ins[1]
+        n_sp = x.ndim - 2
+        strides = tuple(n.attr("strides", (1,) * n_sp))
+        dilations = tuple(n.attr("dilations", (1,) * n_sp))
+        groups = int(n.attr("group", 1))
+        auto_pad = n.attr("auto_pad", b"NOTSET")
+        if isinstance(auto_pad, bytes):
+            auto_pad = auto_pad.decode()
+        if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+            # explicit pads: ONNX SAME_LOWER puts the odd pixel FIRST, which is
+            # not what XLA's "SAME" (== SAME_UPPER) does
+            padding = []
+            for i in range(n_sp):
+                size = x.shape[2 + i]
+                k_eff = (w.shape[2 + i] - 1) * dilations[i] + 1
+                out = -(-size // strides[i])
+                total = max((out - 1) * strides[i] + k_eff - size, 0)
+                half, odd = divmod(total, 2)
+                padding.append((half + odd, half) if auto_pad == "SAME_LOWER"
+                               else (half, half + odd))
+        else:
+            padding = _pads_to_jax(n.attr("pads", ()), n_sp)
+        dn = jax.lax.conv_dimension_numbers(
+            x.shape, w.shape,
+            ("NCHW", "OIHW", "NCHW") if n_sp == 2 else ("NCW", "OIW", "NCW"))
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups)
+        if len(ins) > 2 and ins[2] is not None:
+            bias = ins[2].reshape((1, -1) + (1,) * n_sp)
+            y = y + bias
+        return y
+
+    # ------------------------------------------------------------------ pool
+    def _pool(self, n, x, op, init):
+        k = tuple(n.attr("kernel_shape"))
+        strides = tuple(n.attr("strides", k))
+        pads = _pads_to_jax(n.attr("pads", ()), len(k))
+        window = (1, 1) + k
+        ws = (1, 1) + strides
+        pad = [(0, 0), (0, 0)] + pads
+        return jax.lax.reduce_window(x, init, op, window, ws, pad)
+
+    def op_MaxPool(self, n, ins):
+        return self._pool(n, ins[0], jax.lax.max, -jnp.inf)
+
+    def op_AveragePool(self, n, ins):
+        # ONNX default count_include_pad=0: border windows divide by the number
+        # of REAL elements, not the full kernel area
+        summed = self._pool(n, ins[0], jax.lax.add, 0.0)
+        if int(n.attr("count_include_pad", 0)):
+            return summed / float(np.prod(tuple(n.attr("kernel_shape"))))
+        counts = self._pool(n, jnp.ones_like(ins[0]), jax.lax.add, 0.0)
+        return summed / counts
+
+    def op_GlobalAveragePool(self, n, ins):
+        x = ins[0]
+        return x.mean(axis=tuple(range(2, x.ndim)), keepdims=True)
+
+    # ------------------------------------------------------------------- norm
+    def op_BatchNormalization(self, n, ins):
+        x, scale, bias, mean, var = ins[:5]
+        eps = n.attr("epsilon", 1e-5)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return ((x - mean.reshape(shape))
+                / jnp.sqrt(var.reshape(shape) + eps)
+                * scale.reshape(shape) + bias.reshape(shape))
+
+    def op_Dropout(self, n, ins):
+        if not self.training or self.rng is None:
+            return ins[0]
+        ratio = n.attr("ratio", 0.5)
+        keep = 1.0 - ratio
+        # independent key per dropout node — one shared key would give every
+        # dropout in the graph the same mask
+        self._drop_count += 1
+        key = jax.random.fold_in(self.rng, self._drop_count)
+        mask = jax.random.bernoulli(key, keep, ins[0].shape)
+        return jnp.where(mask, ins[0] / keep, 0)
+
+    # ------------------------------------------------------------------ shape
+    def op_Flatten(self, n, ins):
+        axis = int(n.attr("axis", 1))
+        x = ins[0]
+        lead = int(np.prod(x.shape[:axis])) if axis else 1
+        return x.reshape(lead, -1)
+
+    def op_Reshape(self, n, ins):
+        shape = tuple(int(s) for s in np.asarray(ins[1]))
+        return ins[0].reshape(
+            tuple(ins[0].shape[i] if s == 0 else s for i, s in enumerate(shape)))
+
+    def op_Transpose(self, n, ins):
+        perm = n.attr("perm")
+        return jnp.transpose(ins[0], perm)
+
+    def op_Concat(self, n, ins):
+        return jnp.concatenate(ins, axis=int(n.attr("axis", 0)))
+
+    def op_Squeeze(self, n, ins):
+        axes = (tuple(int(a) for a in np.asarray(ins[1]))
+                if len(ins) > 1 and ins[1] is not None
+                else tuple(n.attr("axes", ())))
+        return jnp.squeeze(ins[0], axis=axes or None)
+
+    def op_Unsqueeze(self, n, ins):
+        axes = (tuple(int(a) for a in np.asarray(ins[1]))
+                if len(ins) > 1 and ins[1] is not None
+                else tuple(n.attr("axes", ())))
+        x = ins[0]
+        for a in sorted(axes):
+            x = jnp.expand_dims(x, a)
+        return x
+
+    def op_Shape(self, n, ins):
+        return jnp.asarray(ins[0].shape, jnp.int64)
+
+    def op_Gather(self, n, ins):
+        return jnp.take(ins[0], jnp.asarray(ins[1], jnp.int32),
+                        axis=int(n.attr("axis", 0)))
+
+    # ---------------------------------------------------------------- reduce
+    def op_ReduceMean(self, n, ins):
+        axes = tuple(n.attr("axes", ())) or None
+        return ins[0].mean(axis=axes, keepdims=bool(n.attr("keepdims", 1)))
+
+    def op_ReduceSum(self, n, ins):
+        axes = (tuple(int(a) for a in np.asarray(ins[1]))
+                if len(ins) > 1 and ins[1] is not None
+                else tuple(n.attr("axes", ())))
+        return ins[0].sum(axis=axes or None,
+                          keepdims=bool(n.attr("keepdims", 1)))
+
+    # ------------------------------------------------------------------ misc
+    def op_Constant(self, n, ins):
+        t = n.attr("value")
+        return jnp.asarray(t.data)
+
+    def op_Identity(self, n, ins):
+        return ins[0]
+
+
+class OnnxModel(Layer):
+    """An ONNX graph as a framework Layer: initializers are trainable params;
+    ``apply`` replays the node list (trace-time unroll → one XLA program)."""
+
+    def __init__(self, graph: Graph, name=None):
+        super().__init__(name=name or (graph.name or "onnx_model"))
+        self.graph = graph
+        init_names = set(graph.initializers)
+        self.input_names = [vi.name for vi in graph.inputs
+                            if vi.name not in init_names]
+        if not self.input_names:
+            raise ValueError("ONNX graph has no runtime inputs")
+        self.output_names = [vi.name for vi in graph.outputs]
+        self.input_shape_hint = tuple(graph.inputs[0].shape[1:]) \
+            if graph.inputs and graph.inputs[0].shape else None
+
+    def build(self, rng, input_shape):
+        params = {k: jnp.asarray(v) if np.issubdtype(v.dtype, np.floating)
+                  else np.asarray(v)
+                  for k, v in self.graph.initializers.items()}
+        # non-float initializers (shape constants) stay numpy inside the layer;
+        # only float tensors enter the trainable pytree
+        self._static = {k: v for k, v in params.items()
+                        if not isinstance(v, jnp.ndarray)}
+        return {k: v for k, v in params.items()
+                if isinstance(v, jnp.ndarray)}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        env: Dict[str, jnp.ndarray] = {}
+        env.update(self._static)
+        env.update(params)
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        if len(xs) != len(self.input_names):
+            raise ValueError(f"graph expects {len(self.input_names)} inputs "
+                             f"({self.input_names}), got {len(xs)}")
+        for name, arr in zip(self.input_names, xs):
+            env[name] = jnp.asarray(arr)
+        ex = _Executor(params, training, rng)
+        for node in self.graph.nodes:
+            # empty names mark omitted OPTIONAL inputs — keep the slot as None
+            # so positional operands (e.g. Clip's min/max) don't shift
+            ins = [env[i] if i else None for i in node.inputs]
+            while ins and ins[-1] is None:
+                ins.pop()
+            outs = ex.run(node, ins)
+            for out_name, val in zip(node.outputs, outs):
+                env[out_name] = val
+        outs = [env[o] for o in self.output_names]
+        return (outs[0] if len(outs) == 1 else outs), state
+
+    def compute_output_shape(self, input_shape):
+        return input_shape  # unknown statically; predict paths don't need it
+
+
+def load_onnx(path_or_bytes) -> Sequential:
+    """Load an ONNX model file → compiled-ready Sequential wrapping OnnxModel
+    (onnx_loader.py ``load`` parity)."""
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        buf = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            buf = f.read()
+    graph = decode_model(buf)
+    layer = OnnxModel(graph)
+    m = Sequential(name=layer.name)
+    m.add(layer)
+    return m

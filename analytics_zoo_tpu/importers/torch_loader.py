@@ -1,0 +1,127 @@
+"""Torch checkpoint ingestion — weight-donor importer (no libtorch runtime).
+
+Reference parity: TorchNet/TorchModel load TorchScript/pickled modules into an
+embedded runtime (zoo/.../api/net/TorchNet.scala:39-156, TorchModel.scala:25).
+On TPU there is no embedded-interpreter path (SURVEY.md §2.3): the capability
+kept is *weights in* — read a torch checkpoint (state_dict or full module) into
+numpy, then map onto a framework-native model's params pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+def load_torch_state_dict(path: str,
+                          allow_pickle: bool = False) -> Dict[str, np.ndarray]:
+    """Read a ``.pt``/``.pth`` file → {name: numpy array}. Accepts a raw
+    state_dict or a checkpoint dict holding one under 'state_dict'/'model'.
+
+    Loads with ``weights_only=True`` (tensors + containers only — no arbitrary
+    pickle execution from untrusted files). Full pickled ``nn.Module`` files
+    need ``allow_pickle=True``, which runs the checkpoint's pickle code — only
+    for files you trust."""
+    import torch
+
+    try:
+        obj = torch.load(path, map_location="cpu", weights_only=True)
+    except Exception:
+        if not allow_pickle:
+            raise ValueError(
+                f"{path!r} is not a plain weights checkpoint. If you trust the "
+                "file (it may execute code on load), pass allow_pickle=True.")
+        obj = torch.load(path, map_location="cpu", weights_only=False)
+    if hasattr(obj, "state_dict"):
+        obj = obj.state_dict()
+    if isinstance(obj, dict):
+        for k in ("state_dict", "model", "model_state_dict"):
+            if k in obj and isinstance(obj[k], dict):
+                obj = obj[k]
+                break
+    if not isinstance(obj, dict):
+        raise ValueError(f"unrecognized torch checkpoint structure: {type(obj)}")
+    out = {}
+    for k, v in obj.items():
+        if hasattr(v, "detach"):
+            out[k] = v.detach().cpu().numpy()
+    if not out:
+        raise ValueError("checkpoint holds no tensors")
+    return out
+
+
+def assign_torch_weights(model, state_dict: Dict[str, np.ndarray],
+                         mapping: Dict[str, str],
+                         transpose_linear: bool = True):
+    """Assign torch tensors into a compiled model's params.
+
+    ``mapping``: {framework param path ("layer/leaf" as in the flat weight
+    bundle, e.g. "dense_0/kernel") → torch key ("fc1.weight")}. Linear kernels
+    are transposed (torch stores (out, in); this framework stores (in, out))
+    unless ``transpose_linear=False``. Conv kernels OIHW → HWIO are transposed
+    when the target is rank-4 with mismatched layout.
+
+    The model must be compiled; weights land via the same path as load_weights.
+    """
+    import jax
+
+    est = getattr(model, "estimator", None)
+    if est is None:
+        raise RuntimeError("model must be compiled before weight assignment")
+    if est.train_state is None:
+        params_t, state_t = model.build(jax.random.PRNGKey(0))
+        est.initial_weights = (params_t, state_t)
+        target = params_t
+    else:
+        target = jax.device_get(est.train_state["params"])
+
+    flat = _flatten(target)
+    new_flat = dict(flat)
+    for fw_key, torch_key in mapping.items():
+        if fw_key not in flat:
+            raise KeyError(f"framework param {fw_key!r} not found; "
+                           f"have {sorted(flat)[:8]}...")
+        if torch_key not in state_dict:
+            raise KeyError(f"torch key {torch_key!r} not in checkpoint")
+        src = np.asarray(state_dict[torch_key])
+        dst_shape = flat[fw_key].shape
+        if src.shape != dst_shape:
+            if transpose_linear and src.ndim == 2 and src.T.shape == dst_shape:
+                src = src.T
+            elif src.ndim == 4 and np.transpose(src, (2, 3, 1, 0)).shape == dst_shape:
+                src = np.transpose(src, (2, 3, 1, 0))  # OIHW → HWIO
+            else:
+                raise ValueError(f"{torch_key}: shape {src.shape} does not fit "
+                                 f"{fw_key} {dst_shape}")
+        new_flat[fw_key] = src.astype(np.asarray(flat[fw_key]).dtype)
+    rebuilt = _unflatten(target, new_flat)
+    if est.train_state is None:
+        est.initial_weights = (rebuilt, est.initial_weights[1])
+    else:
+        est.train_state["params"] = est._place_state(rebuilt)
+    return model
+
+
+def _flatten(tree, prefix="") -> Dict[str, np.ndarray]:
+    import jax
+
+    out = {}
+    paths, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def _unflatten(template, flat: Dict[str, np.ndarray]):
+    import jax
+
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        leaves.append(flat[key])
+    return jax.tree_util.tree_unflatten(treedef, leaves)

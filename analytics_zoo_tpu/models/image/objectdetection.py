@@ -29,7 +29,7 @@ from ...nn.topology import Model
 # ----------------------------------------------------------------- anchors
 
 
-def generate_anchors(image_size: int, feature_sizes: Sequence[int],
+def generate_anchors(feature_sizes: Sequence[int],
                      scales: Optional[Sequence[float]] = None,
                      aspect_ratios: Sequence[float] = (1.0, 2.0, 0.5)) -> np.ndarray:
     """Anchor pyramid (SSD Prior boxes): for each feature map cell, one anchor
@@ -239,7 +239,7 @@ class SSDModel(Model):
             mode="concat", concat_axis=0)(heads)
         super().__init__(inp, out, name="ssd")
         self.feature_sizes = feature_sizes
-        self.anchors = generate_anchors(image_size, feature_sizes,
+        self.anchors = generate_anchors(feature_sizes,
                                         aspect_ratios=self.aspect_ratios)
 
 

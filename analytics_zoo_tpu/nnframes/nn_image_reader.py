@@ -37,8 +37,9 @@ class NNImageReader:
                     files.extend(os.path.join(root, n) for n in names
                                  if n.lower().endswith(_EXTS))
             else:
-                files.extend(f for f in glob.glob(part)
-                             if f.lower().endswith(_EXTS))
+                # explicit file or glob: the user named it — no extension
+                # filtering (PIL decodes more formats than _EXTS lists)
+                files.extend(glob.glob(part))
         files = sorted(set(files))
         if not files:
             raise FileNotFoundError(f"no images found under {path!r}")

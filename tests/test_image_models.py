@@ -51,7 +51,7 @@ def test_anchor_layout_is_cell_major():
     """Anchor row order must match the head's reshape: (cell, ar) — rows for
     one cell are contiguous and share a center (regression: ar-major ordering
     paired prediction slots with anchors at unrelated cells)."""
-    anchors = generate_anchors(32, [2], aspect_ratios=(1.0, 2.0, 0.5))
+    anchors = generate_anchors([2], aspect_ratios=(1.0, 2.0, 0.5))
     assert anchors.shape == (12, 4)
     for cell in range(4):
         rows = anchors[cell * 3:(cell + 1) * 3]
@@ -61,7 +61,7 @@ def test_anchor_layout_is_cell_major():
 
 
 def test_anchors_and_matching_roundtrip():
-    anchors = generate_anchors(32, [4, 2])
+    anchors = generate_anchors([4, 2])
     assert anchors.shape == (3 * (16 + 4), 4)
     gt = np.array([[0.1, 0.1, 0.5, 0.5]], dtype="float32")
     labels = np.array([2], dtype="int32")

@@ -1,0 +1,237 @@
+"""Importer tests: ONNX wire codec round-trip, executor vs torch differential,
+torch weight donor, Net.load dispatch (SURVEY.md §2.3 ingestion parity)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.importers import (Net, OnnxModel, assign_torch_weights,
+                                         load_onnx, load_torch_state_dict)
+from analytics_zoo_tpu.importers.onnx_proto import (Attribute, Graph, Node,
+                                                    Tensor, ValueInfo,
+                                                    decode_model, encode_model)
+
+
+def build_mlp_graph(w1, b1, w2, b2):
+    """x(N,4) -> Gemm -> Relu -> Gemm -> Softmax."""
+    g = Graph(name="mlp")
+    g.initializers = {"w1": w1, "b1": b1, "w2": w2, "b2": b2}
+    g.inputs = [ValueInfo("x", (None, 4))]
+    g.outputs = [ValueInfo("probs", (None, w2.shape[1]))]
+    g.nodes = [
+        Node("Gemm", ["x", "w1", "b1"], ["h"], "gemm1"),
+        Node("Relu", ["h"], ["hr"], "relu1"),
+        Node("Gemm", ["hr", "w2", "b2"], ["logits"], "gemm2"),
+        Node("Softmax", ["logits"], ["probs"], "sm",
+             attrs={"axis": Attribute(name="axis", i=1)}),
+    ]
+    return g
+
+
+def test_wire_codec_roundtrip():
+    rng = np.random.default_rng(0)
+    w1 = rng.standard_normal((4, 8)).astype("float32")
+    g = build_mlp_graph(w1, np.zeros(8, "float32"),
+                        rng.standard_normal((8, 3)).astype("float32"),
+                        np.zeros(3, "float32"))
+    buf = encode_model(g)
+    g2 = decode_model(buf)
+    assert [n.op_type for n in g2.nodes] == ["Gemm", "Relu", "Gemm", "Softmax"]
+    np.testing.assert_allclose(g2.initializers["w1"], w1)
+    assert g2.inputs[0].name == "x" and g2.inputs[0].shape == (None, 4)
+    assert g2.nodes[3].attr("axis") == 1
+
+
+def test_onnx_mlp_executes_and_matches_numpy(tmp_path):
+    rng = np.random.default_rng(1)
+    w1 = rng.standard_normal((4, 8)).astype("float32")
+    b1 = rng.standard_normal(8).astype("float32")
+    w2 = rng.standard_normal((8, 3)).astype("float32")
+    b2 = rng.standard_normal(3).astype("float32")
+    path = str(tmp_path / "mlp.onnx")
+    with open(path, "wb") as f:
+        f.write(encode_model(build_mlp_graph(w1, b1, w2, b2)))
+
+    model = load_onnx(path)
+    model.compile(optimizer="adam", loss="mse")
+    x = rng.standard_normal((5, 4)).astype("float32")
+    got = model.predict(x)
+
+    h = np.maximum(x @ w1 + b1, 0)
+    logits = h @ w2 + b2
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_onnx_conv_differential_vs_torch(tmp_path):
+    """Conv/BN/pool graph built from a torch module's weights must match the
+    torch forward exactly (the KerasRunner-style differential oracle)."""
+    import torch
+    import torch.nn as nn
+
+    torch.manual_seed(0)
+    tm = nn.Sequential(
+        nn.Conv2d(3, 6, 3, stride=1, padding=1),
+        nn.BatchNorm2d(6), nn.ReLU(),
+        nn.MaxPool2d(2),
+        nn.Conv2d(6, 4, 3, padding=0), nn.ReLU(),
+    ).eval()
+    x = torch.randn(2, 3, 8, 8)
+    with torch.no_grad():
+        want = tm(x).numpy()
+
+    sd = {k: v.numpy() for k, v in tm.state_dict().items()}
+    g = Graph(name="conv")
+    g.initializers = {
+        "w0": sd["0.weight"], "b0": sd["0.bias"],
+        "bn_s": sd["1.weight"], "bn_b": sd["1.bias"],
+        "bn_m": sd["1.running_mean"], "bn_v": sd["1.running_var"],
+        "w4": sd["4.weight"], "b4": sd["4.bias"],
+    }
+    g.inputs = [ValueInfo("x", (None, 3, 8, 8))]
+    g.outputs = [ValueInfo("y", ())]
+    g.nodes = [
+        Node("Conv", ["x", "w0", "b0"], ["c0"], "conv0", attrs={
+            "pads": Attribute(name="pads", ints=(1, 1, 1, 1)),
+            "strides": Attribute(name="strides", ints=(1, 1)),
+            "kernel_shape": Attribute(name="kernel_shape", ints=(3, 3))}),
+        Node("BatchNormalization", ["c0", "bn_s", "bn_b", "bn_m", "bn_v"],
+             ["bn"], "bn1", attrs={"epsilon": Attribute(name="epsilon", f=1e-5)}),
+        Node("Relu", ["bn"], ["r1"], "r1"),
+        Node("MaxPool", ["r1"], ["p"], "pool", attrs={
+            "kernel_shape": Attribute(name="kernel_shape", ints=(2, 2)),
+            "strides": Attribute(name="strides", ints=(2, 2))}),
+        Node("Conv", ["p", "w4", "b4"], ["c4"], "conv4", attrs={
+            "kernel_shape": Attribute(name="kernel_shape", ints=(3, 3))}),
+        Node("Relu", ["c4"], ["y"], "r2"),
+    ]
+    path = str(tmp_path / "conv.onnx")
+    with open(path, "wb") as f:
+        f.write(encode_model(g))
+
+    model = load_onnx(path)
+    model.compile(optimizer="adam", loss="mse")
+    got = model.predict(x.numpy())
+    np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+def test_onnx_elementwise_ops(tmp_path):
+    g = Graph(name="ew")
+    g.initializers = {"two": np.asarray([2.0], dtype="float32")}
+    g.inputs = [ValueInfo("x", (None, 3))]
+    g.outputs = [ValueInfo("y", ())]
+    g.nodes = [
+        Node("Mul", ["x", "two"], ["m"]),
+        Node("Exp", ["m"], ["e"]),
+        Node("Log", ["e"], ["l"]),
+        Node("Neg", ["l"], ["n"]),
+        Node("Abs", ["n"], ["a"]),
+        Node("Clip", ["a"], ["y"], attrs={
+            "min": Attribute(name="min", f=0.5),
+            "max": Attribute(name="max", f=4.0)}),
+    ]
+    model = load_onnx(encode_model(g))
+    model.compile(optimizer="adam", loss="mse")
+    x = np.asarray([[0.1, 1.0, 3.0]], dtype="float32")
+    got = model.predict(x)
+    np.testing.assert_allclose(got, np.clip(np.abs(2 * x), 0.5, 4.0), atol=1e-5)
+
+
+def test_onnx_clip_with_omitted_min_input():
+    """Clip with min omitted via empty name (opset>=11 exporter pattern): the
+    max operand must stay in its positional slot (regression: input filtering
+    shifted it into min)."""
+    g = Graph(name="clip")
+    g.initializers = {"mx": np.asarray(4.0, dtype="float32")}
+    g.inputs = [ValueInfo("x", (None, 3))]
+    g.outputs = [ValueInfo("y", ())]
+    g.nodes = [Node("Clip", ["x", "", "mx"], ["y"])]
+    model = load_onnx(encode_model(g))
+    model.compile(optimizer="adam", loss="mse")
+    x = np.asarray([[-5.0, 2.0, 9.0]], dtype="float32")
+    np.testing.assert_allclose(model.predict(x), [[-5.0, 2.0, 4.0]], atol=1e-6)
+
+
+def test_onnx_average_pool_excludes_padding():
+    """AveragePool default count_include_pad=0: padded border windows divide by
+    the real element count."""
+    g = Graph(name="ap")
+    g.inputs = [ValueInfo("x", (None, 1, 2, 2))]
+    g.outputs = [ValueInfo("y", ())]
+    g.nodes = [Node("AveragePool", ["x"], ["y"], attrs={
+        "kernel_shape": Attribute(name="kernel_shape", ints=(2, 2)),
+        "strides": Attribute(name="strides", ints=(1, 1)),
+        "pads": Attribute(name="pads", ints=(1, 1, 1, 1))})]
+    model = load_onnx(encode_model(g))
+    model.compile(optimizer="adam", loss="mse")
+    x = np.ones((1, 1, 2, 2), dtype="float32")
+    out = model.predict(x)
+    np.testing.assert_allclose(out, np.ones_like(out), atol=1e-6)
+
+
+def test_onnx_unsupported_op_raises():
+    g = Graph(name="bad")
+    g.inputs = [ValueInfo("x", (None, 2))]
+    g.outputs = [ValueInfo("y", ())]
+    g.nodes = [Node("Einsum", ["x"], ["y"])]
+    model = load_onnx(encode_model(g))
+    model.compile(optimizer="adam", loss="mse")
+    with pytest.raises(NotImplementedError, match="Einsum"):
+        model.predict(np.zeros((1, 2), dtype="float32"))
+
+
+# ------------------------------------------------------------------- torch
+def test_torch_state_dict_and_weight_assignment(tmp_path):
+    import torch
+    import torch.nn as nn
+
+    from analytics_zoo_tpu.nn import layers as L
+    from analytics_zoo_tpu.nn.topology import Sequential
+
+    torch.manual_seed(0)
+    tm = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    p = str(tmp_path / "m.pt")
+    torch.save(tm.state_dict(), p)
+
+    sd = load_torch_state_dict(p)
+    assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+
+    m = Sequential()
+    m.add(L.InputLayer((4,)))
+    m.add(L.Dense(8, activation="relu", name="fc1"))
+    m.add(L.Dense(2, name="fc2"))
+    m.compile(optimizer="adam", loss="mse")
+    # framework keys follow the weight-bundle slot convention (<slot>_<type>)
+    assign_torch_weights(m, sd, {
+        "1_dense/kernel": "0.weight", "1_dense/bias": "0.bias",
+        "2_dense/kernel": "2.weight", "2_dense/bias": "2.bias"})
+    x = np.random.default_rng(0).standard_normal((3, 4)).astype("float32")
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(m.predict(x), want, atol=1e-4)
+
+
+def test_net_load_dispatch(tmp_path):
+    import torch
+    import torch.nn as nn
+
+    p = str(tmp_path / "w.pth")
+    torch.save(nn.Linear(2, 2).state_dict(), p)
+    sd = Net.load(p)
+    assert "weight" in sd
+    with pytest.raises(ValueError, match="cannot determine"):
+        Net.load(str(tmp_path))
+
+
+def test_torch_full_module_requires_opt_in(tmp_path):
+    """Pickled full modules execute code on load — refused unless the caller
+    passes allow_pickle=True."""
+    import torch
+    import torch.nn as nn
+
+    p = str(tmp_path / "full.pt")
+    torch.save(nn.Linear(2, 2), p)
+    with pytest.raises(ValueError, match="allow_pickle"):
+        load_torch_state_dict(p)
+    sd = load_torch_state_dict(p, allow_pickle=True)
+    assert "weight" in sd
