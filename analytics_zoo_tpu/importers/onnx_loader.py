@@ -47,8 +47,30 @@ class _Executor:
         self.rng = rng
         self._drop_count = 0
 
+    # ops evaluated in pure numpy when every operand is a host constant —
+    # keeps shape-computation chains (Shape→Gather→Concat→Reshape) concrete:
+    # inside a jit trace jnp ops are staged even on constants, and Reshape
+    # needs actual integer values
+    _HOST_OPS = {
+        "Gather": lambda n, ins: np.take(ins[0], np.asarray(ins[1], np.int64),
+                                         axis=int(n.attr("axis", 0))),
+        "Concat": lambda n, ins: np.concatenate(ins, axis=int(n.attr("axis", 0))),
+        "Add": lambda n, ins: ins[0] + ins[1],
+        "Sub": lambda n, ins: ins[0] - ins[1],
+        "Mul": lambda n, ins: ins[0] * ins[1],
+        "Squeeze": lambda n, ins: np.squeeze(
+            ins[0], axis=tuple(n.attr("axes", ())) or None),
+        "Unsqueeze": lambda n, ins: np.expand_dims(
+            ins[0], tuple(n.attr("axes", (0,)))[0]),
+        "Identity": lambda n, ins: ins[0],
+    }
+
     # every handler: (node, inputs: List[array]) -> List[array]
     def run(self, node: Node, ins: List):
+        live = [i for i in ins if i is not None]
+        if (node.op_type in self._HOST_OPS and live
+                and all(isinstance(i, np.ndarray) for i in live)):
+            return [self._HOST_OPS[node.op_type](node, ins)]
         h = getattr(self, f"op_{node.op_type}", None)
         if h is None:
             raise NotImplementedError(
@@ -251,7 +273,10 @@ class _Executor:
         return x
 
     def op_Shape(self, n, ins):
-        return jnp.asarray(ins[0].shape, jnp.int64)
+        # host-side numpy constant, NOT a jnp array: shapes are static under
+        # tracing, and downstream Reshape/Gather must be able to read concrete
+        # values (np.asarray on a traced array would fail)
+        return np.asarray(ins[0].shape, np.int64)
 
     def op_Gather(self, n, ins):
         return jnp.take(ins[0], jnp.asarray(ins[1], jnp.int32),

@@ -23,11 +23,17 @@ def load_torch_state_dict(path: str,
     pickle execution from untrusted files). Full pickled ``nn.Module`` files
     need ``allow_pickle=True``, which runs the checkpoint's pickle code — only
     for files you trust."""
+    import os
+    import pickle
+
     import torch
 
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
     try:
         obj = torch.load(path, map_location="cpu", weights_only=True)
-    except Exception:
+    except (pickle.UnpicklingError, RuntimeError, AttributeError):
+        # weights_only rejected the payload (custom classes / full module)
         if not allow_pickle:
             raise ValueError(
                 f"{path!r} is not a plain weights checkpoint. If you trust the "
@@ -70,8 +76,13 @@ def assign_torch_weights(model, state_dict: Dict[str, np.ndarray],
     if est is None:
         raise RuntimeError("model must be compiled before weight assignment")
     if est.train_state is None:
-        params_t, state_t = model.build(jax.random.PRNGKey(0))
-        est.initial_weights = (params_t, state_t)
+        if est.initial_weights is not None:
+            # keep weights from earlier load/assign calls — partial mappings
+            # may be applied in several passes
+            params_t, state_t = est.initial_weights
+        else:
+            params_t, state_t = model.build(jax.random.PRNGKey(0))
+            est.initial_weights = (params_t, state_t)
         target = params_t
     else:
         target = jax.device_get(est.train_state["params"])
@@ -99,7 +110,14 @@ def assign_torch_weights(model, state_dict: Dict[str, np.ndarray],
     if est.train_state is None:
         est.initial_weights = (rebuilt, est.initial_weights[1])
     else:
+        import jax.numpy as jnp
+
         est.train_state["params"] = est._place_state(rebuilt)
+        # stale optimizer moments belong to the pre-assignment weights
+        # (same reasoning as KerasNet.load_weights, topology.py)
+        est.train_state["opt_state"] = est._place_state(
+            est.tx.init(jax.device_get(est.train_state["params"])))
+        est.train_state["step"] = jnp.zeros((), jnp.int32)
     return model
 
 

@@ -1,0 +1,13 @@
+"""Native host runtime — ctypes bindings over ``native/zoo_native.cpp``.
+
+The C++ side (SURVEY.md §2.11 item 5 — the PMem/memkind allocator equivalent)
+provides the mmap arena and threaded row gather; this module compiles it on
+first use (g++, cached .so) and degrades gracefully to numpy when no compiler
+is available (``native_available()`` → False, all APIs keep working).
+"""
+
+from .lib import (HostArena, NativeSampleCache, gather_rows, native_available,
+                  num_gather_threads)
+
+__all__ = ["HostArena", "NativeSampleCache", "gather_rows", "native_available",
+           "num_gather_threads"]

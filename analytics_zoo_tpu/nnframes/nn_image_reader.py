@@ -38,8 +38,9 @@ class NNImageReader:
                                  if n.lower().endswith(_EXTS))
             else:
                 # explicit file or glob: the user named it — no extension
-                # filtering (PIL decodes more formats than _EXTS lists)
-                files.extend(glob.glob(part))
+                # filtering (PIL decodes more formats than _EXTS lists), but
+                # only regular files (globs like 'dir/*' also match subdirs)
+                files.extend(f for f in glob.glob(part) if os.path.isfile(f))
         files = sorted(set(files))
         if not files:
             raise FileNotFoundError(f"no images found under {path!r}")

@@ -169,6 +169,29 @@ def test_onnx_average_pool_excludes_padding():
     np.testing.assert_allclose(out, np.ones_like(out), atol=1e-6)
 
 
+def test_onnx_shape_gather_concat_reshape_chain():
+    """The torch x.view(x.size(0), -1) export pattern: Shape→Gather→Concat→
+    Reshape must work under jit (shapes are static; Shape emits a host
+    constant)."""
+    g = Graph(name="flatten_dyn")
+    g.initializers = {"idx0": np.asarray([0], dtype="int64"),
+                      "minus1": np.asarray([-1], dtype="int64")}
+    g.inputs = [ValueInfo("x", (None, 2, 3, 4))]
+    g.outputs = [ValueInfo("y", ())]
+    g.nodes = [
+        Node("Shape", ["x"], ["s"]),
+        Node("Gather", ["s", "idx0"], ["n"], attrs={
+            "axis": Attribute(name="axis", i=0)}),
+        Node("Concat", ["n", "minus1"], ["shape"], attrs={
+            "axis": Attribute(name="axis", i=0)}),
+        Node("Reshape", ["x", "shape"], ["y"]),
+    ]
+    model = load_onnx(encode_model(g))
+    model.compile(optimizer="adam", loss="mse")
+    x = np.arange(2 * 2 * 3 * 4, dtype="float32").reshape(2, 2, 3, 4)
+    np.testing.assert_array_equal(model.predict(x), x.reshape(2, -1))
+
+
 def test_onnx_unsupported_op_raises():
     g = Graph(name="bad")
     g.inputs = [ValueInfo("x", (None, 2))]
@@ -221,6 +244,40 @@ def test_net_load_dispatch(tmp_path):
     assert "weight" in sd
     with pytest.raises(ValueError, match="cannot determine"):
         Net.load(str(tmp_path))
+
+
+def test_torch_two_pass_assignment_keeps_first_pass(tmp_path):
+    """Assigning weights in two calls before fit must not reset pass one."""
+    import torch
+    import torch.nn as nn
+
+    from analytics_zoo_tpu.nn import layers as L
+    from analytics_zoo_tpu.nn.topology import Sequential
+
+    torch.manual_seed(1)
+    tm = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+    p = str(tmp_path / "m.pt")
+    torch.save(tm.state_dict(), p)
+    sd = load_torch_state_dict(p)
+
+    m = Sequential()
+    m.add(L.InputLayer((3,)))
+    m.add(L.Dense(4, activation="relu"))
+    m.add(L.Dense(2))
+    m.compile(optimizer="adam", loss="mse")
+    assign_torch_weights(m, sd, {"1_dense/kernel": "0.weight",
+                                 "1_dense/bias": "0.bias"})
+    assign_torch_weights(m, sd, {"2_dense/kernel": "2.weight",
+                                 "2_dense/bias": "2.bias"})
+    x = np.random.default_rng(0).standard_normal((4, 3)).astype("float32")
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(m.predict(x), want, atol=1e-4)
+
+
+def test_torch_missing_file_raises_file_not_found(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_torch_state_dict(str(tmp_path / "nope.pt"))
 
 
 def test_torch_full_module_requires_opt_in(tmp_path):
