@@ -153,30 +153,28 @@ class FeatureSet:
                 self.process_index::self.process_count]
             if len(sel) == 0:
                 continue
-            # sorted gather is dramatically faster on memmap tiers
+            yield _tree_map(lambda a: self._gather(a, sel), self.data)
+
+    @staticmethod
+    def _gather(a: np.ndarray, sel: np.ndarray) -> np.ndarray:
+        """Row gather for one batch. Memmap tiers read in SORTED index order
+        (page-cache friendly) then restore batch order; in-DRAM contiguous
+        arrays route through the native threaded gather (zoo_native.cpp
+        gather_rows — saturates DRAM bandwidth instead of numpy's
+        single-threaded memcpy)."""
+        if isinstance(a, np.memmap):
             order = np.argsort(sel, kind="stable")
             inv = np.empty_like(order)
             inv[order] = np.arange(len(order))
-            sorted_sel = sel[order]
-            yield _tree_map(lambda a: self._gather(a, sorted_sel, inv), self.data)
-
-    @staticmethod
-    def _gather(a: np.ndarray, sorted_idx: np.ndarray,
-                inv: np.ndarray) -> np.ndarray:
-        """Row gather for one batch. In-DRAM arrays route through the native
-        threaded gather (zoo_native.cpp gather_rows — saturates DRAM bandwidth
-        instead of numpy's single-threaded memcpy); memmap tiers keep numpy's
-        sorted access pattern which the page cache rewards."""
-        if isinstance(a, np.memmap):
-            return np.ascontiguousarray(a[sorted_idx][inv])
+            return np.ascontiguousarray(a[sel[order]][inv])
         from ..native import gather_rows, native_available
 
         # native path only for contiguous arrays — gather_rows would otherwise
         # copy the WHOLE source to make it contiguous, once per batch
         if (native_available() and a.nbytes >= (1 << 20)
                 and a.flags["C_CONTIGUOUS"]):
-            return gather_rows(a, sorted_idx[inv])
-        return np.ascontiguousarray(a[sorted_idx][inv])
+            return gather_rows(a, sel)
+        return np.ascontiguousarray(a[sel])
 
     def slices(self, num_slices: Optional[int] = None) -> List["FeatureSet"]:
         """Epoch slicing: split into sub-epoch FeatureSets (DiskFeatureSet's

@@ -30,6 +30,20 @@ from ..nn.topology import Sequential
 from .onnx_proto import Graph, Node, decode_model
 
 
+def _host_axes(n: Node, ins) -> tuple:
+    """Axes for Squeeze/Unsqueeze: opset>=13 passes them as input[1], older
+    opsets as the 'axes' attribute."""
+    if len(ins) > 1 and ins[1] is not None:
+        return tuple(int(a) for a in np.asarray(ins[1]))
+    return tuple(n.attr("axes", ()))
+
+
+def _np_unsqueeze(x: np.ndarray, axes: tuple) -> np.ndarray:
+    for a in sorted(axes):
+        x = np.expand_dims(x, a)
+    return x
+
+
 def _pads_to_jax(pads: Sequence[int], n_spatial: int):
     """ONNX pads [b1..bn, e1..en] → [(b1,e1)...]; None → zeros."""
     if not pads:
@@ -59,9 +73,8 @@ class _Executor:
         "Sub": lambda n, ins: ins[0] - ins[1],
         "Mul": lambda n, ins: ins[0] * ins[1],
         "Squeeze": lambda n, ins: np.squeeze(
-            ins[0], axis=tuple(n.attr("axes", ())) or None),
-        "Unsqueeze": lambda n, ins: np.expand_dims(
-            ins[0], tuple(n.attr("axes", (0,)))[0]),
+            ins[0], axis=_host_axes(n, ins) or None),
+        "Unsqueeze": lambda n, ins: _np_unsqueeze(ins[0], _host_axes(n, ins)),
         "Identity": lambda n, ins: ins[0],
     }
 

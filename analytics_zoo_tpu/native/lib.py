@@ -60,30 +60,55 @@ def _load():
         if so is None:
             _build_failed = True
             return None
-        lib = ctypes.CDLL(so)
-        lib.arena_create.restype = ctypes.c_void_p
-        lib.arena_create.argtypes = [ctypes.c_size_t, ctypes.c_char_p]
-        lib.arena_alloc.restype = ctypes.c_int64
-        lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
-        lib.arena_base.restype = ctypes.POINTER(ctypes.c_uint8)
-        lib.arena_base.argtypes = [ctypes.c_void_p]
-        for fn in ("arena_used", "arena_capacity"):
-            getattr(lib, fn).restype = ctypes.c_int64
-            getattr(lib, fn).argtypes = [ctypes.c_void_p]
-        lib.arena_reset.argtypes = [ctypes.c_void_p]
-        lib.arena_destroy.argtypes = [ctypes.c_void_p]
-        lib.arena_flush.restype = ctypes.c_int
-        lib.arena_flush.argtypes = [ctypes.c_void_p]
-        lib.gather_rows.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_void_p, ctypes.c_int]
-        lib.scale_shift_f32.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_float,
-            ctypes.c_float, ctypes.c_int]
-        lib.zoo_native_abi_version.restype = ctypes.c_int
-        assert lib.zoo_native_abi_version() == 1
-        _lib = lib
+        try:
+            _lib = _bind(ctypes.CDLL(so))
+        except Exception as e:
+            # bad/foreign-arch/stale-ABI .so: try one rebuild, else fall back
+            log.warning("prebuilt native lib unusable (%s); %s", e,
+                        "rebuilding" if os.path.exists(_SRC) else
+                        "using numpy fallback")
+            try:
+                os.remove(so)
+            except OSError:
+                pass
+            rebuilt = _compile() if os.path.exists(_SRC) else None
+            if rebuilt is None:
+                _build_failed = True
+                return None
+            try:
+                _lib = _bind(ctypes.CDLL(rebuilt))
+            except Exception as e2:
+                log.warning("rebuilt native lib unusable (%s); numpy fallback", e2)
+                _build_failed = True
+                return None
         return _lib
+
+
+def _bind(lib):
+    """Declare signatures + ABI check; raises on any mismatch (caller handles)."""
+    lib.arena_create.restype = ctypes.c_void_p
+    lib.arena_create.argtypes = [ctypes.c_size_t, ctypes.c_char_p]
+    lib.arena_alloc.restype = ctypes.c_int64
+    lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.arena_base.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.arena_base.argtypes = [ctypes.c_void_p]
+    for fn in ("arena_used", "arena_capacity"):
+        getattr(lib, fn).restype = ctypes.c_int64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.arena_reset.argtypes = [ctypes.c_void_p]
+    lib.arena_destroy.argtypes = [ctypes.c_void_p]
+    lib.arena_flush.restype = ctypes.c_int
+    lib.arena_flush.argtypes = [ctypes.c_void_p]
+    lib.gather_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int]
+    lib.scale_shift_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_float,
+        ctypes.c_float, ctypes.c_int]
+    lib.zoo_native_abi_version.restype = ctypes.c_int
+    if lib.zoo_native_abi_version() != 1:
+        raise RuntimeError("zoo_native ABI version mismatch")
+    return lib
 
 
 def native_available() -> bool:
@@ -159,6 +184,11 @@ class HostArena:
                 raise OSError("msync failed")
 
     def close(self):
+        """EXPLICITLY unmap the arena. Every array returned by :meth:`alloc`
+        becomes invalid (views point into the unmapped region — reading them
+        afterwards is undefined). There is deliberately no ``__del__``: GC-time
+        munmap under live numpy views would segfault; an unclosed arena is
+        reclaimed at process exit instead."""
         if self._lib is not None and self._handle and self._handle.value:
             self._lib.arena_destroy(self._handle)
             self._handle = ctypes.c_void_p(None)
@@ -169,12 +199,6 @@ class HostArena:
 
     def __exit__(self, *exc):
         self.close()
-
-    def __del__(self):  # pragma: no cover - gc timing
-        try:
-            self.close()
-        except Exception:
-            pass
 
 
 def gather_rows(src: np.ndarray, indices: np.ndarray,

@@ -123,12 +123,21 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
     specs shard batch over dp/fsdp, sequence over sp, heads over tp — so tensor and
     sequence parallelism compose.
     """
-    if strategy not in ("auto", "full", "ring", "ulysses"):
+    if strategy not in ("auto", "full", "flash", "ring", "ulysses"):
         raise ValueError(f"unknown attention strategy {strategy!r}; "
-                         "known: auto, full, ring, ulysses")
+                         "known: auto, full, flash, ring, ulysses")
     sp = mesh.shape[seq_axis]
     if strategy == "auto":
         strategy = "ring" if sp > 1 else "full"
+    if strategy == "flash":
+        if sp > 1:
+            raise ValueError(
+                "strategy='flash' is a single-device kernel; on a sequence-"
+                "parallel mesh (sp>1) use 'ring' (blockwise over the sp ring) "
+                "or 'ulysses'")
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal)
     if strategy == "full" or sp == 1:
         return full_attention(q, k, v, causal=causal)
 

@@ -1,0 +1,222 @@
+"""Blockwise (flash) attention — the pallas TPU kernel (SURVEY.md §7 hard parts:
+"ring attention / SP pallas kernel").
+
+Forward: tiled online-softmax. Grid (B·H, T_q/block_q, T_kv/block_k); each
+program folds one K/V tile into fp32 VMEM accumulators (m, l, acc), writing the
+normalized output on the last K tile. Q·Kᵀ and P·V hit the MXU per tile; scores
+never materialize in HBM — peak memory O(block_q · block_k) per core instead of
+O(T²). Causal masking skips fully-future K tiles (no wasted tiles beyond the
+diagonal).
+
+Backward: custom VJP recomputing probabilities from the saved log-sum-exp
+(standard flash recompute: P = exp(S − lse)), expressed in plain jnp so XLA
+fuses it; combine with ``jax.checkpoint`` or the ring path
+(:mod:`analytics_zoo_tpu.ops.attention`) for long-sequence training.
+
+Layout: (B, T, H, D) like the other attention strategies. On non-TPU backends
+the kernel runs in interpreter mode (tests) or falls back to full attention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
+                block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def body():
+        q = q_ref[0].astype(jnp.float32)            # (block_q, D)
+        k = k_ref[0].astype(jnp.float32)            # (block_k, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]                      # (block_q, 1)
+        l_prev = l_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                      # (block_q, block_k)
+        l_new = l_prev * corr + p.sum(axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # skip K tiles strictly in the future of every query in this Q tile
+        @pl.when(kb * block_k <= qi * block_q + block_q - 1)
+        def _():
+            body()
+    else:
+        body()
+
+    @pl.when(kb == nk - 1)
+    def _finish():
+        l = l_scr[:, 0:1]
+        safe_l = jnp.where(l == 0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        # lse block spans the FULL row (TPU tiling: last-two block dims must
+        # divide (8,128) or equal the array dims); each q-tile writes its slice
+        lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = \
+            m_scr[:, 0] + jnp.log(safe_l[:, 0])
+
+
+try:  # pallas import kept optional: CPU-only deployments fall back to jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover - environment without pallas
+    _HAS_PALLAS = False
+
+
+def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
+               interpret: bool):
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    scale = 1.0 / float(np.sqrt(d))
+    # (B, T, H, D) -> (B*H, T, D)
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, t_q, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    nq = t_q // block_q
+    nk = t_k // block_k
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, t_q), lambda bh, qi, kb: (bh, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, t_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        # qi is NOT parallel: the lse out-block (one full row per bh) is
+        # revisited by every qi step; parallel execution over qi would give
+        # each core its own copy of the row and clobber other cores' slices
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qh, kh, vh)
+    out4 = out.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
+    lse4 = lse.reshape(b, h, t_q)
+    return out4, lse4.astype(jnp.float32)
+
+
+def _flash_bwd(q, k, v, o, lse, g, *, causal: bool):
+    """Flash backward via lse recompute (one pass, fused by XLA)."""
+    b, t_q, h, d = q.shape
+    scale = 1.0 / float(np.sqrt(d))
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        q_pos = jnp.arange(t_q)[:, None]
+        k_pos = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])                      # (B,H,Tq,Tk)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vf)
+    delta = jnp.sum(gf * of, axis=-1).transpose(0, 2, 1)  # (B,H,Tq)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Blockwise attention, (B, T, H, D) → (B, T, H, D).
+
+    Falls back to plain fused attention when pallas is unavailable or the
+    sequence does not tile evenly (the caller may pad instead).
+    """
+    out, _ = _flash_attention_fwd_res(q, k, v, causal, block_q, block_k,
+                                      interpret)
+    return out
+
+
+def _tiles_ok(q, k, block_q, block_k):
+    return (q.shape[1] % block_q == 0 and k.shape[1] % block_k == 0)
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _flash_attention_fwd_res(q, k, v, causal, block_q, block_k, interpret):
+    from .attention import full_attention
+
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    if not _HAS_PALLAS or not _tiles_ok(q, k, block_q, block_k):
+        out = full_attention(q, k, v, causal=causal)
+        return out, None
+    interpret = _interpret_default() if interpret is None else interpret
+    out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, res = _flash_attention_fwd_res(q, k, v, causal, block_q, block_k,
+                                        interpret)
+    if res is None:  # fallback path: save inputs, recompute via full attention
+        res = (q, k, v, None, None)
+    return out, res
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    if lse is None:
+        from .attention import full_attention
+
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: full_attention(q_, k_, v_, causal=causal),
+            q, k, v)
+        return vjp(g)
+    return _flash_bwd(q, k, v, out, lse, g, causal=causal)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
