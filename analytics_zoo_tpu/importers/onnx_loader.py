@@ -38,10 +38,19 @@ def _host_axes(n: Node, ins) -> tuple:
     return tuple(n.attr("axes", ()))
 
 
-def _np_unsqueeze(x: np.ndarray, axes: tuple) -> np.ndarray:
-    for a in sorted(axes):
-        x = np.expand_dims(x, a)
+def _unsqueeze(x, axes: tuple, xp=np):
+    """ONNX Unsqueeze: axes are positions in the OUTPUT rank (so negative axes
+    resolve against ndim + len(axes), not intermediate ranks). Shared by the
+    host-constant and traced paths."""
+    out_rank = x.ndim + len(axes)
+    resolved = sorted(a % out_rank for a in axes)
+    for a in resolved:
+        x = xp.expand_dims(x, a)
     return x
+
+
+def _np_unsqueeze(x: np.ndarray, axes: tuple) -> np.ndarray:
+    return _unsqueeze(x, axes, np)
 
 
 def _pads_to_jax(pads: Sequence[int], n_spatial: int):
@@ -271,19 +280,10 @@ class _Executor:
         return jnp.concatenate(ins, axis=int(n.attr("axis", 0)))
 
     def op_Squeeze(self, n, ins):
-        axes = (tuple(int(a) for a in np.asarray(ins[1]))
-                if len(ins) > 1 and ins[1] is not None
-                else tuple(n.attr("axes", ())))
-        return jnp.squeeze(ins[0], axis=axes or None)
+        return jnp.squeeze(ins[0], axis=_host_axes(n, ins) or None)
 
     def op_Unsqueeze(self, n, ins):
-        axes = (tuple(int(a) for a in np.asarray(ins[1]))
-                if len(ins) > 1 and ins[1] is not None
-                else tuple(n.attr("axes", ())))
-        x = ins[0]
-        for a in sorted(axes):
-            x = jnp.expand_dims(x, a)
-        return x
+        return _unsqueeze(ins[0], _host_axes(n, ins), jnp)
 
     def op_Shape(self, n, ins):
         # host-side numpy constant, NOT a jnp array: shapes are static under

@@ -1,0 +1,154 @@
+"""Keras-2-style API facade (reference ``zoo/.../api/keras2/`` +
+``pyzoo/zoo/pipeline/api/keras2/``: the keras-2 naming/argument conventions on
+top of the keras-1-style core — ``units``/``filters``/``rate``/``kernel_size``
+instead of ``output_dim``/``nb_filter``/``p``).
+
+Every symbol is a thin constructor adapter over the canonical layer library, so
+keras2 and keras1 layers mix freely in one model.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+from ..nn import layers as L
+from ..nn.graph import Input
+from ..nn.topology import Model, Sequential
+
+__all__ = ["Dense", "Dropout", "Activation", "Flatten", "Reshape",
+           "Conv1D", "Conv2D", "MaxPooling1D", "MaxPooling2D",
+           "AveragePooling1D", "AveragePooling2D", "GlobalAveragePooling2D",
+           "GlobalMaxPooling2D", "BatchNormalization", "LayerNormalization",
+           "Embedding", "LSTM", "GRU", "SimpleRNN", "Bidirectional",
+           "TimeDistributed", "Concatenate", "Add", "Multiply", "Maximum",
+           "Average", "Input", "Model", "Sequential", "InputLayer"]
+
+InputLayer = L.InputLayer
+Activation = L.Activation
+Flatten = L.Flatten
+Reshape = L.Reshape
+Bidirectional = L.Bidirectional
+TimeDistributed = L.TimeDistributed
+LayerNormalization = L.LayerNormalization
+GlobalAveragePooling2D = L.GlobalAveragePooling2D
+GlobalMaxPooling2D = L.GlobalMaxPooling2D
+
+
+def _pair(v) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def Dense(units: int, activation=None, use_bias: bool = True,
+          kernel_initializer="glorot_uniform", input_shape=None, name=None):
+    return L.Dense(units, activation=activation, use_bias=use_bias,
+                   init=kernel_initializer, input_shape=input_shape, name=name)
+
+
+def Dropout(rate: float, name=None, input_shape=None):
+    return L.Dropout(rate, name=name, input_shape=input_shape)
+
+
+def Conv1D(filters: int, kernel_size: int, strides: int = 1,
+           padding: str = "valid", activation=None, use_bias: bool = True,
+           input_shape=None, name=None):
+    return L.Convolution1D(filters, kernel_size, activation=activation,
+                           border_mode=padding, subsample_length=strides,
+                           use_bias=use_bias, input_shape=input_shape,
+                           name=name)
+
+
+def Conv2D(filters: int, kernel_size, strides=(1, 1), padding: str = "valid",
+           activation=None, use_bias: bool = True, input_shape=None, name=None):
+    kh, kw = _pair(kernel_size)
+    return L.Convolution2D(filters, kh, kw, activation=activation,
+                           border_mode=padding, subsample=_pair(strides),
+                           use_bias=use_bias, input_shape=input_shape,
+                           name=name)
+
+
+def MaxPooling1D(pool_size: int = 2, strides: Optional[int] = None,
+                 padding: str = "valid", name=None, input_shape=None):
+    return L.MaxPooling1D(pool_length=pool_size, stride=strides,
+                          border_mode=padding, name=name,
+                          input_shape=input_shape)
+
+
+def AveragePooling1D(pool_size: int = 2, strides: Optional[int] = None,
+                     padding: str = "valid", name=None, input_shape=None):
+    return L.AveragePooling1D(pool_length=pool_size, stride=strides,
+                              border_mode=padding, name=name,
+                              input_shape=input_shape)
+
+
+def MaxPooling2D(pool_size=(2, 2), strides=None, padding: str = "valid",
+                 name=None, input_shape=None):
+    return L.MaxPooling2D(pool_size=_pair(pool_size),
+                          strides=None if strides is None else _pair(strides),
+                          border_mode=padding, name=name,
+                          input_shape=input_shape)
+
+
+def AveragePooling2D(pool_size=(2, 2), strides=None, padding: str = "valid",
+                     name=None, input_shape=None):
+    return L.AveragePooling2D(pool_size=_pair(pool_size),
+                              strides=None if strides is None else _pair(strides),
+                              border_mode=padding, name=name,
+                              input_shape=input_shape)
+
+
+def BatchNormalization(momentum: float = 0.99, epsilon: float = 1e-3,
+                       name=None, input_shape=None):
+    return L.BatchNormalization(momentum=momentum, epsilon=epsilon, name=name,
+                                input_shape=input_shape)
+
+
+def Embedding(input_dim: int, output_dim: int, input_length=None,
+              embeddings_initializer="uniform", name=None):
+    shape = (input_length,) if input_length is not None else None
+    return L.Embedding(input_dim, output_dim, init=embeddings_initializer,
+                       name=name, input_shape=shape)
+
+
+def LSTM(units: int, activation="tanh", recurrent_activation="hard_sigmoid",
+         return_sequences: bool = False, go_backwards: bool = False,
+         name=None, input_shape=None):
+    return L.LSTM(units, activation=activation,
+                  inner_activation=recurrent_activation,
+                  return_sequences=return_sequences, go_backwards=go_backwards,
+                  name=name, input_shape=input_shape)
+
+
+def GRU(units: int, activation="tanh", recurrent_activation="hard_sigmoid",
+        return_sequences: bool = False, go_backwards: bool = False,
+        name=None, input_shape=None):
+    return L.GRU(units, activation=activation,
+                 inner_activation=recurrent_activation,
+                 return_sequences=return_sequences, go_backwards=go_backwards,
+                 name=name, input_shape=input_shape)
+
+
+def SimpleRNN(units: int, activation="tanh", return_sequences: bool = False,
+              name=None, input_shape=None):
+    return L.SimpleRNN(units, activation=activation,
+                       return_sequences=return_sequences, name=name,
+                       input_shape=input_shape)
+
+
+def Concatenate(axis: int = -1, name=None):
+    return L.Merge(mode="concat", concat_axis=axis, name=name)
+
+
+def Add(name=None):
+    return L.Merge(mode="sum", name=name)
+
+
+def Multiply(name=None):
+    return L.Merge(mode="mul", name=name)
+
+
+def Maximum(name=None):
+    return L.Merge(mode="max", name=name)
+
+
+def Average(name=None):
+    return L.Merge(mode="ave", name=name)

@@ -1,0 +1,3 @@
+from .bert_classifier import BERTClassifier
+
+__all__ = ["BERTClassifier"]

@@ -67,11 +67,16 @@ def _load():
             log.warning("prebuilt native lib unusable (%s); %s", e,
                         "rebuilding" if os.path.exists(_SRC) else
                         "using numpy fallback")
-            try:
-                os.remove(so)
-            except OSError:
-                pass
-            rebuilt = _compile() if os.path.exists(_SRC) else None
+            if os.path.exists(_SRC):
+                # only discard the .so when we can rebuild it — a transient
+                # dlopen failure must not destroy a shipped prebuilt forever
+                try:
+                    os.remove(so)
+                except OSError:
+                    pass
+                rebuilt = _compile()
+            else:
+                rebuilt = None
             if rebuilt is None:
                 _build_failed = True
                 return None

@@ -137,7 +137,15 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
                 "or 'ulysses'")
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal)
+        # batch/head parallelism is embarrassingly parallel for attention:
+        # shard_map keeps each device's kernel on its OWN batch/head shard
+        # (without it GSPMD would all-gather q/k/v and replicate the work)
+        spec = P(batch_axes, None, head_axis, None)
+        wrapped = jax.shard_map(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return wrapped(q, k, v)
     if strategy == "full" or sp == 1:
         return full_attention(q, k, v, causal=causal)
 

@@ -1,0 +1,18 @@
+"""Shared example bootstrap: repo on sys.path, CPU fallback, small sizes."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def force_cpu_if_no_tpu():
+    import jax
+
+    try:
+        jax.devices("tpu")
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+
+
+SMOKE = os.environ.get("ZOO_EXAMPLE_SMOKE", "0") == "1"

@@ -1,0 +1,45 @@
+"""Distributed training over a multi-device mesh — dp×fsdp×tp×sp shardings
+(the AllReduceParameter/DistriOptimizer replacement, SURVEY.md §2.2).
+
+Runs on a virtual 8-device CPU mesh so it works on any machine; the SAME code
+drives a real TPU pod (the mesh axes map to ICI)."""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from _common import SMOKE  # noqa: E402  (sys.path setup)
+
+import numpy as np  # noqa: E402
+
+from analytics_zoo_tpu.common.config import MeshConfig, RuntimeConfig  # noqa: E402
+from analytics_zoo_tpu.common.context import init_zoo_context  # noqa: E402
+from analytics_zoo_tpu.engine.estimator import Estimator  # noqa: E402
+from analytics_zoo_tpu.models.transformer import TransformerLM, lm_loss  # noqa: E402
+from analytics_zoo_tpu.parallel import make_param_sharding  # noqa: E402
+
+
+def main():
+    ctx = init_zoo_context(RuntimeConfig(
+        platform="cpu", mesh=MeshConfig(dp=2, fsdp=2, tp=2, sp=1)))
+    print("mesh:", dict(zip(ctx.mesh.axis_names, ctx.mesh.devices.shape)))
+
+    vocab, seq = 512, 64
+    model = TransformerLM(vocab=vocab, hidden_size=64, n_block=2, n_head=4,
+                          seq_len=seq)
+    est = Estimator(model, optimizer="adam", loss=lm_loss, mesh=ctx.mesh,
+                    param_sharding=make_param_sharding(ctx.mesh))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (256, seq + 1))
+    x, y = ids[:, :-1], ids[:, 1:]
+    from analytics_zoo_tpu.data.featureset import FeatureSet
+
+    est.fit(FeatureSet.from_numpy(x, y), batch_size=32,
+            epochs=1 if SMOKE else 2)
+    print("done; final step:", int(est.train_state["step"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Keras2 facade + BERTClassifier tests, and smoke runs of the example scripts
+(the reference's run-example-tests*.sh / app-test capability, SURVEY.md §2.9)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+# ------------------------------------------------------------------- keras2
+def test_keras2_sequential_trains():
+    from analytics_zoo_tpu import keras2 as k2
+
+    m = k2.Sequential()
+    m.add(k2.InputLayer((6,)))
+    m.add(k2.Dense(16, activation="relu"))
+    m.add(k2.Dropout(rate=0.1))
+    m.add(k2.Dense(2, activation="softmax"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 6)).astype("float32")
+    y = (x.sum(1) > 0).astype("int32")
+    m.fit(x, y, batch_size=32, nb_epoch=2)
+    assert m.predict(x).shape == (64, 2)
+
+
+def test_keras2_conv_pool_names():
+    from analytics_zoo_tpu import keras2 as k2
+
+    m = k2.Sequential()
+    m.add(k2.InputLayer((16, 16, 3)))
+    m.add(k2.Conv2D(filters=4, kernel_size=3, padding="same", activation="relu"))
+    m.add(k2.MaxPooling2D(pool_size=2))
+    m.add(k2.BatchNormalization(momentum=0.9))
+    m.add(k2.Flatten())
+    m.add(k2.Dense(units=2))
+    m.compile(optimizer="adam", loss="mse")
+    x = np.random.default_rng(0).standard_normal((4, 16, 16, 3)).astype("float32")
+    assert m.predict(x).shape == (4, 2)
+
+
+def test_keras2_functional_merge():
+    from analytics_zoo_tpu import keras2 as k2
+
+    a = k2.Input((4,))
+    b = k2.Input((4,))
+    ha = k2.Dense(8, activation="relu")(a)
+    hb = k2.Dense(8, activation="relu")(b)
+    merged = k2.Concatenate()([ha, hb])
+    out = k2.Dense(1)(merged)
+    m = k2.Model([a, b], out)
+    m.compile(optimizer="adam", loss="mse")
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal((8, 4)).astype("float32") for _ in range(2)]
+    assert m.predict(xs).shape == (8, 1)
+
+
+# ------------------------------------------------------------------- BERT
+def test_bert_classifier_fit_and_roundtrip(tmp_path):
+    from analytics_zoo_tpu.models.text import BERTClassifier
+
+    model = BERTClassifier(num_classes=3, vocab=100, hidden_size=32, n_block=1,
+                           n_head=2, seq_len=16)
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 100, (32, 16)).astype("int32")
+    labels = rng.integers(0, 3, 32).astype("int32")
+    model.fit(ids, labels, batch_size=16, nb_epoch=1)
+    probs = model.predict(ids)
+    assert probs.shape == (32, 3)
+    np.testing.assert_allclose(np.asarray(probs).sum(-1), 1.0, rtol=1e-3)
+    p = str(tmp_path / "bert")
+    model.save_model(p)
+    loaded = BERTClassifier.load_model(p)
+    loaded.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    np.testing.assert_allclose(np.asarray(loaded.predict(ids)),
+                               np.asarray(probs), atol=1e-4)
+
+
+# ------------------------------------------------------- example smoke runs
+CHEAP_EXAMPLES = [
+    "ncf_recommendation.py",
+    "wide_and_deep.py",
+    "anomaly_detection.py",
+    "text_classification.py",
+    "nnframes_dataframe.py",
+    "custom_loss_autograd.py",
+    "onnx_import.py",
+    "transformer_lm.py",
+    "autots_forecast.py",
+    "serving_quickstart.py",
+    "distributed_training.py",
+]
+
+
+@pytest.mark.parametrize("script", CHEAP_EXAMPLES)
+def test_example_smoke(script):
+    env = dict(os.environ, ZOO_EXAMPLE_SMOKE="1", JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO)
+    r = subprocess.run([sys.executable, script], cwd=EXAMPLES, env=env,
+                       capture_output=True, timeout=420)
+    assert r.returncode == 0, (
+        f"{script} failed:\n{r.stdout.decode()[-1500:]}\n"
+        f"{r.stderr.decode()[-2500:]}")

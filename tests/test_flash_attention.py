@@ -86,3 +86,46 @@ def test_flash_strategy_dispatch():
     want = full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5,
                                rtol=2e-5)
+
+
+def test_flash_strategy_keeps_dp_sharding():
+    """Under a dp-sharded mesh the flash output must stay sharded over dp
+    (regression: unwrapped pallas_call let GSPMD replicate the whole batch)."""
+    import jax.sharding as shd
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from analytics_zoo_tpu.ops.attention import sharded_attention
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device CPU mesh")
+    devs = np.array(jax.devices()[:4]).reshape(4, 1, 1, 1, 1, 1)
+    mesh = shd.Mesh(devs, ("dp", "fsdp", "tp", "sp", "pp", "ep"))
+    q, k, v = make_qkv(b=8, t=32)
+    spec = P(("dp", "fsdp"), None, "tp", None)
+    qs, ks, vs = (jax.device_put(a, NamedSharding(mesh, spec))
+                  for a in (q, k, v))
+
+    @jax.jit
+    def f(q, k, v):
+        return sharded_attention(q, k, v, mesh, strategy="flash", causal=True)
+
+    got = f(qs, ks, vs)
+    assert got.sharding.spec == spec
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_flash_sp_mesh_rejected():
+    import jax.sharding as shd
+
+    from analytics_zoo_tpu.ops.attention import sharded_attention
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    devs = np.array(jax.devices()[:2]).reshape(1, 1, 1, 2, 1, 1)
+    mesh = shd.Mesh(devs, ("dp", "fsdp", "tp", "sp", "pp", "ep"))
+    q, k, v = make_qkv(t=32)
+    with pytest.raises(ValueError, match="single-device kernel"):
+        sharded_attention(q, k, v, mesh, strategy="flash")

@@ -192,6 +192,20 @@ def test_onnx_shape_gather_concat_reshape_chain():
     np.testing.assert_array_equal(model.predict(x), x.reshape(2, -1))
 
 
+def test_onnx_unsqueeze_multiple_negative_axes():
+    """ONNX semantics: axes index the OUTPUT rank — axes=[-1,-2] on (3,4) gives
+    (3,4,1,1), not (3,1,4,1)."""
+    g = Graph(name="unsq")
+    g.inputs = [ValueInfo("x", (3, 4))]
+    g.outputs = [ValueInfo("y", ())]
+    g.nodes = [Node("Unsqueeze", ["x"], ["y"], attrs={
+        "axes": Attribute(name="axes", ints=(-1, -2))})]
+    model = load_onnx(encode_model(g))
+    model.compile(optimizer="adam", loss="mse")
+    out = model.predict(np.zeros((3, 4), dtype="float32"))
+    assert out.shape == (3, 4, 1, 1), out.shape
+
+
 def test_onnx_unsupported_op_raises():
     g = Graph(name="bad")
     g.inputs = [ValueInfo("x", (None, 2))]
