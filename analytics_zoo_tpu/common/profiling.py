@@ -1,0 +1,62 @@
+"""Profiling / tracing helpers (SURVEY.md §5.1).
+
+The reference only has ``timing(label){...}`` wall-time logs and BigDL's driver
+metrics; on TPU the right tool is the XLA profiler (xprof traces viewable in
+TensorBoard / Perfetto). This module wraps it with the same ergonomic surface
+as the reference's ``timing`` blocks, plus a step-window helper.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Iterator, Optional
+
+log = logging.getLogger("analytics_zoo_tpu")
+
+
+@contextlib.contextmanager
+def xprof_trace(log_dir: str) -> Iterator[None]:
+    """Capture an XLA profiler trace into ``log_dir`` (open with TensorBoard's
+    profile plugin or Perfetto)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region inside a trace (TraceAnnotation) + wall-time log — the
+    ``timing`` block (InferenceSupportive.scala) upgraded with xprof context."""
+    import jax
+
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    log.info("%s: %.1f ms", name, (time.perf_counter() - t0) * 1e3)
+
+
+def profile_steps(step_fn, args_iter, log_dir: str, *, warmup: int = 2,
+                  steps: int = 5):
+    """Run ``step_fn`` over batches from ``args_iter``: ``warmup`` untraced
+    steps (compile + cache), then ``steps`` traced ones. Returns the traced
+    steps' median wall time in ms."""
+    import jax
+
+    times = []
+    it = iter(args_iter)
+    for _ in range(warmup):
+        jax.block_until_ready(step_fn(*next(it)))
+    with xprof_trace(log_dir):
+        for i in range(steps):
+            with jax.profiler.StepTraceAnnotation("step", step_num=i):
+                t0 = time.perf_counter()
+                jax.block_until_ready(step_fn(*next(it)))
+                times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2] * 1e3

@@ -12,6 +12,7 @@ from .embedding import Embedding, SparseEmbedding, WordEmbedding
 from .merge import Merge, merge
 from .normalization import BatchNormalization, LayerNormalization
 from .recurrent import (GRU, LSTM, Bidirectional, SimpleRNN, TimeDistributed)
+from .moe import MoE
 
 Conv1D = Convolution1D
 Conv2D = Convolution2D
@@ -22,7 +23,7 @@ __all__ = [
     "DepthwiseConv2D", "Dropout", "Embedding", "ExpandDim", "Flatten", "GRU", "GaussianDropout",
     "GaussianNoise", "GlobalAveragePooling1D", "GlobalAveragePooling2D",
     "GlobalMaxPooling1D", "GlobalMaxPooling2D", "InputLayer", "LSTM", "Lambda",
-    "LayerNormalization", "Masking", "MaxPooling1D", "MaxPooling2D", "Merge",
+    "LayerNormalization", "Masking", "MaxPooling1D", "MaxPooling2D", "Merge", "MoE",
     "Narrow", "Permute", "RepeatVector", "Reshape", "Select", "SimpleRNN",
     "SparseDense", "SparseEmbedding", "Squeeze", "TimeDistributed", "UpSampling2D",
     "WordEmbedding", "ZeroPadding2D", "merge",

@@ -139,7 +139,15 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
 
         # batch/head parallelism is embarrassingly parallel for attention:
         # shard_map keeps each device's kernel on its OWN batch/head shard
-        # (without it GSPMD would all-gather q/k/v and replicate the work)
+        # (without it GSPMD would all-gather q/k/v and replicate the work).
+        # shard_map needs exact divisibility; shapes that don't split fall
+        # back to the unwrapped kernel (GSPMD handles them, possibly with
+        # gathers — correct, just not maximally parallel).
+        batch_div = 1
+        for a in batch_axes:
+            batch_div *= mesh.shape[a]
+        if q.shape[0] % batch_div or q.shape[2] % mesh.shape[head_axis]:
+            return flash_attention(q, k, v, causal)
         spec = P(batch_axes, None, head_axis, None)
         wrapped = jax.shard_map(
             lambda q_, k_, v_: flash_attention(q_, k_, v_, causal),

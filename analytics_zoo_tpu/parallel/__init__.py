@@ -9,8 +9,10 @@ from ..common.context import build_mesh
 from ..ops.attention import (full_attention, ring_attention_local,
                              sharded_attention, ulysses_attention_local)
 from .sharding import TP_RULES, make_param_sharding, replicated
+from .pipeline import pipeline_apply, stack_stage_params
 
 __all__ = [
+    "pipeline_apply", "stack_stage_params",
     "TP_RULES", "build_mesh", "full_attention", "make_param_sharding",
     "replicated", "ring_attention_local", "sharded_attention",
     "ulysses_attention_local",

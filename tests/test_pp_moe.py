@@ -1,0 +1,222 @@
+"""Pipeline parallelism + MoE/expert parallelism tests on the virtual 8-device
+CPU mesh (SURVEY.md §2.2 PP and EP rows)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from analytics_zoo_tpu.nn.layers import MoE
+from analytics_zoo_tpu.parallel import pipeline_apply, stack_stage_params
+
+
+def make_mesh(pp=4):
+    devs = jax.devices()
+    if len(devs) < pp:
+        pytest.skip(f"needs {pp} devices")
+    arr = np.array(devs[:pp]).reshape(1, 1, 1, 1, pp, 1)
+    return Mesh(arr, ("dp", "fsdp", "tp", "sp", "pp", "ep"))
+
+
+def mlp_stage(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def make_stage_params(n_stages, d, hidden, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_stages):
+        out.append({
+            "w1": jnp.asarray(rng.standard_normal((d, hidden)) * 0.3, jnp.float32),
+            "b1": jnp.zeros(hidden, jnp.float32),
+            "w2": jnp.asarray(rng.standard_normal((hidden, d)) * 0.3, jnp.float32),
+            "b2": jnp.zeros(d, jnp.float32),
+        })
+    return out
+
+
+def sequential_reference(params_list, x):
+    for p in params_list:
+        x = mlp_stage(p, x)
+    return x
+
+
+@pytest.mark.parametrize("n_micro", [4, 8])
+def test_pipeline_matches_sequential(n_micro):
+    mesh = make_mesh(pp=4)
+    d, hidden = 8, 16
+    params_list = make_stage_params(4, d, hidden)
+    stacked = stack_stage_params(params_list)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((16, d)),
+                    jnp.float32)
+    got = pipeline_apply(mlp_stage, stacked, x, mesh, n_microbatches=n_micro)
+    want = sequential_reference(params_list, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5,
+                               rtol=1e-5)
+
+
+def test_pipeline_differentiable():
+    mesh = make_mesh(pp=4)
+    d, hidden = 4, 8
+    params_list = make_stage_params(4, d, hidden)
+    stacked = stack_stage_params(params_list)
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((8, d)),
+                    jnp.float32)
+
+    def loss_pp(p):
+        return jnp.sum(pipeline_apply(mlp_stage, p, x, mesh,
+                                      n_microbatches=4) ** 2)
+
+    def loss_seq(pl):
+        return jnp.sum(sequential_reference(pl, x) ** 2)
+
+    g_pp = jax.grad(loss_pp)(stacked)
+    g_seq = jax.grad(loss_seq)(params_list)
+    g_seq_stacked = stack_stage_params(g_seq)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-4, rtol=1e-4),
+        g_pp, g_seq_stacked)
+
+
+def test_pipeline_rejects_bad_microbatch():
+    mesh = make_mesh(pp=4)
+    stacked = stack_stage_params(make_stage_params(4, 4, 8))
+    x = jnp.zeros((10, 4))
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_apply(mlp_stage, stacked, x, mesh, n_microbatches=3)
+
+
+# ------------------------------------------------------------------- MoE
+def test_moe_forward_shapes_and_aux_loss():
+    layer = MoE(hidden_size=16, n_experts=4, intermediate_size=32, top_k=2)
+    params, state = layer.build(jax.random.PRNGKey(0), (None, 16))
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 12, 16)),
+                    jnp.float32)
+    y, new_state = layer.apply(params, state, x)
+    assert y.shape == (2, 12, 16)
+    assert float(new_state["aux_loss"]) > 0
+    # balanced routing on random inputs: aux loss near its minimum of n_experts/top_k...
+    # just require finite and bounded
+    assert float(new_state["aux_loss"]) < 100
+
+
+def test_moe_single_expert_equals_dense_mlp():
+    """With one expert and top_k=1 every token goes through the single MLP —
+    output must equal the plain MLP computation."""
+    layer = MoE(hidden_size=8, n_experts=1, intermediate_size=16, top_k=1,
+                capacity_factor=2.0)
+    params, _ = layer.build(jax.random.PRNGKey(1), (None, 8))
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((1, 6, 8)),
+                    jnp.float32)
+    y, _ = layer.apply(params, {}, x)
+    tok = x.reshape(-1, 8)
+    h = jax.nn.gelu(tok @ params["expert_up"][0] + params["expert_up_bias"][0])
+    want = (h @ params["expert_down"][0] + params["expert_down_bias"][0])
+    np.testing.assert_allclose(np.asarray(y.reshape(-1, 8)), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_moe_matches_dense_mixture_with_ample_capacity():
+    """With capacity >> tokens, MoE must equal the dense top-k mixture:
+    y = Σ_slot gate·MLP_expert(token). Regression: per-slot capacity counters
+    let slot-0/slot-1 tokens collide on one expert slot and get summed."""
+    layer = MoE(hidden_size=8, n_experts=3, intermediate_size=16, top_k=2,
+                capacity_factor=8.0)
+    params, _ = layer.build(jax.random.PRNGKey(5), (None, 8))
+    x = jnp.asarray(np.random.default_rng(5).standard_normal((1, 10, 8)),
+                    jnp.float32)
+    y, _ = layer.apply(params, {}, x)
+
+    tok = x.reshape(-1, 8)
+    probs = jax.nn.softmax(tok @ params["router_kernel"], axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, 2)
+    gate_vals = gate_vals / gate_vals.sum(-1, keepdims=True)
+
+    def expert_mlp(e, t):
+        h = jax.nn.gelu(t @ params["expert_up"][e] + params["expert_up_bias"][e])
+        return h @ params["expert_down"][e] + params["expert_down_bias"][e]
+
+    want = np.zeros_like(np.asarray(tok))
+    for i in range(tok.shape[0]):
+        for s in range(2):
+            e = int(gate_idx[i, s])
+            want[i] += float(gate_vals[i, s]) * np.asarray(
+                expert_mlp(e, tok[i]))
+    np.testing.assert_allclose(np.asarray(y.reshape(-1, 8)), want, atol=1e-4,
+                               rtol=1e-4)
+
+
+def test_moe_ep_indivisible_raises():
+    from analytics_zoo_tpu.common.config import MeshConfig, RuntimeConfig
+    from analytics_zoo_tpu.common.context import (init_zoo_context,
+                                                  reset_zoo_context)
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    layer = MoE(hidden_size=8, n_experts=6, top_k=2)  # 6 % 4 != 0
+    params, _ = layer.build(jax.random.PRNGKey(6), (None, 8))
+    x = jnp.zeros((1, 4, 8), jnp.float32)
+    reset_zoo_context()
+    try:
+        init_zoo_context(RuntimeConfig(platform="cpu",
+                                       mesh=MeshConfig(dp=0, ep=4)))
+        with pytest.raises(ValueError, match="not divisible"):
+            layer.apply(params, {}, x)
+    finally:
+        reset_zoo_context()
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """A tiny capacity forces token dropping: dropped tokens produce zeros."""
+    layer = MoE(hidden_size=4, n_experts=2, top_k=1, capacity_factor=0.1)
+    params, _ = layer.build(jax.random.PRNGKey(2), (None, 4))
+    x = jnp.ones((1, 16, 4), jnp.float32)  # identical tokens → same expert
+    y, _ = layer.apply(params, {}, x)
+    # capacity ceil(1*16/2*0.1)=1 per expert → at most 2 tokens served
+    nonzero_rows = int(jnp.sum(jnp.any(jnp.abs(y[0]) > 1e-9, axis=-1)))
+    assert nonzero_rows <= 2
+
+
+def test_moe_differentiable():
+    layer = MoE(hidden_size=8, n_experts=4, top_k=2)
+    params, _ = layer.build(jax.random.PRNGKey(3), (None, 8))
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 8, 8)),
+                    jnp.float32)
+
+    def loss(p):
+        y, st = layer.apply(p, {}, x)
+        return jnp.sum(y ** 2) + 0.01 * st["aux_loss"]
+
+    grads = jax.grad(loss)(params)
+    total = sum(float(jnp.sum(jnp.abs(g)))
+                for g in jax.tree_util.tree_leaves(grads))
+    assert np.isfinite(total) and total > 0
+
+
+def test_moe_under_ep_mesh():
+    """MoE inside jit under an ep>1 mesh context: compiles and matches the
+    no-mesh result."""
+    from analytics_zoo_tpu.common.config import MeshConfig, RuntimeConfig
+    from analytics_zoo_tpu.common.context import (init_zoo_context,
+                                                  reset_zoo_context)
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    layer = MoE(hidden_size=8, n_experts=4, top_k=2)
+    params, _ = layer.build(jax.random.PRNGKey(4), (None, 8))
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((2, 8, 8)),
+                    jnp.float32)
+    y_ref, _ = layer.apply(params, {}, x)
+    reset_zoo_context()
+    try:
+        ctx = init_zoo_context(RuntimeConfig(
+            platform="cpu", mesh=MeshConfig(dp=0, ep=4)))
+        with ctx.mesh:
+            y_ep, _ = jax.jit(lambda p, x: layer.apply(p, {}, x))(params, x)
+        np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                                   atol=1e-5, rtol=1e-5)
+    finally:
+        reset_zoo_context()
