@@ -82,21 +82,26 @@ class GANEstimator:
             # distinct key per D sub-step — d_steps>1 must draw FRESH noise
             k = jax.random.fold_in(state["rng"],
                                    state["step"] * (d_steps + 1) + d_idx)
-            z = jax.random.normal(k, (real.shape[0], noise_dim))
+            kz, kg, kd1, kd2 = jax.random.split(k, 4)
+            z = jax.random.normal(kz, (real.shape[0], noise_dim))
 
             def d_loss(dp):
-                fake, _ = gen.apply(state["g_params"], state["g_state"], z)
-                real_logits, _ = disc.apply(dp, state["d_state"], real,
-                                            training=True, rng=k)
-                fake_logits, _ = disc.apply(dp, state["d_state"],
-                                            jax.lax.stop_gradient(fake),
-                                            training=True, rng=k)
-                return disc_loss_fn(real_logits, fake_logits)
+                # both nets in TRAINING mode throughout — D must train against
+                # the same stochastic G it will face in the G-update
+                fake, _ = gen.apply(state["g_params"], state["g_state"], z,
+                                    training=True, rng=kg)
+                real_logits, d_state = disc.apply(dp, state["d_state"], real,
+                                                  training=True, rng=kd1)
+                fake_logits, d_state = disc.apply(dp, d_state,
+                                                  jax.lax.stop_gradient(fake),
+                                                  training=True, rng=kd2)
+                return disc_loss_fn(real_logits, fake_logits), d_state
 
-            loss, grads = jax.value_and_grad(d_loss)(state["d_params"])
+            (loss, d_state), grads = jax.value_and_grad(d_loss, has_aux=True)(
+                state["d_params"])
             upd, d_opt = disc_tx.update(grads, state["d_opt"], state["d_params"])
             state = dict(state, d_params=optax.apply_updates(state["d_params"], upd),
-                         d_opt=d_opt)
+                         d_opt=d_opt, d_state=d_state)
             return state, loss
 
         def step(state, real):
@@ -106,20 +111,24 @@ class GANEstimator:
 
             k = jax.random.fold_in(state["rng"],
                                    state["step"] * (d_steps + 1) + d_steps)
-            z = jax.random.normal(k, (real.shape[0], noise_dim))
+            kz, kg, kd = jax.random.split(k, 3)
+            z = jax.random.normal(kz, (real.shape[0], noise_dim))
 
             def g_loss(gp):
-                fake, _ = gen.apply(gp, state["g_state"], z, training=True,
-                                    rng=k)
+                fake, g_state = gen.apply(gp, state["g_state"], z,
+                                          training=True, rng=kg)
+                # D also in training mode: G optimizes against the SAME
+                # stochastic discriminator function D was just trained as
                 fake_logits, _ = disc.apply(state["d_params"], state["d_state"],
-                                            fake)
-                return gen_loss_fn(fake_logits)
+                                            fake, training=True, rng=kd)
+                return gen_loss_fn(fake_logits), g_state
 
-            loss, grads = jax.value_and_grad(g_loss)(state["g_params"])
+            (loss, g_state), grads = jax.value_and_grad(g_loss, has_aux=True)(
+                state["g_params"])
             upd, g_opt = gen_tx.update(grads, state["g_opt"], state["g_params"])
             state = dict(state,
                          g_params=optax.apply_updates(state["g_params"], upd),
-                         g_opt=g_opt, step=state["step"] + 1)
+                         g_opt=g_opt, g_state=g_state, step=state["step"] + 1)
             return state, (d_loss_val, loss)
 
         return step

@@ -39,9 +39,9 @@ def main():
             except TimeoutError:
                 results.append(None)
         ok = sum(1 for r in results if r is not None)
-        first = next(r for r in results if r is not None)
+        first = next((r for r in results if r is not None), None)
         print(f"served {ok}/16 requests; first probs:",
-              np.round(np.asarray(first), 3))
+              None if first is None else np.round(np.asarray(first), 3))
     finally:
         job.stop()
         broker.shutdown()

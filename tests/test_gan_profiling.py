@@ -33,6 +33,25 @@ def test_gan_learns_shifted_gaussian():
     assert dist < 2.0, f"generated mean {fake.mean(axis=0)} too far (d={dist:.2f})"
 
 
+def test_gan_threads_batchnorm_state():
+    """Stateful layers inside G/D must see their moving stats update during
+    training (regression: returned states were discarded)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    real = (rng.standard_normal((128, 2)) + 5.0).astype("float32")
+    gen = Sequential([L.Dense(8, activation="relu", input_shape=(4,)),
+                      L.Dense(2)])
+    disc = Sequential([L.Dense(8, input_shape=(2,)), L.BatchNormalization(),
+                       L.Activation("relu"), L.Dense(1)])
+    est = GANEstimator(gen, disc, noise_dim=4)
+    est.fit(real, batch_size=32, epochs=2)
+    bn_state = jax.tree_util.tree_leaves(est.state["d_state"])
+    moved = any(float(jnp.abs(l).max()) not in (0.0, 1.0) for l in bn_state)
+    assert moved, "discriminator BatchNorm moving stats never updated"
+
+
 def test_gan_generate_requires_fit():
     gen = Sequential([L.Dense(2, input_shape=(4,))])
     disc = Sequential([L.Dense(1, input_shape=(2,))])
@@ -55,8 +74,9 @@ def test_profile_steps_and_annotate(tmp_path):
     log_dir = str(tmp_path / "trace")
     ms = profile_steps(step, iter([(x,)] * 10), log_dir, warmup=2, steps=3)
     assert ms > 0
-    # a trace directory with events must exist
-    found = any("plugins" in r or f for r, d, f in os.walk(log_dir))
-    assert found
+    # an xprof trace file must actually have been captured
+    trace_files = [os.path.join(r, name) for r, _d, fs in os.walk(log_dir)
+                   for name in fs]
+    assert trace_files, "profiler produced no trace files"
     with annotate("host-phase"):
         pass

@@ -1,0 +1,155 @@
+"""Differential tests of core layers against torch as the golden oracle —
+the reference's KerasRunner pattern (SURVEY.md §4: "checkOutputAndGrad shells
+out to ... Keras ... then compares"); here the oracle is torch (cpu) and the
+comparison covers forward AND input-gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from analytics_zoo_tpu.nn import layers as L
+
+
+def fwd_and_grad(layer, params, x, reduce=lambda y: (y ** 2).sum()):
+    def f(p, xx):
+        y, _ = layer.apply(p, {}, xx)
+        return reduce(y), y
+
+    (loss, y), grads = jax.value_and_grad(f, argnums=(0, 1), has_aux=True)(
+        params, jnp.asarray(x))
+    return np.asarray(y), grads
+
+
+def torch_fwd_and_grad(module, x, reduce=lambda y: (y ** 2).sum()):
+    xt = torch.from_numpy(np.asarray(x)).requires_grad_(True)
+    y = module(xt)
+    reduce(y).backward()
+    return y.detach().numpy(), xt.grad.numpy()
+
+
+def test_dense_matches_linear():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 6)).astype("float32")
+    layer = L.Dense(4)
+    params, _ = layer.build(jax.random.PRNGKey(0), (6,))
+    tm = torch.nn.Linear(6, 4)
+    with torch.no_grad():
+        tm.weight.copy_(torch.from_numpy(np.asarray(params["kernel"]).T))
+        tm.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+    y, (gp, gx) = fwd_and_grad(layer, params, x)
+    yt, gxt = torch_fwd_and_grad(tm, x)
+    np.testing.assert_allclose(y, yt, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx), gxt, atol=1e-4)
+
+
+def test_conv2d_matches_torch():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 9, 9, 3)).astype("float32")
+    layer = L.Convolution2D(5, 3, 3, border_mode="same", subsample=(2, 2))
+    params, _ = layer.build(jax.random.PRNGKey(1), (9, 9, 3))
+    tm = torch.nn.Conv2d(3, 5, 3, stride=2, padding=1)
+    with torch.no_grad():
+        # HWIO -> OIHW
+        tm.weight.copy_(torch.from_numpy(
+            np.transpose(np.asarray(params["kernel"]), (3, 2, 0, 1))))
+        tm.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+    y, (gp, gx) = fwd_and_grad(layer, params, x)
+    x_nchw = np.transpose(x, (0, 3, 1, 2))
+    yt, gxt = torch_fwd_and_grad(tm, x_nchw)
+    np.testing.assert_allclose(y, np.transpose(yt, (0, 2, 3, 1)), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx),
+                               np.transpose(gxt, (0, 2, 3, 1)), atol=1e-4)
+
+
+def test_batchnorm_inference_matches_torch():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 5, 5, 3)).astype("float32")
+    layer = L.BatchNormalization(epsilon=1e-5)
+    params, state = layer.build(jax.random.PRNGKey(2), (5, 5, 3))
+    # give the moving stats non-trivial values
+    state = {"moving_mean": jnp.asarray([0.3, -0.1, 0.5]),
+             "moving_var": jnp.asarray([1.5, 0.7, 2.0])}
+    tm = torch.nn.BatchNorm2d(3, eps=1e-5).eval()
+    with torch.no_grad():
+        tm.weight.copy_(torch.from_numpy(np.asarray(params["gamma"])))
+        tm.bias.copy_(torch.from_numpy(np.asarray(params["beta"])))
+        tm.running_mean.copy_(torch.from_numpy(np.asarray(state["moving_mean"])))
+        tm.running_var.copy_(torch.from_numpy(np.asarray(state["moving_var"])))
+    y, _ = layer.apply(params, state, jnp.asarray(x), training=False)
+    with torch.no_grad():
+        yt = tm(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+    np.testing.assert_allclose(np.asarray(y), np.transpose(yt, (0, 2, 3, 1)),
+                               atol=1e-5)
+
+
+def test_lstm_matches_torch():
+    """Gate order [i,f,c,o] matches torch's [i,f,g,o]; use sigmoid inner
+    activation (torch's) instead of the Keras-1 hard_sigmoid default."""
+    rng = np.random.default_rng(3)
+    B, T, D, H = 2, 7, 4, 5
+    x = rng.standard_normal((B, T, D)).astype("float32")
+    layer = L.LSTM(H, inner_activation="sigmoid", return_sequences=True)
+    params, _ = layer.build(jax.random.PRNGKey(3), (T, D))
+    tm = torch.nn.LSTM(D, H, batch_first=True)
+    with torch.no_grad():
+        tm.weight_ih_l0.copy_(torch.from_numpy(np.asarray(params["kernel"]).T))
+        tm.weight_hh_l0.copy_(torch.from_numpy(
+            np.asarray(params["recurrent_kernel"]).T))
+        tm.bias_ih_l0.copy_(torch.from_numpy(np.asarray(params["bias"])))
+        tm.bias_hh_l0.zero_()
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    with torch.no_grad():
+        yt, _ = tm(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(y), yt.numpy(), atol=1e-5)
+
+
+def test_gelu_softmax_activations_match():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((16, 8)).astype("float32")
+    from analytics_zoo_tpu.nn.activations import get_activation
+
+    np.testing.assert_allclose(
+        np.asarray(get_activation("gelu")(jnp.asarray(x))),
+        torch.nn.functional.gelu(torch.from_numpy(x)).numpy(), atol=2e-3)
+    np.testing.assert_allclose(
+        np.asarray(get_activation("softmax")(jnp.asarray(x))),
+        torch.softmax(torch.from_numpy(x), dim=-1).numpy(), atol=1e-6)
+
+
+def test_depthwise_conv_matches_torch():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 8, 8, 4)).astype("float32")
+    layer = L.DepthwiseConv2D((3, 3), border_mode="same", use_bias=True)
+    params, _ = layer.build(jax.random.PRNGKey(5), (8, 8, 4))
+    tm = torch.nn.Conv2d(4, 4, 3, padding=1, groups=4)
+    with torch.no_grad():
+        # our kernel (kh, kw, 1, C) -> torch (C, 1, kh, kw)
+        tm.weight.copy_(torch.from_numpy(
+            np.transpose(np.asarray(params["kernel"]), (3, 2, 0, 1))))
+        tm.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    with torch.no_grad():
+        yt = tm(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+    np.testing.assert_allclose(np.asarray(y), np.transpose(yt, (0, 2, 3, 1)),
+                               atol=1e-4)
+
+
+def test_layernorm_matches_torch():
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((4, 10)).astype("float32")
+    layer = L.LayerNormalization()
+    params, _ = layer.build(jax.random.PRNGKey(6), (10,))
+    tm = torch.nn.LayerNorm(10, eps=layer.epsilon if hasattr(layer, "epsilon")
+                            else 1e-5)
+    with torch.no_grad():
+        gamma_key = "gamma" if "gamma" in params else "scale"
+        beta_key = "beta" if "beta" in params else "bias"
+        tm.weight.copy_(torch.from_numpy(np.asarray(params[gamma_key])))
+        tm.bias.copy_(torch.from_numpy(np.asarray(params[beta_key])))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    with torch.no_grad():
+        yt = tm(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(np.asarray(y), yt, atol=1e-4)
