@@ -1,6 +1,14 @@
-"""Training engine: Estimator, checkpointing."""
+"""Training engine: Estimator, checkpointing, GAN."""
 
 from .checkpoint import latest_checkpoint, load_checkpoint, save_checkpoint
 from .estimator import Estimator
+from .gan import GANEstimator
 
-__all__ = ["Estimator", "latest_checkpoint", "load_checkpoint", "save_checkpoint"]
+# LocalEstimator (reference estimator/LocalEstimator.scala:39 — single-node
+# multi-threaded training without Spark): on TPU the single-device Estimator IS
+# the local path — one jitted step uses every core of the chip; the name is
+# kept for API parity.
+LocalEstimator = Estimator
+
+__all__ = ["Estimator", "GANEstimator", "LocalEstimator", "latest_checkpoint",
+           "load_checkpoint", "save_checkpoint"]

@@ -1,0 +1,44 @@
+"""Keras H5 weight ingestion — `Net.load_keras` capability
+(reference ``Net.loadKeras`` / ``net_load.py``: Keras-saved models as weight
+donors; the architecture is re-expressed natively, weights transfer).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def load_keras_h5_weights(path: str) -> Dict[str, np.ndarray]:
+    """Read every weight array from a Keras ``.h5``/``.keras`` weights file into
+    a flat {"layer/weight_name": array} dict (works for both
+    ``save_weights`` files and full-model H5 files with a model_weights group).
+    """
+    import h5py
+
+    out: Dict[str, np.ndarray] = {}
+
+    def visit(name, obj):
+        if isinstance(obj, h5py.Dataset):
+            arr = np.asarray(obj)
+            if arr.dtype.kind in "fiu" and arr.ndim > 0:
+                out[name] = arr  # names are relative to root (group-aware)
+
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+        root.visititems(visit)
+    if not out:
+        raise ValueError(f"no weight arrays found in {path!r}")
+    return out
+
+
+def assign_keras_weights(model, weights: Dict[str, np.ndarray],
+                         mapping: Dict[str, str]):
+    """Assign H5 arrays onto a compiled model's params — same contract as
+    :func:`analytics_zoo_tpu.importers.torch_loader.assign_torch_weights`
+    (framework slot path → h5 key), including dense-kernel transpose when the
+    shapes fit only that way."""
+    from .torch_loader import assign_torch_weights
+
+    return assign_torch_weights(model, weights, mapping)

@@ -13,7 +13,9 @@ class Net:
         """Auto-detecting loader:
         * ``.onnx`` → :func:`load_onnx` (executable model)
         * ``.pt``/``.pth`` → torch state_dict (weight donor dict)
+        * ``.h5``/``.keras`` → Keras weight-donor dict
         * directory with ``config.json`` → zoo model bundle
+        * ``kind='tf'`` → TF checkpoint donor dict (needs tensorflow)
         """
         kind = kind or Net._detect(path)
         if kind == "onnx":
@@ -24,13 +26,19 @@ class Net:
             from .torch_loader import load_torch_state_dict
 
             return load_torch_state_dict(path)
+        if kind == "keras":
+            from .keras_h5 import load_keras_h5_weights
+
+            return load_keras_h5_weights(path)
+        if kind == "tf":
+            return Net.load_tf(path)
         if kind == "zoo":
             from ..models.common.zoo_model import load_model_bundle
 
             model, _ = load_model_bundle(path)
             return model
         raise ValueError(f"cannot determine artifact kind for {path!r}; "
-                         f"pass kind='onnx'|'torch'|'zoo'")
+                         f"pass kind='onnx'|'torch'|'keras'|'tf'|'zoo'")
 
     @staticmethod
     def _detect(path: str) -> Optional[str]:
@@ -39,6 +47,8 @@ class Net:
             return "onnx"
         if low.endswith((".pt", ".pth")):
             return "torch"
+        if low.endswith((".h5", ".hdf5", ".keras")):
+            return "keras"
         if os.path.isdir(path) and os.path.exists(
                 os.path.join(path, "config.json")):
             return "zoo"
@@ -56,3 +66,38 @@ class Net:
     @staticmethod
     def load_zoo(path: str):
         return Net.load(path, kind="zoo")
+
+    @staticmethod
+    def load_keras(path: str) -> Dict:
+        """Keras H5 weights file → flat weight-donor dict (Net.loadKeras
+        capability; pair with assign_keras_weights)."""
+        from .keras_h5 import load_keras_h5_weights
+
+        return load_keras_h5_weights(path)
+
+    @staticmethod
+    def load_tf(path: str) -> Dict:
+        """TF checkpoint → {var_name: array} donor dict. Requires the
+        ``tensorflow`` package (not bundled in TPU images); SavedModel graphs
+        should be exported to ONNX instead (Net.load_onnx)."""
+        try:
+            import tensorflow as tf  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "Net.load_tf needs the tensorflow package to read checkpoint "
+                "files. For graph import, convert the SavedModel to ONNX "
+                "(tf2onnx) and use Net.load_onnx — the executor runs it "
+                "natively on TPU.") from e
+        reader = tf.train.load_checkpoint(path)
+        return {name: reader.get_tensor(name)
+                for name in reader.get_variable_to_shape_map()}
+
+    @staticmethod
+    def load_caffe(def_path: str, model_path: str):
+        """Extension point (reference CaffeLoader.scala): Caffe ingestion is
+        not built in — convert caffemodel to ONNX (e.g. caffe2onnx) and use
+        Net.load_onnx."""
+        raise NotImplementedError(
+            "Caffe import is an extension point: convert the model to ONNX "
+            "and load with Net.load_onnx, or contribute a prototxt mapper "
+            "targeting analytics_zoo_tpu.nn.layers.")

@@ -84,7 +84,6 @@ def test_process_kill_and_resume(tmp_path):
     r2 = run_worker(script, ckpt, die_at=-1)
     assert r2.returncode == 0, r2.stderr.decode()[-2000:]
     out = r2.stdout.decode()
-    assert "resumed from" in (r2.stderr.decode() + out).lower() or True
     final = int(out.strip().split("FINAL_ITER")[-1].strip())
     # 512 samples / 64 batch = 8 iters/epoch × 4 epochs = 32 total; resume run
     # must finish at 32 — and must NOT have recomputed the killed run's work

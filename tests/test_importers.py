@@ -294,6 +294,60 @@ def test_torch_missing_file_raises_file_not_found(tmp_path):
         load_torch_state_dict(str(tmp_path / "nope.pt"))
 
 
+def test_keras_h5_weight_donor(tmp_path):
+    """Write an H5 weights file by hand (the Keras save_weights layout) and
+    round-trip it through Net.load_keras + assign into a native model."""
+    import h5py
+
+    from analytics_zoo_tpu.importers.keras_h5 import assign_keras_weights
+    from analytics_zoo_tpu.nn import layers as L
+    from analytics_zoo_tpu.nn.topology import Sequential
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((4, 8)).astype("float32")
+    b = rng.standard_normal(8).astype("float32")
+    p = str(tmp_path / "w.h5")
+    with h5py.File(p, "w") as f:
+        g = f.create_group("dense_1/dense_1")
+        g.create_dataset("kernel:0", data=w)
+        g.create_dataset("bias:0", data=b)
+
+    donor = Net.load_keras(p)
+    assert set(donor) == {"dense_1/dense_1/kernel:0", "dense_1/dense_1/bias:0"}
+
+    m = Sequential()
+    m.add(L.InputLayer((4,)))
+    m.add(L.Dense(8))
+    m.compile(optimizer="adam", loss="mse")
+    assign_keras_weights(m, donor, {
+        "1_dense/kernel": "dense_1/dense_1/kernel:0",
+        "1_dense/bias": "dense_1/dense_1/bias:0"})
+    x = rng.standard_normal((3, 4)).astype("float32")
+    np.testing.assert_allclose(m.predict(x), x @ w + b, atol=1e-5)
+
+
+def test_net_tf_checkpoint_donor(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    v = tf.Variable(np.arange(6, dtype="float32").reshape(2, 3), name="w")
+    ck = tf.train.Checkpoint(w=v)
+    prefix = ck.write(str(tmp_path / "ckpt"))
+    donor = Net.load_tf(prefix)
+    key = next(k for k in donor if "w" in k and "VARIABLE_VALUE" in k.upper()
+               or k.startswith("w"))
+    np.testing.assert_allclose(donor[key].reshape(2, 3),
+                               np.arange(6).reshape(2, 3))
+
+
+def test_net_caffe_and_detect_entries(tmp_path):
+    with pytest.raises(NotImplementedError, match="ONNX"):
+        Net.load_caffe("a.prototxt", "a.caffemodel")
+    assert Net._detect("weights.h5") == "keras"
+    assert Net._detect("model.keras") == "keras"
+    with pytest.raises(Exception):  # h5py: not an HDF5 file
+        Net.load(str(tmp_path / "x.h5"), kind="keras")
+
+
 def test_torch_full_module_requires_opt_in(tmp_path):
     """Pickled full modules execute code on load — refused unless the caller
     passes allow_pickle=True."""
