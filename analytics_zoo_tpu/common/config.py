@@ -123,6 +123,8 @@ class TrainConfig:
     retry_times: int = 5                    # bigdl.failure.retryTimes parity
     log_every_n_steps: int = 50
     donate_state: bool = True               # donate params/opt-state buffers to the step
+    shuffle: bool = True                    # per-epoch example shuffle; turn OFF for
+                                            # order-dependent losses (rank_hinge pairs)
 
 
 def apply_env_overrides(cfg: Any, prefix: str = _ENV_PREFIX) -> Any:

@@ -107,6 +107,35 @@ class FeatureSet:
         assert isinstance(shards, XShards)
         return cls(shards.collect_tree(), **kw)
 
+    @classmethod
+    def from_tf_dataset(cls, dataset, max_elements: Optional[int] = None,
+                        **kw) -> "FeatureSet":
+        """Materialize a ``tf.data.Dataset`` into a FeatureSet (TFDataset
+        family parity — tf_dataset.py:116 ``from_tf_data``; the tf.data graph
+        runs host-side once, then batches feed the device like any other tier).
+
+        Elements may be tensors, (x, y) tuples, or dicts of tensors; dataset
+        must be UNBATCHED (per-example elements). ``max_elements`` caps
+        materialization for infinite/huge datasets.
+        """
+        import itertools
+
+        it = dataset.as_numpy_iterator()
+        if max_elements is not None:
+            it = itertools.islice(it, max_elements)  # no extra fetch past cap
+        rows = list(it)
+        if not rows:
+            raise ValueError("tf.data dataset yielded no elements")
+        first = rows[0]
+        if isinstance(first, dict):
+            tree = {k: np.stack([r[k] for r in rows]) for k in first}
+        elif isinstance(first, (tuple, list)):
+            tree = tuple(np.stack([r[i] for r in rows])
+                         for i in range(len(first)))
+        else:
+            tree = np.stack(rows)
+        return cls(tree, **kw)
+
     # ----------------------------------------------------------------- internals
     def _to_memmap(self, arr: np.ndarray) -> np.ndarray:
         path = os.path.join(self._cache_dir, f"arr_{self._mm_count}.npy")

@@ -266,7 +266,8 @@ class Estimator:
             # one-batch lookahead: overlap host gather + HBM upload of batch N+1
             # with the device step on batch N (device_prefetch pattern)
             buf = []
-            for hb in train_set.batches(batch_size, epoch=epoch, shuffle=True):
+            for hb in train_set.batches(batch_size, epoch=epoch,
+                                        shuffle=self.config.shuffle):
                 buf.append(self._to_global(hb))
                 if len(buf) >= 2:
                     yield buf.pop(0)

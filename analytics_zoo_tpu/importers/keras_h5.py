@@ -15,8 +15,15 @@ def load_keras_h5_weights(path: str) -> Dict[str, np.ndarray]:
     a flat {"layer/weight_name": array} dict (works for both
     ``save_weights`` files and full-model H5 files with a model_weights group).
     """
+    import zipfile
+
     import h5py
 
+    if zipfile.is_zipfile(path):
+        raise ValueError(
+            f"{path!r} is a Keras 3 native .keras archive (zip), not HDF5. "
+            "Re-save with model.save_weights('w.h5') / save_format='h5', or "
+            "export to ONNX and use Net.load_onnx.")
     out: Dict[str, np.ndarray] = {}
 
     def visit(name, obj):

@@ -88,9 +88,17 @@ class Net:
                 "files. For graph import, convert the SavedModel to ONNX "
                 "(tf2onnx) and use Net.load_onnx — the executor runs it "
                 "natively on TPU.") from e
+        import numpy as np
+
         reader = tf.train.load_checkpoint(path)
-        return {name: reader.get_tensor(name)
-                for name in reader.get_variable_to_shape_map()}
+        out = {}
+        for name in reader.get_variable_to_shape_map():
+            arr = np.asarray(reader.get_tensor(name))
+            # skip bookkeeping entries (_CHECKPOINTABLE_OBJECT_GRAPH proto
+            # bytes, save counters' object dtype) — donor dicts hold arrays
+            if arr.dtype.kind in "fiu":
+                out[name] = arr
+        return out
 
     @staticmethod
     def load_caffe(def_path: str, model_path: str):

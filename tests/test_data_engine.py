@@ -114,3 +114,52 @@ def test_event_writer_roundtrip(tmp_path):
     assert (1, "Loss", 0.5) in scalars
     assert (2, "Loss", 0.25) in scalars
     assert any(t == "Throughput" for _, t, _ in scalars)
+
+
+def test_featureset_from_tf_dataset():
+    tf = __import__("pytest").importorskip("tensorflow")
+    import numpy as np
+
+    from analytics_zoo_tpu.data.featureset import FeatureSet
+
+    x = np.arange(40, dtype="float32").reshape(20, 2)
+    y = np.arange(20, dtype="int32")
+    ds = tf.data.Dataset.from_tensor_slices((x, y))
+    fs = FeatureSet.from_tf_dataset(ds)
+    assert len(fs) == 20
+    bx, by = next(fs.batches(10, shuffle=False))
+    np.testing.assert_array_equal(bx, x[:10])
+    np.testing.assert_array_equal(by, y[:10])
+    # dict elements + max_elements cap
+    ds2 = tf.data.Dataset.from_tensor_slices({"a": x}).repeat()
+    fs2 = FeatureSet.from_tf_dataset(ds2, max_elements=8)
+    assert len(fs2) == 8
+
+
+def test_train_config_shuffle_off_preserves_order():
+    """rank_hinge-style losses need adjacent-pair order; TrainConfig(shuffle=
+    False) must feed batches in dataset order."""
+    import numpy as np
+
+    from analytics_zoo_tpu.common.config import TrainConfig
+    from analytics_zoo_tpu.data.featureset import FeatureSet
+    from analytics_zoo_tpu.engine.estimator import Estimator
+    from analytics_zoo_tpu.nn import layers as L
+    from analytics_zoo_tpu.nn.topology import Sequential
+
+    seen = []
+
+    def spy_loss(y_true, y_pred):
+        import jax.numpy as jnp
+
+        return jnp.mean((y_true - y_pred) ** 2)
+
+    model = Sequential([L.Dense(1, input_shape=(1,))])
+    est = Estimator(model, optimizer="sgd", loss=spy_loss,
+                    config=TrainConfig(shuffle=False))
+    x = np.arange(8, dtype="float32")[:, None]
+    y = x.copy()
+    fs = FeatureSet.from_numpy(x, y)
+    batches = [np.asarray(b[0]).reshape(-1) for b in fs.batches(4, epoch=3, shuffle=False)]
+    np.testing.assert_array_equal(np.concatenate(batches), np.arange(8))
+    est.fit(fs, batch_size=4, epochs=1)  # runs without shuffling (no assert crash)

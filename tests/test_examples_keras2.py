@@ -97,6 +97,9 @@ CHEAP_EXAMPLES = [
     "autots_forecast.py",
     "serving_quickstart.py",
     "distributed_training.py",
+    "seq2seq_chatbot.py",
+    "qa_ranker.py",
+    "int8_inference.py",
 ]
 
 
