@@ -125,6 +125,11 @@ class TrainConfig:
     donate_state: bool = True               # donate params/opt-state buffers to the step
     shuffle: bool = True                    # per-epoch example shuffle; turn OFF for
                                             # order-dependent losses (rank_hinge pairs)
+    cache_on_device: bool = False           # keep the whole dataset in HBM and run
+                                            # lax.scan blocks of steps (zero per-step
+                                            # host work); single-process only
+    scan_block_steps: int = 100             # steps fused per scanned device call in
+                                            # cache_on_device mode (trigger granularity)
 
 
 def apply_env_overrides(cfg: Any, prefix: str = _ENV_PREFIX) -> Any:

@@ -155,7 +155,9 @@ class Estimator:
         }
         return self._place_state(state)
 
-    def _make_train_step(self):
+    def _step_fn(self):
+        """The raw (state, batch) -> (state, loss) transition shared by the
+        per-batch jitted step and the scanned device-cached epoch runner."""
         model, loss_fn, tx = self.model, self.loss_fn, self.tx
 
         def step(state, batch):
@@ -180,8 +182,31 @@ class Estimator:
             }
             return new_state, loss
 
+        return step
+
+    def _make_train_step(self):
         donate = (0,) if self.config.donate_state else ()
-        return jax.jit(step, donate_argnums=donate)
+        return jax.jit(self._step_fn(), donate_argnums=donate)
+
+    def _make_scan_block(self):
+        """Device-cached mode: one jitted call running ``scan_block_steps``
+        train steps via ``lax.scan``, gathering each batch from the
+        HBM-resident dataset by index (TPU-first replacement for the
+        reference's per-iteration Spark job — zero host work per step)."""
+        step = self._step_fn()
+        batch_sharding = self._batch_sharding()
+
+        def block(state, data, idx_mat):
+            def body(st, idxs):
+                batch = jax.tree_util.tree_map(
+                    lambda a: jax.lax.with_sharding_constraint(
+                        jnp.take(a, idxs, axis=0), batch_sharding), data)
+                return step(st, batch)
+
+            return jax.lax.scan(body, state, idx_mat)
+
+        donate = (0,) if self.config.donate_state else ()
+        return jax.jit(block, donate_argnums=donate)
 
     # --------------------------------------------------------------------- fit
     def fit(self, data, batch_size: Optional[int] = None,
@@ -255,6 +280,11 @@ class Estimator:
     def _run_epoch(self, train_set: FeatureSet, batch_size: int,
                    checkpoint_trigger: Trigger):
         cfg = self.config
+        if (cfg.cache_on_device
+                and get_zoo_context().process_count == 1
+                and train_set.memory_type == "DRAM"):
+            return self._run_epoch_cached(train_set, batch_size,
+                                          checkpoint_trigger)
         ts = self.trainer_state
         epoch = ts.epoch
         sharding = self._batch_sharding()
@@ -291,6 +321,13 @@ class Estimator:
             if (checkpoint_trigger is not None and checkpoint_trigger(ts)
                     and cfg.checkpoint_dir):
                 self._save(cfg.checkpoint_dir)
+        self._finish_epoch(t0, seen, loss)
+
+    def _finish_epoch(self, t0: float, seen: int, loss):
+        """Epoch epilogue shared by both epoch runners: final-loss scalar,
+        epoch/records bookkeeping, checkpoint save, summary flush."""
+        cfg = self.config
+        ts = self.trainer_state
         if loss is not None:
             ts.last_loss = float(loss)
             # always record the epoch-final loss so short runs still get scalars
@@ -304,6 +341,100 @@ class Estimator:
             self._save(cfg.checkpoint_dir)
         if self.train_summary:
             self.train_summary.flush()
+
+    def _run_epoch_cached(self, train_set: FeatureSet, batch_size: int,
+                          checkpoint_trigger: Trigger):
+        """Epoch with the dataset resident in HBM and steps fused into
+        ``lax.scan`` blocks (TrainConfig.cache_on_device).
+
+        Triggers/logging fire at block granularity (``scan_block_steps``);
+        trailing steps that don't fill a block run through the per-batch path
+        so no samples are dropped beyond the usual remainder.
+        """
+        cfg = self.config
+        ts = self.trainer_state
+        epoch = ts.epoch
+        t0 = time.perf_counter()
+
+        # key the HBM-resident copy on the array objects, not the FeatureSet —
+        # fit() wraps raw (x, y) into a fresh FeatureSet every call, and
+        # re-uploading ~the whole dataset each epoch would dominate runtime.
+        # The key holds STRONG references so object identity can't be recycled
+        # by the allocator after a gc (id() alone would alias new datasets).
+        leaves = jax.tree_util.tree_leaves(train_set.data)
+        cached = getattr(self, "_device_data_key", None)
+        if (cached is None or len(cached) != len(leaves)
+                or any(a is not b for a, b in zip(cached, leaves))):
+            self._device_data = jax.device_put(train_set.data, self._replicated())
+            self._device_data_key = leaves
+        if getattr(self, "_scan_block", None) is None:
+            self._scan_block = self._make_scan_block()
+        if self._train_step is None:
+            self._train_step = self._make_train_step()
+
+        # epoch permutation computed ON device (jax.random.permutation) so no
+        # index upload happens per epoch; deterministic in (seed, epoch)
+        n_total = len(train_set)
+        if cfg.shuffle:
+            if getattr(self, "_perm_n", None) != n_total:
+                self._perm_fn = jax.jit(
+                    lambda seed: jax.random.permutation(
+                        jax.random.PRNGKey(seed),
+                        jnp.arange(n_total, dtype=jnp.int32)))
+                self._perm_n = n_total
+            idx = self._perm_fn(train_set.seed + epoch * 1_000_003)
+        else:
+            idx = jnp.arange(n_total, dtype=jnp.int32)
+        n_steps = n_total // batch_size
+        block = max(1, min(cfg.scan_block_steps, n_steps))
+        n_blocks = n_steps // block
+        seen = 0
+        loss = None
+        for b in range(n_blocks):
+            sel = idx[b * block * batch_size:(b + 1) * block * batch_size]
+            idx_mat = sel.reshape(block, batch_size)
+            self.train_state, losses = self._scan_block(
+                self.train_state, self._device_data, idx_mat)
+            loss = losses[-1]
+            ts.iteration += block
+            seen += block * batch_size
+            if cfg.log_every_n_steps and (b + 1) * block >= cfg.log_every_n_steps \
+                    and ((b + 1) * block) // cfg.log_every_n_steps \
+                    > (b * block) // cfg.log_every_n_steps:
+                loss_val = float(loss)
+                ts.last_loss = loss_val
+                dt = time.perf_counter() - t0
+                throughput = seen / max(dt, 1e-9)
+                if self.train_summary:
+                    self.train_summary.add_scalars(ts.iteration, {
+                        "Loss": loss_val, "Throughput": throughput})
+                logger.info("epoch %d iter %d loss %.4f throughput %.1f rec/s",
+                            epoch, ts.iteration, loss_val, throughput)
+            if (checkpoint_trigger is not None and cfg.checkpoint_dir
+                    and self._trigger_crossed(checkpoint_trigger, ts, block)):
+                self._save(cfg.checkpoint_dir)
+        # trailing steps (< one block): per-batch path, gathering on device
+        for s in range(n_blocks * block, n_steps):
+            sel = idx[s * batch_size:(s + 1) * batch_size]
+            db = jax.tree_util.tree_map(lambda a: jnp.take(a, sel, axis=0),
+                                        self._device_data)
+            self.train_state, loss = self._train_step(self.train_state, db)
+            ts.iteration += 1
+            seen += batch_size
+            if (checkpoint_trigger is not None and checkpoint_trigger(ts)
+                    and cfg.checkpoint_dir):
+                self._save(cfg.checkpoint_dir)
+        self._finish_epoch(t0, seen, loss)
+
+    @staticmethod
+    def _trigger_crossed(trigger: Trigger, ts: TrainerState, block: int) -> bool:
+        """Block-granular trigger test: when iteration jumps by ``block``, an
+        interval trigger fires if any multiple of its interval was CROSSED in
+        the block (exact modulo equality would almost never hold)."""
+        if isinstance(trigger, SeveralIteration):
+            return (ts.iteration // trigger.interval
+                    > (ts.iteration - block) // trigger.interval)
+        return trigger(ts)
 
     def _save(self, directory: str):
         if get_zoo_context().process_index == 0:

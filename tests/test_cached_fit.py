@@ -1,0 +1,96 @@
+"""Device-cached (HBM-resident, lax.scan) training path equivalence.
+
+TrainConfig.cache_on_device runs the same permutation/batches/rng as the
+per-batch path, so both must land on (numerically) the same trained state.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from analytics_zoo_tpu.common import TrainConfig, get_zoo_context
+from analytics_zoo_tpu.engine import Estimator
+from analytics_zoo_tpu.nn import layers as L
+from analytics_zoo_tpu.nn.graph import Input
+
+
+def _mlp():
+    x = Input((6,))
+    h = L.Dense(16, activation="relu")(x)
+    out = L.Dense(3, activation="softmax")(h)
+    from analytics_zoo_tpu.nn.topology import Model
+
+    return Model(x, out)
+
+
+def _data(n=640):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 6)).astype("float32")
+    y = (x.sum(1) > 0).astype("int32") + (x[:, 0] > 1).astype("int32")
+    return x, y
+
+
+def _fit(cache: bool, scan_block: int = 3, epochs: int = 2, shuffle: bool = False):
+    x, y = _data()
+    cfg = TrainConfig(cache_on_device=cache, scan_block_steps=scan_block,
+                      log_every_n_steps=1000, shuffle=shuffle)
+    est = Estimator(_mlp(), optimizer="sgd",
+                    loss="sparse_categorical_crossentropy",
+                    mesh=get_zoo_context().mesh, config=cfg)
+    est.fit((x, y), batch_size=64, epochs=epochs, seed=7)
+    return est
+
+
+def test_cached_matches_perbatch_training():
+    # shuffle=False: both paths visit identical batches in identical order
+    # (the cached path shuffles with an on-device permutation, so shuffled
+    # runs are deterministic per-path but not identical across paths)
+    a = _fit(cache=False)
+    b = _fit(cache=True)
+    assert a.trainer_state.iteration == b.trainer_state.iteration
+    la = jax.tree_util.tree_leaves(a.train_state["params"])
+    lb = jax.tree_util.tree_leaves(b.train_state["params"])
+    for pa, pb in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=2e-4, atol=2e-5)
+    assert np.isfinite(b.trainer_state.last_loss)
+
+
+def test_cached_trailing_steps_and_eval():
+    # 640 samples / batch 64 = 10 steps; block 4 -> 2 blocks + 2 trailing steps
+    est = _fit(cache=True, scan_block=4, epochs=1)
+    assert est.trainer_state.iteration == 10
+    x, y = _data()
+    res = est.evaluate((x, y), batch_size=64, metrics=("accuracy",))
+    assert 0.0 <= res["sparse_categorical_accuracy"] <= 1.0
+
+
+def test_cached_checkpoint_trigger_crosses_block(tmp_path):
+    # interval 7 with block 4: iteration jumps 4,8,12,... -> modulo equality
+    # would fire only at 28; crossing logic fires at 8 (crossed 7)
+    import os
+
+    from analytics_zoo_tpu.common import TrainConfig, get_zoo_context
+    from analytics_zoo_tpu.common.triggers import SeveralIteration
+
+    x, y = _data()
+    cfg = TrainConfig(cache_on_device=True, scan_block_steps=4,
+                      log_every_n_steps=1000,
+                      checkpoint_dir=str(tmp_path), shuffle=False)
+    est = Estimator(_mlp(), optimizer="sgd",
+                    loss="sparse_categorical_crossentropy",
+                    mesh=get_zoo_context().mesh, config=cfg)
+    est.fit((x, y), batch_size=64, epochs=1,
+            checkpoint_trigger=SeveralIteration(7))
+    ckpts = [d for d in os.listdir(tmp_path) if "ckpt" in d or d]
+    assert len(ckpts) >= 2  # mid-epoch fire(s) + epoch end
+
+
+def test_cached_shuffled_trains():
+    est = _fit(cache=True, scan_block=5, epochs=3, shuffle=True)
+    assert est.trainer_state.iteration == 30
+    assert np.isfinite(est.trainer_state.last_loss)
+    x, y = _data()
+    res = est.evaluate((x, y), batch_size=64, metrics=("accuracy",))
+    assert res["sparse_categorical_accuracy"] > 0.5
