@@ -8,10 +8,13 @@ never materialize in HBM — peak memory O(block_q · block_k) per core instead 
 O(T²). Causal masking skips fully-future K tiles (no wasted tiles beyond the
 diagonal).
 
-Backward: custom VJP recomputing probabilities from the saved log-sum-exp
-(standard flash recompute: P = exp(S − lse)), expressed in plain jnp so XLA
-fuses it; combine with ``jax.checkpoint`` or the ring path
-(:mod:`analytics_zoo_tpu.ops.attention`) for long-sequence training.
+Backward: tiled pallas kernels recomputing probabilities from the saved
+log-sum-exp (standard flash recompute: P = exp(S − lse)). Two passes:
+``_bwd_dq_kernel`` (grid over Q tiles, folding K/V tiles) and
+``_bwd_dkv_kernel`` (grid over K tiles, folding Q tiles). Like the forward,
+scores/probabilities live only in VMEM — peak HBM stays O(T·D), not O(T²),
+for training as well as inference. Only the non-pallas fallback materializes
+full attention.
 
 Layout: (B, T, H, D) like the other attention strategies. On non-TPU backends
 the kernel runs in interpreter mode (tests) or falls back to full attention.
@@ -140,28 +143,172 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
     return out4, lse4.astype(jnp.float32)
 
 
-def _flash_bwd(q, k, v, o, lse, g, *, causal: bool):
-    """Flash backward via lse recompute (one pass, fused by XLA)."""
-    b, t_q, h, d = q.shape
-    scale = 1.0 / float(np.sqrt(d))
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    of = o.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+def _bwd_p_ds(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qi, kb, *,
+              scale: float, causal: bool, block_q: int, block_k: int):
+    """Shared backward-tile recompute: (p, ds, q, k, g) for tile (qi, kb).
+
+    P = exp(S − lse) from the saved log-sum-exp; dS = P ∘ (dP − δ) · scale —
+    identical math in the dq and dk/dv kernels so the two passes can never
+    desynchronize.
+    """
+    q = q_ref[0].astype(jnp.float32)                # (block_q, D)
+    k = k_ref[0].astype(jnp.float32)                # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)                # (block_q, D)
+    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]    # (block_q,)
+    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
     if causal:
-        q_pos = jnp.arange(t_q)[:, None]
-        k_pos = jnp.arange(k.shape[1])[None, :]
-        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
-    p = jnp.exp(s - lse[..., None])                      # (B,H,Tq,Tk)
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vf)
-    delta = jnp.sum(gf * of, axis=-1).transpose(0, 2, 1)  # (B,H,Tq)
-    ds = p * (dp - delta[..., None]) * scale
-    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
-    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])                   # (block_q, block_k)
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    return p, ds, q, k, g
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale: float, causal: bool,
+                   block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def body():
+        _, ds, _, k, _ = _bwd_p_ds(
+            q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qi, kb,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kb * block_k <= qi * block_q + block_q - 1)
+        def _():
+            body()
+    else:
+        body()
+
+    @pl.when(kb == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                    causal: bool, block_q: int, block_k: int):
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def body():
+        p, ds, q, _, g = _bwd_p_ds(
+            q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qi, kb,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        # dV += Pᵀ · dO
+        dv_scr[:] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        # dK += dSᵀ · Q
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip Q tiles strictly before this K tile (their P block is all-masked)
+        @pl.when(qi * block_q + block_q - 1 >= kb * block_k)
+        def _():
+            body()
+    else:
+        body()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, *, causal: bool, block_q: int,
+               block_k: int, interpret: bool):
+    """Tiled flash backward: dq/dk/dv pallas kernels from the saved lse."""
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    scale = 1.0 / float(np.sqrt(d))
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, t_q, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    gh = g.transpose(0, 2, 1, 3).reshape(b * h, t_q, d)
+    # delta_i = rowsum(dO_i ∘ O_i), computed once in plain XLA (O(T·D))
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = delta.transpose(0, 2, 1).reshape(b * h, 1, t_q)
+    lse3 = lse.reshape(b * h, 1, t_q)
+    nq = t_q // block_q
+    nk = t_k // block_k
+
+    row_spec = pl.BlockSpec((1, 1, t_q), lambda bh, i, j: (bh, 0, 0))
+    # unlike the forward (whose lse OUT row is revisited by every qi), lse and
+    # delta are read-only here and each middle-dim index owns a disjoint out
+    # block, so only the innermost fold dim must stay sequential
+    dims = None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            row_spec, row_spec,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=dims,
+        interpret=interpret,
+    )(qh, kh, vh, gh, lse3, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b * h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, kb, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, kb, qi: (bh, qi, 0)),
+            row_spec, row_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t_k, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=dims,
+        interpret=interpret,
+    )(qh, kh, vh, gh, lse3, delta)
+
+    to4 = lambda a, t: a.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return (to4(dq, t_q).astype(q.dtype), to4(dk, t_k).astype(k.dtype),
+            to4(dv, t_k).astype(v.dtype))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -185,15 +332,22 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _resolve(q, k, block_q, block_k, interpret):
+    """Clamp tile sizes to the sequence and resolve interpret mode — shared by
+    the forward and the VJP backward so both always use identical tiling."""
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    interpret = _interpret_default() if interpret is None else interpret
+    return block_q, block_k, interpret
+
+
 def _flash_attention_fwd_res(q, k, v, causal, block_q, block_k, interpret):
     from .attention import full_attention
 
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
+    block_q, block_k, interpret = _resolve(q, k, block_q, block_k, interpret)
     if not _HAS_PALLAS or not _tiles_ok(q, k, block_q, block_k):
         out = full_attention(q, k, v, causal=causal)
         return out, None
-    interpret = _interpret_default() if interpret is None else interpret
     out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
                           block_k=block_k, interpret=interpret)
     return out, (q, k, v, out, lse)
@@ -216,7 +370,9 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
             lambda q_, k_, v_: full_attention(q_, k_, v_, causal=causal),
             q, k, v)
         return vjp(g)
-    return _flash_bwd(q, k, v, out, lse, g, causal=causal)
+    block_q, block_k, interpret = _resolve(q, k, block_q, block_k, interpret)
+    return _flash_bwd(q, k, v, out, lse, g, causal=causal,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -129,3 +129,61 @@ def test_flash_sp_mesh_rejected():
     q, k, v = make_qkv(t=32)
     with pytest.raises(ValueError, match="single-device kernel"):
         sharded_attention(q, k, v, mesh, strategy="flash")
+
+
+def _max_intermediate_elems(fn, *args):
+    """Largest intermediate (in elements) appearing in fn's jaxpr, recursing
+    into sub-jaxprs EXCEPT pallas kernels (whose refs are VMEM tiles)."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+
+    def walk(jx):
+        mx = 0
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                for v in eqn.outvars:
+                    mx = max(mx, int(np.prod(v.aval.shape)))
+                continue
+            for v in eqn.outvars:
+                if hasattr(v.aval, "shape"):
+                    mx = max(mx, int(np.prod(v.aval.shape)))
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    mx = max(mx, walk(sub.jaxpr))
+        return mx
+
+    return walk(jaxpr.jaxpr)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_no_quadratic_memory(causal):
+    # The tiled pallas backward must not materialize any (B,H,T,T) tensor:
+    # the largest intermediate in the whole grad jaxpr stays O(B*T*H*D),
+    # far below T^2 scale.
+    b, t, h, d = 1, 512, 2, 16
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal, 128, 128, True).sum()
+
+    q, k, v = make_qkv(b=b, t=t, h=h, d=d)
+    biggest = _max_intermediate_elems(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+    assert biggest <= 4 * b * t * h * d, (
+        f"O(T^2)-scale intermediate found: {biggest} elems "
+        f"(T^2 scale would be {b*h*t*t})")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_tiled_backward_matches_oracle_multi_tile(causal):
+    # multiple q AND k tiles so cross-tile accumulation paths are exercised
+    q, k, v = make_qkv(b=1, t=128, h=2, d=16, seed=3)
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, causal, 32, 32, True) ** 2).sum()
+
+    def f_full(q, k, v):
+        return (full_attention(q, k, v, causal=causal) ** 2).sum()
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_full, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-4, rtol=5e-4)
