@@ -119,8 +119,16 @@ class BaseTSModel:
 
         os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
         est = self.model.estimator
-        save_weights(model_path, self.model, est.train_state["params"],
-                     est.train_state["model_state"])
+        if est.train_state is not None:
+            params, mstate = (est.train_state["params"],
+                              est.train_state["model_state"])
+        elif est.initial_weights is not None:
+            # built/restored but never stepped — save the loaded weights
+            params, mstate = est.initial_weights
+        else:
+            raise RuntimeError("model has no weights to save — fit or restore "
+                               "it first")
+        save_weights(model_path, self.model, params, mstate)
         cfg = {k: v for k, v in self.config.items()}
         cfg["future_seq_len"] = self.future_seq_len
         with open(config_path or model_path + ".config.json", "w") as f:

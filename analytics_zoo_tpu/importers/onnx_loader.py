@@ -251,7 +251,11 @@ class _Executor:
     def op_Dropout(self, n, ins):
         if not self.training or self.rng is None:
             return ins[0]
-        ratio = n.attr("ratio", 0.5)
+        # opset>=12: ratio arrives as input[1]; older opsets as an attribute
+        if len(ins) > 1 and ins[1] is not None:
+            ratio = float(np.asarray(ins[1]))
+        else:
+            ratio = n.attr("ratio", 0.5)
         keep = 1.0 - ratio
         # independent key per dropout node — one shared key would give every
         # dropout in the graph the same mask
@@ -297,8 +301,12 @@ class _Executor:
 
     # ---------------------------------------------------------------- reduce
     def op_ReduceMean(self, n, ins):
-        axes = tuple(n.attr("axes", ())) or None
-        return ins[0].mean(axis=axes, keepdims=bool(n.attr("keepdims", 1)))
+        # opset>=18 passes axes as input[1] (like ReduceSum since opset 13)
+        axes = (tuple(int(a) for a in np.asarray(ins[1]))
+                if len(ins) > 1 and ins[1] is not None
+                else tuple(n.attr("axes", ())))
+        return ins[0].mean(axis=axes or None,
+                           keepdims=bool(n.attr("keepdims", 1)))
 
     def op_ReduceSum(self, n, ins):
         axes = (tuple(int(a) for a in np.asarray(ins[1]))

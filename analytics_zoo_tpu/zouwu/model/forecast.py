@@ -217,3 +217,29 @@ class TCMFForecaster(Forecaster):
                         else target_value)
         pred = self.predict(x=x, horizon=tv.shape[1])
         return [Evaluator.evaluate(m, tv, pred) for m in metric]
+
+    def save(self, model_path: str):
+        """Persist the full fitted state (reference TCMFForecaster.save parity,
+        zouwu/model/forecast.py) so a fitted model survives the process."""
+        if self.F is None:
+            raise RuntimeError("TCMF not fitted — nothing to save")
+        np.savez(
+            model_path if model_path.endswith(".npz") else model_path + ".npz",
+            F=self.F, X=self.X, ar_coef=self.ar_coef,
+            y_mean=self.y_mean, y_std=self.y_std,
+            meta=np.asarray([self.ar_lags_eff, self.rank, self.lr, self.reg,
+                             self.max_iter, self.ar_lags, self.seed],
+                            dtype=np.float64))
+
+    def restore(self, model_path: str):
+        path = model_path if model_path.endswith(".npz") else model_path + ".npz"
+        with np.load(path) as z:
+            self.F, self.X = z["F"], z["X"]
+            self.ar_coef = z["ar_coef"]
+            self.y_mean, self.y_std = z["y_mean"], z["y_std"]
+            meta = z["meta"]
+        self.ar_lags_eff = int(meta[0])
+        self.rank, self.lr, self.reg = int(meta[1]), float(meta[2]), float(meta[3])
+        self.max_iter, self.ar_lags, self.seed = (int(meta[4]), int(meta[5]),
+                                                  int(meta[6]))
+        return self

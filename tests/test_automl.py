@@ -111,7 +111,12 @@ def test_vanilla_lstm_fit_predict(tmp_path):
     mp = str(tmp_path / "m")
     m.save(mp)
     m2 = VanillaLSTM().restore(mp)
+    # restored-but-never-stepped model must save its loaded weights, not crash
+    mp2 = str(tmp_path / "m2")
+    m2.save(mp2)
     np.testing.assert_allclose(pred, m2.predict(x), atol=1e-5)
+    m3 = VanillaLSTM().restore(mp2)
+    np.testing.assert_allclose(pred, m3.predict(x), atol=1e-5)
 
 
 def test_seq2seq_multistep():

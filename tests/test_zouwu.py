@@ -86,6 +86,19 @@ def test_tcmf_forecaster_recovers_low_rank():
     naive = np.abs(Y_full[:, T:] - Y_full[:, T - 1:T]).mean()
     assert mae < naive
 
+def test_tcmf_save_restore(tmp_path):
+    rng = np.random.default_rng(2)
+    y = rng.standard_normal((6, 40)).astype("float32")
+    f = TCMFForecaster(rank=3, max_iter=80)
+    f.fit(y)
+    pred = f.predict(horizon=5)
+    path = str(tmp_path / "tcmf")
+    f.save(path)
+    g = TCMFForecaster().restore(path)
+    np.testing.assert_allclose(g.predict(horizon=5), pred, rtol=1e-6)
+    assert g.ar_lags_eff == f.ar_lags_eff and g.rank == f.rank
+
+
 def test_tcmf_dict_input_and_incremental():
     rng = np.random.default_rng(1)
     y = rng.standard_normal((5, 30)).astype("float32")
