@@ -1,30 +1,71 @@
 """Layer library (Keras-1-style naming, /root/reference/zoo/.../keras/layers/ parity)."""
 
 from .core import (Activation, Dense, Dropout, ExpandDim, Flatten, GaussianDropout,
-                   GaussianNoise, InputLayer, Lambda, Masking, Narrow, Permute,
-                   RepeatVector, Reshape, Select, SparseDense, Squeeze)
+                   GaussianNoise, Highway, InputLayer, Lambda, Masking, MaxoutDense,
+                   Narrow, Permute, RepeatVector, Reshape, Select, SparseDense,
+                   Squeeze)
 from .convolution import (AveragePooling1D, AveragePooling2D, Convolution1D,
                           Convolution2D, DepthwiseConv2D, GlobalAveragePooling1D,
                           GlobalAveragePooling2D, GlobalMaxPooling1D,
                           GlobalMaxPooling2D, MaxPooling1D, MaxPooling2D,
                           UpSampling2D, ZeroPadding2D)
+from .conv_extended import (AtrousConvolution1D, AtrousConvolution2D,
+                            AveragePooling3D, Convolution3D, Cropping1D,
+                            Cropping2D, Cropping3D, Deconvolution2D,
+                            GlobalAveragePooling3D, GlobalMaxPooling3D, LRN2D,
+                            LocallyConnected1D, LocallyConnected2D,
+                            MaxPooling3D, ResizeBilinear,
+                            SeparableConvolution2D, ShareConvolution2D,
+                            UpSampling1D, UpSampling3D, WithinChannelLRN2D,
+                            ZeroPadding1D, ZeroPadding3D)
+from .elementwise import (AddConstant, BinaryThreshold, CAdd, CMul, Exp, Expand,
+                          GaussianSampler, GetShape, HardShrink, HardTanh,
+                          Identity, KerasLayerWrapper, Log, Max, Mul,
+                          MulConstant, Negative, Power, Scale, SelectTable,
+                          SoftShrink, SplitTensor, Sqrt, Square, Threshold)
+from .advanced_activations import (ELU, LeakyReLU, PReLU, RReLU, Softmax, SReLU,
+                                   SpatialDropout1D, SpatialDropout2D,
+                                   SpatialDropout3D, ThresholdedReLU)
+from .attention import (BERT, MultiHeadAttention, PositionalEmbedding,
+                        TransformerLayer)
 from .embedding import Embedding, SparseEmbedding, WordEmbedding
 from .merge import Merge, merge
 from .normalization import BatchNormalization, LayerNormalization
-from .recurrent import (GRU, LSTM, Bidirectional, SimpleRNN, TimeDistributed)
+from .recurrent import (GRU, LSTM, Bidirectional, ConvLSTM2D, ConvLSTM3D,
+                        SimpleRNN, TimeDistributed)
 from .moe import MoE
 
 Conv1D = Convolution1D
 Conv2D = Convolution2D
+Conv3D = Convolution3D
+ShareConv2D = ShareConvolution2D
+Input = InputLayer
+LayerNorm = LayerNormalization
 
 __all__ = [
-    "Activation", "AveragePooling1D", "AveragePooling2D", "BatchNormalization",
-    "Bidirectional", "Conv1D", "Conv2D", "Convolution1D", "Convolution2D", "Dense",
-    "DepthwiseConv2D", "Dropout", "Embedding", "ExpandDim", "Flatten", "GRU", "GaussianDropout",
-    "GaussianNoise", "GlobalAveragePooling1D", "GlobalAveragePooling2D",
-    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "InputLayer", "LSTM", "Lambda",
-    "LayerNormalization", "Masking", "MaxPooling1D", "MaxPooling2D", "Merge", "MoE",
-    "Narrow", "Permute", "RepeatVector", "Reshape", "Select", "SimpleRNN",
-    "SparseDense", "SparseEmbedding", "Squeeze", "TimeDistributed", "UpSampling2D",
-    "WordEmbedding", "ZeroPadding2D", "merge",
+    "BERT", "Input", "LayerNorm", "MultiHeadAttention", "PositionalEmbedding",
+    "TransformerLayer",
+    "Activation", "AddConstant", "AtrousConvolution1D", "AtrousConvolution2D",
+    "AveragePooling1D", "AveragePooling2D", "AveragePooling3D",
+    "BatchNormalization", "Bidirectional", "BinaryThreshold", "CAdd", "CMul",
+    "Conv1D", "Conv2D", "Conv3D", "ConvLSTM2D", "ConvLSTM3D", "Convolution1D",
+    "Convolution2D", "Convolution3D", "Cropping1D", "Cropping2D", "Cropping3D",
+    "Deconvolution2D", "Dense", "DepthwiseConv2D", "Dropout", "ELU", "Embedding",
+    "Exp", "Expand", "ExpandDim", "Flatten", "GRU", "GaussianDropout",
+    "GaussianNoise", "GaussianSampler", "GetShape", "GlobalAveragePooling1D",
+    "GlobalAveragePooling2D", "GlobalAveragePooling3D", "GlobalMaxPooling1D",
+    "GlobalMaxPooling2D", "GlobalMaxPooling3D", "HardShrink", "HardTanh",
+    "Highway", "Identity", "InputLayer", "KerasLayerWrapper", "LRN2D", "LSTM",
+    "Lambda", "LayerNormalization", "LeakyReLU", "LocallyConnected1D",
+    "LocallyConnected2D", "Log", "Masking", "Max", "MaxPooling1D",
+    "MaxPooling2D", "MaxPooling3D", "MaxoutDense", "Merge", "MoE", "Mul",
+    "MulConstant", "Narrow", "Negative", "PReLU", "Permute", "Power", "RReLU",
+    "RepeatVector", "Reshape", "ResizeBilinear", "SReLU", "Scale", "Select",
+    "SelectTable", "SeparableConvolution2D", "ShareConv2D", "ShareConvolution2D",
+    "SimpleRNN", "Softmax", "SoftShrink", "SparseDense", "SparseEmbedding",
+    "SpatialDropout1D", "SpatialDropout2D", "SpatialDropout3D", "SplitTensor",
+    "Sqrt", "Square", "Squeeze", "Threshold", "ThresholdedReLU",
+    "TimeDistributed", "UpSampling1D", "UpSampling2D", "UpSampling3D",
+    "WithinChannelLRN2D", "WordEmbedding", "ZeroPadding1D", "ZeroPadding2D",
+    "ZeroPadding3D", "merge",
 ]

@@ -1,0 +1,182 @@
+"""Parametric / advanced activation layers.
+
+Parity targets (/root/reference/zoo/.../pipeline/api/keras/layers/):
+LeakyReLU.scala (alpha=0.3), ELU.scala (alpha=1.0), ThresholdedReLU.scala
+(theta=1.0), PReLU.scala (nOutputPlane), SReLU.scala (4 learnable tensors +
+sharedAxes), RReLU.scala (random slope in training, mean slope at eval),
+Softmax.scala, SpatialDropout1D/2D/3D.scala.
+
+All are point-wise jnp expressions; the learnable ones (PReLU/SReLU) keep their
+parameters broadcastable so XLA fuses the activation into the producing matmul.
+Layout note: channels are LAST here (TPU-native NHWC), so "per-channel"
+parameters live on the trailing axis, not axis 1 as in the reference's NCHW.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..module import (Layer, as_compute, get_initializer, glorot_uniform,
+                      param_dtype)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, alpha: float = 0.3, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.alpha = float(alpha)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jax.nn.leaky_relu(as_compute(x), self.alpha), state
+
+
+class ELU(Layer):
+    def __init__(self, alpha: float = 1.0, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.alpha = float(alpha)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jax.nn.elu(as_compute(x), self.alpha), state
+
+
+class ThresholdedReLU(Layer):
+    """x if x > theta else 0 (ThresholdedReLU.scala)."""
+
+    def __init__(self, theta: float = 1.0, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.theta = float(theta)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        return jnp.where(x > self.theta, x, 0.0).astype(x.dtype), state
+
+
+class Softmax(Layer):
+    """Softmax over the last axis as a standalone layer (Softmax.scala)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jax.nn.softmax(as_compute(x), axis=-1), state
+
+
+class PReLU(Layer):
+    """Learnable leaky slope (PReLU.scala / BigDL nn.PReLU).
+
+    ``n_output_plane=0`` → one shared alpha; otherwise one alpha per channel
+    (trailing axis in our NHWC layout). Initialized to 0.25 like torch.
+    """
+
+    def __init__(self, n_output_plane: int = 0, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.n_output_plane = int(n_output_plane)
+
+    def build(self, rng, input_shape):
+        n = self.n_output_plane if self.n_output_plane > 0 else 1
+        return {"alpha": jnp.full((n,), 0.25, param_dtype())}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        alpha = jnp.asarray(params["alpha"], x.dtype)
+        return jnp.where(x >= 0, x, alpha * x), state
+
+
+class SReLU(Layer):
+    """S-shaped ReLU (SReLU.scala):
+
+        f(x) = t_r + a_r (x - t_r)   for x >= t_r
+        f(x) = x                     for t_l < x < t_r
+        f(x) = t_l + a_l (x - t_l)   for x <= t_l
+
+    Four learnable tensors shaped like the input's non-batch dims, with
+    ``shared_axes`` collapsed to 1 (e.g. shared_axes=(1,2) on (H,W,C) input
+    learns per-channel parameters shared over space).
+    """
+
+    def __init__(self, t_left_init="zeros", a_left_init="glorot_uniform",
+                 t_right_init="glorot_uniform", a_right_init="ones",
+                 shared_axes: Optional[Sequence[int]] = None, name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.t_left_init = get_initializer(t_left_init)
+        self.a_left_init = get_initializer(a_left_init)
+        self.t_right_init = get_initializer(t_right_init)
+        self.a_right_init = get_initializer(a_right_init)
+        self.shared_axes = tuple(shared_axes) if shared_axes else ()
+
+    def _param_shape(self, input_shape):
+        # axes are 1-indexed over non-batch dims, matching the reference doc
+        return tuple(1 if (i + 1) in self.shared_axes else s
+                     for i, s in enumerate(input_shape))
+
+    def build(self, rng, input_shape):
+        shape = self._param_shape(input_shape)
+        ks = jax.random.split(rng, 4)
+        dt = param_dtype()
+        return {"t_left": self.t_left_init(ks[0], shape, dt),
+                "a_left": self.a_left_init(ks[1], shape, dt),
+                "t_right": self.t_right_init(ks[2], shape, dt),
+                "a_right": self.a_right_init(ks[3], shape, dt)}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        tl = jnp.asarray(params["t_left"], x.dtype)
+        al = jnp.asarray(params["a_left"], x.dtype)
+        tr = jnp.asarray(params["t_right"], x.dtype)
+        ar = jnp.asarray(params["a_right"], x.dtype)
+        y = jnp.where(x >= tr, tr + ar * (x - tr), x)
+        return jnp.where(x <= tl, tl + al * (x - tl), y), state
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (RReLU.scala): negative slope ~ U(lower, upper)
+    per element in training; fixed mean slope (l+u)/2 at eval."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.lower, self.upper = float(lower), float(upper)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        if training:
+            if rng is None:
+                raise ValueError(f"{self.name}: needs rng in training mode")
+            a = jax.random.uniform(rng, x.shape, x.dtype, self.lower, self.upper)
+        else:
+            a = jnp.asarray((self.lower + self.upper) / 2, x.dtype)
+        return jnp.where(x >= 0, x, a * x), state
+
+
+class _SpatialDropout(Layer):
+    """Drop whole feature maps: the mask broadcasts over the spatial dims so a
+    dropped channel is zero everywhere (the BigDL SpatialDropoutND behavior)."""
+
+    n_spatial = 1
+
+    def __init__(self, p: float = 0.5, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.rate = float(p)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or self.rate <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError(f"{self.name}: needs rng in training mode")
+        keep = 1.0 - self.rate
+        # (B, 1, ..., 1, C): per-sample, per-channel mask shared over space
+        mask_shape = (x.shape[0],) + (1,) * self.n_spatial + (x.shape[-1],)
+        mask = jax.random.bernoulli(rng, keep, mask_shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), state
+
+
+class SpatialDropout1D(_SpatialDropout):
+    n_spatial = 1
+
+
+class SpatialDropout2D(_SpatialDropout):
+    n_spatial = 2
+
+
+class SpatialDropout3D(_SpatialDropout):
+    n_spatial = 3

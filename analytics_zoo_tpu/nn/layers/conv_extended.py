@@ -1,0 +1,672 @@
+"""Extended convolution / pooling / resampling layers.
+
+Parity targets (/root/reference/zoo/.../pipeline/api/keras/layers/):
+Convolution3D.scala, Deconvolution2D.scala, SeparableConvolution2D.scala,
+AtrousConvolution1D/2D.scala, LocallyConnected1D/2D.scala,
+ShareConvolution2D.scala, Cropping1D/2D/3D.scala, ZeroPadding1D/3D.scala,
+UpSampling1D/3D.scala, MaxPooling3D/AveragePooling3D.scala,
+GlobalMaxPooling3D/GlobalAveragePooling3D.scala, ResizeBilinear.scala,
+LRN2D.scala, WithinChannelLRN2D.scala.
+
+Layout is channels-LAST everywhere (NWC / NHWC / NDHWC) — the TPU-native layout
+(the reference defaults to the NCHW/CHANNEL_FIRST of its MKL kernels). All convs
+lower through ``lax.conv_general_dilated`` onto the MXU; dilation is expressed
+as ``rhs_dilation`` (XLA-native) instead of materializing dilated kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..activations import get_activation
+from ..module import Layer, as_compute, get_initializer, param_dtype
+from .convolution import _pair
+
+
+def _triple(v) -> Tuple[int, int, int]:
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v, v, v)
+
+
+# --------------------------------------------------------------------- conv 3D
+
+class Convolution3D(Layer):
+    """3D conv over (B, D1, D2, D3, C) (Convolution3D.scala; kernelDim1/2/3)."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, activation=None, border_mode: str = "valid",
+                 subsample=(1, 1, 1), init="glorot_uniform",
+                 use_bias: bool = True, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.filters = int(nb_filter)
+        self.kernel_size = (int(kernel_dim1), int(kernel_dim2), int(kernel_dim3))
+        self.strides = _triple(subsample)
+        self.padding = border_mode.upper()
+        self.activation = get_activation(activation)
+        self.init = get_initializer(init)
+        self.use_bias = use_bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        params = {"kernel": self.init(
+            rng, self.kernel_size + (in_ch, self.filters), param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        kernel = jnp.asarray(params["kernel"], x.dtype)
+        y = jax.lax.conv_general_dilated(
+            x, kernel, window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        dims = input_shape[:-1]
+        out = []
+        for d, k, s in zip(dims, self.kernel_size, self.strides):
+            out.append(-(-d // s) if self.padding == "SAME"
+                       else (d - k) // s + 1)
+        return tuple(out) + (self.filters,)
+
+
+class Deconvolution2D(Layer):
+    """Transposed 2D conv (Deconvolution2D.scala → BigDL SpatialFullConvolution):
+    output spatial size = (in - 1) * stride + kernel."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), init="glorot_uniform",
+                 use_bias: bool = True, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.filters = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.strides = _pair(subsample)
+        self.activation = get_activation(activation)
+        self.init = get_initializer(init)
+        self.use_bias = use_bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        kh, kw = self.kernel_size
+        params = {"kernel": self.init(rng, (kh, kw, in_ch, self.filters),
+                                      param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        kernel = jnp.asarray(params["kernel"], x.dtype)
+        y = jax.lax.conv_transpose(
+            x, kernel, strides=self.strides, padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        h, w, _ = input_shape
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        return ((h - 1) * sh + kh, (w - 1) * sw + kw, self.filters)
+
+
+class SeparableConvolution2D(Layer):
+    """Depthwise conv then 1x1 pointwise conv (SeparableConvolution2D.scala).
+    Two small MXU GEMMs instead of one dense conv — the MobileNet/Xception op."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode: str = "valid", subsample=(1, 1),
+                 depth_multiplier: int = 1, init="glorot_uniform",
+                 use_bias: bool = True, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.filters = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.strides = _pair(subsample)
+        self.padding = border_mode.upper()
+        self.depth_multiplier = int(depth_multiplier)
+        self.activation = get_activation(activation)
+        self.init = get_initializer(init)
+        self.use_bias = use_bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        kh, kw = self.kernel_size
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "depthwise_kernel": self.init(
+                k1, (kh, kw, 1, in_ch * self.depth_multiplier), param_dtype()),
+            "pointwise_kernel": self.init(
+                k2, (1, 1, in_ch * self.depth_multiplier, self.filters),
+                param_dtype()),
+        }
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        dw = jnp.asarray(params["depthwise_kernel"], x.dtype)
+        pw = jnp.asarray(params["pointwise_kernel"], x.dtype)
+        y = jax.lax.conv_general_dilated(
+            x, dw, window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=x.shape[-1])
+        y = jax.lax.conv_general_dilated(
+            y, pw, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        h, w, _ = input_shape
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        if self.padding == "SAME":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        return (oh, ow, self.filters)
+
+
+class AtrousConvolution2D(Layer):
+    """Dilated 2D conv (AtrousConvolution2D.scala); ``atrous_rate`` becomes
+    XLA ``rhs_dilation`` — no dilated-kernel materialization."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), atrous_rate=(1, 1),
+                 border_mode: str = "valid", init="glorot_uniform",
+                 use_bias: bool = True, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.filters = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.strides = _pair(subsample)
+        self.rate = _pair(atrous_rate)
+        self.padding = border_mode.upper()
+        self.activation = get_activation(activation)
+        self.init = get_initializer(init)
+        self.use_bias = use_bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        kh, kw = self.kernel_size
+        params = {"kernel": self.init(rng, (kh, kw, in_ch, self.filters),
+                                      param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        kernel = jnp.asarray(params["kernel"], x.dtype)
+        y = jax.lax.conv_general_dilated(
+            x, kernel, window_strides=self.strides, padding=self.padding,
+            rhs_dilation=self.rate,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        h, w, _ = input_shape
+        kh = (self.kernel_size[0] - 1) * self.rate[0] + 1
+        kw = (self.kernel_size[1] - 1) * self.rate[1] + 1
+        sh, sw = self.strides
+        if self.padding == "SAME":
+            return (-(-h // sh), -(-w // sw), self.filters)
+        return ((h - kh) // sh + 1, (w - kw) // sw + 1, self.filters)
+
+
+class AtrousConvolution1D(Layer):
+    """Dilated 1D conv over (B, steps, dim) (AtrousConvolution1D.scala)."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, atrous_rate: int = 1,
+                 border_mode: str = "valid", init="glorot_uniform",
+                 use_bias: bool = True, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.filters = int(nb_filter)
+        self.kernel_size = int(filter_length)
+        self.stride = int(subsample_length)
+        self.rate = int(atrous_rate)
+        self.padding = border_mode.upper()
+        self.activation = get_activation(activation)
+        self.init = get_initializer(init)
+        self.use_bias = use_bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        params = {"kernel": self.init(
+            rng, (self.kernel_size, in_ch, self.filters), param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        kernel = jnp.asarray(params["kernel"], x.dtype)
+        y = jax.lax.conv_general_dilated(
+            x, kernel, window_strides=(self.stride,), padding=self.padding,
+            rhs_dilation=(self.rate,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        steps, _ = input_shape
+        k = (self.kernel_size - 1) * self.rate + 1
+        if self.padding == "SAME":
+            return (-(-steps // self.stride), self.filters)
+        return ((steps - k) // self.stride + 1, self.filters)
+
+
+class ShareConvolution2D(Layer):
+    """Conv2D with explicit (pad_h, pad_w) zero padding (ShareConvolution2D.scala
+    — the reference variant shares the weight tensor across replicas and offers
+    ``propagateBack``; in a functional pjit design weights are shared by
+    construction and gradient flow is controlled by ``jax.lax.stop_gradient``,
+    so only the padding semantics remain to express)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), pad_h: int = 0,
+                 pad_w: int = 0, propagate_back: bool = True,
+                 init="glorot_uniform", use_bias: bool = True, name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.filters = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.strides = _pair(subsample)
+        self.pad = (int(pad_h), int(pad_w))
+        self.propagate_back = bool(propagate_back)
+        self.activation = get_activation(activation)
+        self.init = get_initializer(init)
+        self.use_bias = use_bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        kh, kw = self.kernel_size
+        params = {"kernel": self.init(rng, (kh, kw, in_ch, self.filters),
+                                      param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        if not self.propagate_back:
+            x = jax.lax.stop_gradient(x)
+        kernel = jnp.asarray(params["kernel"], x.dtype)
+        ph, pw = self.pad
+        y = jax.lax.conv_general_dilated(
+            x, kernel, window_strides=self.strides,
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        h, w, _ = input_shape
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        ph, pw = self.pad
+        return ((h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1,
+                self.filters)
+
+
+# --------------------------------------------------------- locally connected
+
+class LocallyConnected2D(Layer):
+    """Conv2D with UNSHARED weights per output position (LocallyConnected2D.scala).
+
+    Patches are gathered with static shifted slices (kernel positions unroll at
+    trace time) and contracted against a per-position weight in one einsum —
+    a single batched MXU GEMM instead of the reference's per-position loop.
+    """
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode: str = "valid", subsample=(1, 1),
+                 init="glorot_uniform", use_bias: bool = True, name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        if border_mode.lower() != "valid":
+            raise ValueError("LocallyConnected2D only supports border_mode="
+                             "'valid' (LocallyConnected2D.scala parity)")
+        self.filters = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.strides = _pair(subsample)
+        self.activation = get_activation(activation)
+        self.init = get_initializer(init)
+        self.use_bias = use_bias
+
+    def _out_hw(self, input_shape):
+        h, w = input_shape[0], input_shape[1]
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        kh, kw = self.kernel_size
+        oh, ow = self._out_hw(input_shape)
+        params = {"kernel": self.init(
+            rng, (oh, ow, kh * kw * in_ch, self.filters), param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((oh, ow, self.filters), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        kernel = jnp.asarray(params["kernel"], x.dtype)
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        oh, ow = self._out_hw(x.shape[1:])
+        patches = [x[:, i:i + oh * sh:sh, j:j + ow * sw:sw, :]
+                   for i in range(kh) for j in range(kw)]
+        # (B, OH, OW, KH*KW*C) with (kh, kw, c) ordering matching the kernel
+        p = jnp.concatenate(patches, axis=-1)
+        y = jnp.einsum("bhwk,hwkf->bhwf", p, kernel)
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        oh, ow = self._out_hw(input_shape)
+        return (oh, ow, self.filters)
+
+
+class LocallyConnected1D(Layer):
+    """1D unshared conv over (B, steps, dim) (LocallyConnected1D.scala)."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, init="glorot_uniform",
+                 use_bias: bool = True, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.filters = int(nb_filter)
+        self.kernel_size = int(filter_length)
+        self.stride = int(subsample_length)
+        self.activation = get_activation(activation)
+        self.init = get_initializer(init)
+        self.use_bias = use_bias
+
+    def _out_len(self, steps):
+        return (steps - self.kernel_size) // self.stride + 1
+
+    def build(self, rng, input_shape):
+        steps, in_ch = input_shape
+        ol = self._out_len(steps)
+        params = {"kernel": self.init(
+            rng, (ol, self.kernel_size * in_ch, self.filters), param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((ol, self.filters), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        kernel = jnp.asarray(params["kernel"], x.dtype)
+        ol = self._out_len(x.shape[1])
+        patches = [x[:, i:i + ol * self.stride:self.stride, :]
+                   for i in range(self.kernel_size)]
+        p = jnp.concatenate(patches, axis=-1)   # (B, OL, K*C)
+        y = jnp.einsum("blk,lkf->blf", p, kernel)
+        if self.use_bias:
+            y = y + jnp.asarray(params["bias"], x.dtype)
+        return self.activation(y), state
+
+    def compute_output_shape(self, input_shape):
+        return (self._out_len(input_shape[0]), self.filters)
+
+
+# ------------------------------------------------------------ crop / pad / up
+
+class Cropping1D(Layer):
+    """Crop (left, right) steps from (B, steps, dim) (Cropping1D.scala)."""
+
+    def __init__(self, cropping=(1, 1), name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.cropping = _pair(cropping)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        a, b = self.cropping
+        return x[:, a:x.shape[1] - b, :], state
+
+    def compute_output_shape(self, input_shape):
+        steps, c = input_shape
+        return (steps - sum(self.cropping), c)
+
+
+class Cropping2D(Layer):
+    """Crop ((top, bottom), (left, right)) from (B, H, W, C) (Cropping2D.scala)."""
+
+    def __init__(self, cropping=((0, 0), (0, 0)), name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.h_crop = tuple(cropping[0])
+        self.w_crop = tuple(cropping[1])
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        (t, b), (l, r) = self.h_crop, self.w_crop
+        return x[:, t:x.shape[1] - b, l:x.shape[2] - r, :], state
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (h - sum(self.h_crop), w - sum(self.w_crop), c)
+
+
+class Cropping3D(Layer):
+    """Crop three spatial dims of (B, D1, D2, D3, C) (Cropping3D.scala)."""
+
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.crops = tuple(tuple(c) for c in cropping)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        (a1, b1), (a2, b2), (a3, b3) = self.crops
+        return x[:, a1:x.shape[1] - b1, a2:x.shape[2] - b2,
+                 a3:x.shape[3] - b3, :], state
+
+    def compute_output_shape(self, input_shape):
+        d1, d2, d3, c = input_shape
+        return tuple(d - sum(cr) for d, cr in zip((d1, d2, d3), self.crops)) + (c,)
+
+
+class ZeroPadding1D(Layer):
+    def __init__(self, padding=1, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.pad = _pair(padding)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        a, b = self.pad
+        return jnp.pad(x, ((0, 0), (a, b), (0, 0))), state
+
+    def compute_output_shape(self, input_shape):
+        steps, c = input_shape
+        return (steps + sum(self.pad), c)
+
+
+class ZeroPadding3D(Layer):
+    def __init__(self, padding=(1, 1, 1), name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.pad = _triple(padding)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        p1, p2, p3 = self.pad
+        return jnp.pad(x, ((0, 0), (p1, p1), (p2, p2), (p3, p3), (0, 0))), state
+
+    def compute_output_shape(self, input_shape):
+        d1, d2, d3, c = input_shape
+        return (d1 + 2 * self.pad[0], d2 + 2 * self.pad[1],
+                d3 + 2 * self.pad[2], c)
+
+
+class UpSampling1D(Layer):
+    def __init__(self, length: int = 2, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.length = int(length)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.repeat(x, self.length, axis=1), state
+
+    def compute_output_shape(self, input_shape):
+        steps, c = input_shape
+        return (steps * self.length, c)
+
+
+class UpSampling3D(Layer):
+    def __init__(self, size=(2, 2, 2), name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.size = _triple(size)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = jnp.repeat(x, self.size[0], axis=1)
+        y = jnp.repeat(y, self.size[1], axis=2)
+        return jnp.repeat(y, self.size[2], axis=3), state
+
+    def compute_output_shape(self, input_shape):
+        d1, d2, d3, c = input_shape
+        return (d1 * self.size[0], d2 * self.size[1], d3 * self.size[2], c)
+
+
+# ------------------------------------------------------------------ pooling 3D
+
+class _Pool3D(Layer):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, border_mode="valid",
+                 name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.pool_size = _triple(pool_size)
+        self.strides = _triple(strides) if strides is not None else self.pool_size
+        self.padding = border_mode.upper()
+
+    def _reduce(self, x, init, op):
+        return jax.lax.reduce_window(
+            x, init, op, window_dimensions=(1,) + self.pool_size + (1,),
+            window_strides=(1,) + self.strides + (1,), padding=self.padding)
+
+    def compute_output_shape(self, input_shape):
+        dims, c = input_shape[:-1], input_shape[-1]
+        out = []
+        for d, p, s in zip(dims, self.pool_size, self.strides):
+            out.append(-(-d // s) if self.padding == "SAME"
+                       else (d - p) // s + 1)
+        return tuple(out) + (c,)
+
+
+class MaxPooling3D(_Pool3D):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self._reduce(x, -jnp.inf, jax.lax.max), state
+
+
+class AveragePooling3D(_Pool3D):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        summed = self._reduce(x, 0.0, jax.lax.add)
+        return summed / float(np.prod(self.pool_size)), state
+
+
+class GlobalMaxPooling3D(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.max(x, axis=(1, 2, 3)), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+
+class GlobalAveragePooling3D(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.mean(x, axis=(1, 2, 3)), state
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+
+# ------------------------------------------------------------------- resample
+
+class ResizeBilinear(Layer):
+    """Bilinear image resize of (B, H, W, C) (ResizeBilinear.scala → BigDL
+    nn.ResizeBilinear, which mirrors TF1 resize semantics):
+    ``align_corners=False`` uses the legacy scale ``in/out`` (src = i*scale),
+    ``align_corners=True`` uses ``(in-1)/(out-1)``."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.out_h = int(output_height)
+        self.out_w = int(output_width)
+        self.align_corners = bool(align_corners)
+
+    def _src_coords(self, out_size: int, in_size: int):
+        if self.align_corners and out_size > 1:
+            scale = (in_size - 1) / (out_size - 1)
+        else:
+            scale = in_size / out_size
+        src = jnp.arange(out_size, dtype=jnp.float32) * scale
+        lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_size - 1)
+        hi = jnp.clip(lo + 1, 0, in_size - 1)
+        frac = jnp.clip(src - lo.astype(jnp.float32), 0.0, 1.0)
+        return lo, hi, frac
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        h, w = x.shape[1], x.shape[2]
+        ylo, yhi, yf = self._src_coords(self.out_h, h)
+        xlo, xhi, xf = self._src_coords(self.out_w, w)
+        yf = yf[None, :, None, None].astype(x.dtype)
+        xf = xf[None, None, :, None].astype(x.dtype)
+        top = x[:, ylo][:, :, xlo] * (1 - xf) + x[:, ylo][:, :, xhi] * xf
+        bot = x[:, yhi][:, :, xlo] * (1 - xf) + x[:, yhi][:, :, xhi] * xf
+        return top * (1 - yf) + bot * yf, state
+
+    def compute_output_shape(self, input_shape):
+        return (self.out_h, self.out_w, input_shape[-1])
+
+
+# ----------------------------------------------------------------------- LRN
+
+class LRN2D(Layer):
+    """Cross-channel local response normalization (LRN2D.scala):
+    y = x / (k + alpha/n * sum_{n-window over channels} x^2) ** beta."""
+
+    def __init__(self, alpha: float = 1e-4, k: float = 1.0, beta: float = 0.75,
+                 n: int = 5, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.alpha, self.k, self.beta, self.n = (float(alpha), float(k),
+                                                 float(beta), int(n))
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        sq = x * x
+        window_sum = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, window_dimensions=(1, 1, 1, self.n),
+            window_strides=(1, 1, 1, 1), padding="SAME")
+        denom = (self.k + (self.alpha / self.n) * window_sum) ** self.beta
+        return x / denom, state
+
+
+class WithinChannelLRN2D(Layer):
+    """Within-channel LRN over a size×size spatial window
+    (WithinChannelLRN2D.scala): y = x / (1 + alpha/size² * sum x²) ** beta."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.size, self.alpha, self.beta = int(size), float(alpha), float(beta)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        sq = x * x
+        window_sum = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, self.size, self.size, 1),
+            window_strides=(1, 1, 1, 1), padding="SAME")
+        denom = (1.0 + (self.alpha / (self.size * self.size)) * window_sum
+                 ) ** self.beta
+        return x / denom, state

@@ -263,6 +263,72 @@ class Masking(Layer):
         return jnp.where(keep, x, 0.0).astype(x.dtype), state
 
 
+class Highway(Layer):
+    """Densely connected highway layer (Highway.scala):
+    ``y = T ⊙ act(W_h x + b_h) + (1 - T) ⊙ x`` with transform gate
+    ``T = sigmoid(W_t x + b_t)``. Both projections run as one fused
+    ``(B, D) @ (D, 2D)`` MXU matmul."""
+
+    def __init__(self, activation=None, use_bias: bool = True,
+                 init="glorot_uniform", name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.activation = get_activation(activation)
+        self.use_bias = use_bias
+        self.init = get_initializer(init)
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        params = {"kernel": self.init(rng, (d, 2 * d), param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((2 * d,), param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        z = x @ jnp.asarray(params["kernel"], x.dtype)
+        if self.use_bias:
+            z = z + jnp.asarray(params["bias"], x.dtype)
+        d = x.shape[-1]
+        gate = jax.nn.sigmoid(z[..., :d])
+        h = self.activation(z[..., d:])
+        return gate * h + (1.0 - gate) * x, state
+
+
+class MaxoutDense(Layer):
+    """Element-wise max over ``nb_feature`` linear projections (MaxoutDense.scala)
+    — learns a convex piecewise-linear activation. One
+    ``(B, D) @ (D, nb_feature*out)`` matmul, then a reshape + max."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 use_bias: bool = True, init="glorot_uniform", name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.output_dim = int(output_dim)
+        self.nb_feature = int(nb_feature)
+        self.use_bias = use_bias
+        self.init = get_initializer(init)
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        params = {"kernel": self.init(
+            rng, (d, self.nb_feature * self.output_dim), param_dtype())}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.nb_feature * self.output_dim,),
+                                       param_dtype())
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        z = x @ jnp.asarray(params["kernel"], x.dtype)
+        if self.use_bias:
+            z = z + jnp.asarray(params["bias"], x.dtype)
+        z = z.reshape(z.shape[:-1] + (self.nb_feature, self.output_dim))
+        return jnp.max(z, axis=-2), state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
 class Lambda(Layer):
     """Wrap an arbitrary JAX function as a layer.
 

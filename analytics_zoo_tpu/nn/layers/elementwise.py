@@ -1,0 +1,358 @@
+"""Element-wise / table torch-style layers wrapped in Keras form.
+
+Parity targets (all /root/reference/zoo/src/main/scala/com/intel/analytics/zoo/
+pipeline/api/keras/layers/): AddConstant.scala, MulConstant.scala, Exp.scala,
+Log.scala, Power.scala, Sqrt.scala, Square.scala, Negative.scala, Identity.scala,
+Mul.scala, CAdd.scala, CMul.scala, Scale.scala, Threshold.scala,
+BinaryThreshold.scala, HardTanh.scala, HardShrink.scala, SoftShrink.scala,
+GetShape.scala, Max.scala, SelectTable.scala, SplitTensor.scala, Expand.scala,
+GaussianSampler.scala, KerasLayerWrapper.scala.
+
+Every layer is a pure ``jnp`` expression — XLA fuses them into neighbouring ops,
+so unlike the reference (one BigDL module object + buffers each) these cost
+nothing at runtime beyond the arithmetic itself.
+
+Convention note: ``dim``/``size`` arguments are batch-EXCLUDED like the
+reference's Keras wrappers (a ``size`` of ``(1, C)`` scales per-channel for
+``(B, 1, C)``-broadcastable inputs).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..module import Layer, Shape, as_compute, get_initializer, param_dtype
+
+
+# ------------------------------------------------------------------ constants
+
+class AddConstant(Layer):
+    """y = x + constant (AddConstant.scala)."""
+
+    def __init__(self, constant: float, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.constant = float(constant)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x + self.constant, state
+
+
+class MulConstant(Layer):
+    """y = x * constant (MulConstant.scala)."""
+
+    def __init__(self, constant: float, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.constant = float(constant)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x * self.constant, state
+
+
+class Exp(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.exp(as_compute(x)), state
+
+
+class Log(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.log(as_compute(x)), state
+
+
+class Power(Layer):
+    """y = (shift + scale * x) ** power (Power.scala)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.power, self.scale, self.shift = float(power), float(scale), float(shift)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return (self.shift + self.scale * as_compute(x)) ** self.power, state
+
+
+class Sqrt(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.sqrt(as_compute(x)), state
+
+
+class Square(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        return x * x, state
+
+
+class Negative(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return -x, state
+
+
+class Identity(Layer):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x, state
+
+
+# ------------------------------------------------------- learnable point-wise
+
+class Mul(Layer):
+    """Single learnable scalar multiplier (Mul.scala)."""
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones((1,), param_dtype())}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        return x * jnp.asarray(params["weight"], x.dtype), state
+
+
+class CAdd(Layer):
+    """Learnable bias of shape ``size`` broadcast-added to the input
+    (CAdd.scala — expand on singleton dims)."""
+
+    def __init__(self, size: Sequence[int], b_regularizer=None, name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"bias": jnp.zeros(self.size, param_dtype())}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        return x + jnp.asarray(params["bias"], x.dtype), state
+
+
+class CMul(Layer):
+    """Learnable scale of shape ``size`` broadcast-multiplied (CMul.scala)."""
+
+    def __init__(self, size: Sequence[int], w_regularizer=None, name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size, param_dtype())}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        return x * jnp.asarray(params["weight"], x.dtype), state
+
+
+class Scale(Layer):
+    """CMul then CAdd with weights/bias of shape ``size`` (Scale.scala)."""
+
+    def __init__(self, size: Sequence[int], name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size, param_dtype()),
+                "bias": jnp.zeros(self.size, param_dtype())}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        return (x * jnp.asarray(params["weight"], x.dtype)
+                + jnp.asarray(params["bias"], x.dtype)), state
+
+
+# ------------------------------------------------------------------ threshold
+
+class Threshold(Layer):
+    """x if x > th else v (Threshold.scala)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.th, self.v = float(th), float(v)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        return jnp.where(x > self.th, x, jnp.asarray(self.v, x.dtype)), state
+
+
+class BinaryThreshold(Layer):
+    """1 if x > value else 0 (BinaryThreshold.scala)."""
+
+    def __init__(self, value: float = 1e-6, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.value = float(value)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        return (x > self.value).astype(x.dtype), state
+
+
+class HardTanh(Layer):
+    """clip(x, min_value, max_value) (HardTanh.scala)."""
+
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.min_value, self.max_value = float(min_value), float(max_value)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.clip(as_compute(x), self.min_value, self.max_value), state
+
+
+class HardShrink(Layer):
+    """x where |x| > value else 0 (HardShrink.scala)."""
+
+    def __init__(self, value: float = 0.5, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.value = float(value)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        return jnp.where(jnp.abs(x) > self.value, x, 0.0).astype(x.dtype), state
+
+
+class SoftShrink(Layer):
+    """x-v if x>v; x+v if x<-v; else 0 (SoftShrink.scala)."""
+
+    def __init__(self, value: float = 0.5, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.value = float(value)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        v = self.value
+        return (jnp.where(x > v, x - v, 0.0)
+                + jnp.where(x < -v, x + v, 0.0)).astype(x.dtype), state
+
+
+# --------------------------------------------------------------- shape/table
+
+class GetShape(Layer):
+    """Output the (static) input shape as a 1D int array (GetShape.scala).
+
+    Shapes are compile-time constants under jit, so this emits a constant."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.asarray(np.asarray(x.shape, dtype=np.int32)), state
+
+    def compute_output_shape(self, input_shape):
+        return (len(input_shape) + 1,)
+
+
+class Max(Layer):
+    """Max over (batch-excluded) ``dim``; optionally return argmax indices
+    instead of values (Max.scala ``returnValue``)."""
+
+    def __init__(self, dim: int, return_value: bool = True, name=None,
+                 input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.dim = int(dim)
+        self.return_value = bool(return_value)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        axis = self.dim + 1
+        if self.return_value:
+            return jnp.max(x, axis=axis), state
+        return jnp.argmax(x, axis=axis).astype(jnp.int32), state
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        del shape[self.dim]
+        return tuple(shape)
+
+
+class SelectTable(Layer):
+    """Pick element ``index`` from a list/tuple input (SelectTable.scala;
+    0-based like the zoo wrapper)."""
+
+    def __init__(self, index: int, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.index = int(index)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x[self.index], state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[self.index])
+
+
+class SplitTensor(Layer):
+    """Split along (batch-excluded) ``dim`` into ``num`` equal chunks, output
+    a list (SplitTensor.scala)."""
+
+    def __init__(self, dim: int, num: int, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.dim, self.num = int(dim), int(num)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return list(jnp.split(x, self.num, axis=self.dim + 1)), state
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        shape[self.dim] //= self.num
+        return [tuple(shape)] * self.num
+
+
+class Expand(Layer):
+    """Broadcast singleton dims to ``tgt_sizes`` (Expand.scala / InternalExpand;
+    ``tgt_sizes`` INCLUDES the batch dim, -1 keeps a dim)."""
+
+    def __init__(self, tgt_sizes: Sequence[int], name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.tgt_sizes = tuple(int(s) for s in tgt_sizes)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        tgt = tuple(x.shape[i] if s == -1 else s
+                    for i, s in enumerate(self.tgt_sizes))
+        return jnp.broadcast_to(x, tgt), state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(self.tgt_sizes[1:])
+
+
+class GaussianSampler(Layer):
+    """Sample from N(mean, exp(log_var)) given input [mean, log_var]
+    (GaussianSampler.scala — the VAE reparameterization layer).
+
+    Deterministic at inference (returns the mean), stochastic in training."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        mean, log_var = x
+        if not training:
+            return mean, state
+        if rng is None:
+            raise ValueError(f"{self.name}: sampling in training mode needs rng")
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(0.5 * log_var) * eps, state
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[0])
+
+
+class KerasLayerWrapper(Layer):
+    """Wrap any ``Layer`` (or pure ``fn(x)``) as a Keras-style layer
+    (KerasLayerWrapper.scala — there it adapts torch-style BigDL modules; here
+    any module following the build/apply protocol already fits, so this wrapper
+    exists for API parity and for wrapping bare callables)."""
+
+    def __init__(self, module, output_shape_fn: Optional[Callable] = None,
+                 name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.module = module if isinstance(module, Layer) else None
+        self.fn = None if isinstance(module, Layer) else module
+        self.output_shape_fn = output_shape_fn
+
+    def build(self, rng, input_shape):
+        if self.module is not None:
+            return self.module.build(rng, input_shape)
+        return {}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if self.module is not None:
+            return self.module.apply(params, state, x, training=training, rng=rng)
+        return self.fn(x), state
+
+    def compute_output_shape(self, input_shape):
+        if self.output_shape_fn is not None:
+            return self.output_shape_fn(input_shape)
+        if self.module is not None:
+            return self.module.compute_output_shape(input_shape)
+        return input_shape

@@ -139,6 +139,103 @@ class GRU(_RNNBase):
         return h, h
 
 
+class _ConvLSTMBase(_RNNBase):
+    """Convolutional LSTM over spatial inputs (ConvLSTM2D/3D.scala parity,
+    channels-LAST here vs the reference's CHANNEL_FIRST-only).
+
+    Gates are computed by ONE input conv producing 4·filters channels (strided,
+    same/valid per ``border_mode``) plus ONE 'same' recurrent conv on the hidden
+    state — two conv ops per step, both MXU-lowered, scanned over time with
+    ``lax.scan`` like the dense RNNs.
+    """
+
+    n_spatial = 2
+
+    def __init__(self, output_dim: int, nb_kernel: int, activation="tanh",
+                 inner_activation="hard_sigmoid", border_mode: str = "valid",
+                 subsample: int = 1, return_sequences=False, go_backwards=False,
+                 init="glorot_uniform", inner_init="glorot_uniform", name=None,
+                 input_shape=None):
+        super().__init__(output_dim, activation, return_sequences, go_backwards,
+                         init, inner_init, name=name, input_shape=input_shape)
+        self.nb_kernel = int(nb_kernel)
+        self.padding = border_mode.upper()
+        self.stride = int(subsample)
+        self.inner_activation = get_activation(inner_activation)
+        nd = self.n_spatial
+        self._dn = (("NHWC", "HWIO", "NHWC") if nd == 2
+                    else ("NDHWC", "DHWIO", "NDHWC"))
+
+    def _spatial_out(self, spatial):
+        k, s = self.nb_kernel, self.stride
+        if self.padding == "SAME":
+            return tuple(-(-d // s) for d in spatial)
+        return tuple((d - k) // s + 1 for d in spatial)
+
+    def build(self, rng, input_shape):
+        # input_shape: (T, *spatial, C)
+        in_ch = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        ksp = (self.nb_kernel,) * self.n_spatial
+        params = {
+            "kernel": self.init(k1, ksp + (in_ch, 4 * self.output_dim),
+                                param_dtype()),
+            "recurrent_kernel": self.inner_init(
+                k2, ksp + (self.output_dim, 4 * self.output_dim), param_dtype()),
+            "bias": jnp.zeros((4 * self.output_dim,), param_dtype()),
+        }
+        self._hidden_spatial = self._spatial_out(input_shape[1:-1])
+        return params, {}
+
+    def initial_carry(self, batch, dtype):
+        shape = (batch,) + self._hidden_spatial + (self.output_dim,)
+        z = jnp.zeros(shape, dtype)
+        return (z, z)
+
+    def step(self, p, carry, x_t):
+        h_prev, c_prev = carry
+        nd = self.n_spatial
+        zx = jax.lax.conv_general_dilated(
+            x_t, p["kernel"], window_strides=(self.stride,) * nd,
+            padding=self.padding, dimension_numbers=self._dn)
+        zh = jax.lax.conv_general_dilated(
+            h_prev, p["recurrent_kernel"], window_strides=(1,) * nd,
+            padding="SAME", dimension_numbers=self._dn)
+        z = zx + zh + p["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = self.inner_activation(i)
+        f = self.inner_activation(f)
+        o = self.inner_activation(o)
+        g = self.activation(g)
+        c = f * c_prev + i * g
+        h = o * self.activation(c)
+        return (h, c), h
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        self._hidden_spatial = self._spatial_out(x.shape[2:-1])
+        return super().apply(params, state, x, training=training, rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        steps = input_shape[0]
+        spatial = self._spatial_out(input_shape[1:-1])
+        out = spatial + (self.output_dim,)
+        if self.return_sequences:
+            return (steps,) + out
+        return out
+
+
+class ConvLSTM2D(_ConvLSTMBase):
+    """(B, T, H, W, C) → conv-LSTM (ConvLSTM2D.scala)."""
+
+    n_spatial = 2
+
+
+class ConvLSTM3D(_ConvLSTMBase):
+    """(B, T, D, H, W, C) → conv-LSTM (ConvLSTM3D.scala)."""
+
+    n_spatial = 3
+
+
 class Bidirectional(Layer):
     """Run a recurrent layer forward+backward and merge (Bidirectional.scala)."""
 

@@ -1,0 +1,493 @@
+"""Tests for the extended layer library (VERDICT round-1 Missing #1 closure).
+
+Differential tests use torch as the golden oracle where torch has the same op
+(the reference's KerasRunner pattern, SURVEY.md §4); layers without a torch
+counterpart are verified against hand-computed numpy or structural invariants.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.nn import layers as L
+
+torch = pytest.importorskip("torch")
+
+
+def run(layer, x, shape=None, key=0, training=False, rng_key=None):
+    params, state = layer.build(jax.random.PRNGKey(key),
+                                shape if shape is not None else x.shape[1:])
+    y, _ = layer.apply(params, state, jnp.asarray(x), training=training,
+                       rng=rng_key)
+    return np.asarray(y), params
+
+
+# ------------------------------------------------------------ elementwise math
+def test_elementwise_math_layers():
+    x = np.random.default_rng(0).uniform(0.5, 2.0, (4, 5)).astype("float32")
+    cases = [
+        (L.AddConstant(2.5), x + 2.5),
+        (L.MulConstant(-3.0), x * -3.0),
+        (L.Exp(), np.exp(x)),
+        (L.Log(), np.log(x)),
+        (L.Power(2.0, scale=3.0, shift=1.0), (1.0 + 3.0 * x) ** 2),
+        (L.Sqrt(), np.sqrt(x)),
+        (L.Square(), x * x),
+        (L.Negative(), -x),
+        (L.Identity(), x),
+    ]
+    for layer, want in cases:
+        got, _ = run(layer, x)
+        np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=type(layer).__name__)
+
+
+def test_threshold_family_matches_torch():
+    x = np.random.default_rng(1).standard_normal((6, 7)).astype("float32")
+    xt = torch.from_numpy(x)
+    checks = [
+        (L.Threshold(th=0.2, v=-1.0), torch.nn.Threshold(0.2, -1.0)(xt)),
+        (L.HardShrink(0.4), torch.nn.Hardshrink(0.4)(xt)),
+        (L.SoftShrink(0.4), torch.nn.Softshrink(0.4)(xt)),
+        (L.HardTanh(-0.7, 0.9), torch.nn.Hardtanh(-0.7, 0.9)(xt)),
+    ]
+    for layer, want in checks:
+        got, _ = run(layer, x)
+        np.testing.assert_allclose(got, want.numpy(), atol=1e-6,
+                                   err_msg=type(layer).__name__)
+    got, _ = run(L.BinaryThreshold(0.1), x)
+    np.testing.assert_allclose(got, (x > 0.1).astype("float32"))
+
+
+def test_learnable_pointwise_layers():
+    x = np.random.default_rng(2).standard_normal((3, 4, 5)).astype("float32")
+    y, params = run(L.Mul(), x)
+    np.testing.assert_allclose(y, x, atol=1e-6)  # weight starts at 1
+    assert params["weight"].shape == (1,)
+
+    cadd = L.CAdd((1, 5))
+    params, _ = cadd.build(jax.random.PRNGKey(0), (4, 5))
+    params = {"bias": jnp.asarray(np.arange(5, dtype="float32")).reshape(1, 5)}
+    y, _ = cadd.apply(params, {}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), x + np.arange(5, dtype="float32"))
+
+    cmul = L.CMul((1, 5))
+    y2, _ = cmul.apply({"weight": params["bias"]}, {}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y2), x * np.arange(5, dtype="float32"))
+
+    scale = L.Scale((1, 5))
+    sp = {"weight": 2.0 * jnp.ones((1, 5)), "bias": jnp.ones((1, 5))}
+    y3, _ = scale.apply(sp, {}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y3), 2 * x + 1, rtol=1e-6)
+
+
+def test_shape_and_table_layers():
+    x = np.random.default_rng(3).standard_normal((2, 6, 4)).astype("float32")
+    y, _ = run(L.GetShape(), x)
+    np.testing.assert_array_equal(y, [2, 6, 4])
+
+    y, _ = run(L.Max(dim=0), x)  # max over the steps dim
+    np.testing.assert_allclose(y, x.max(axis=1), atol=1e-6)
+    y, _ = run(L.Max(dim=1, return_value=False), x)
+    np.testing.assert_array_equal(y, x.argmax(axis=2))
+
+    parts, _ = L.SplitTensor(dim=0, num=3).apply({}, {}, jnp.asarray(x))
+    assert len(parts) == 3 and parts[0].shape == (2, 2, 4)
+    np.testing.assert_allclose(np.asarray(parts[1]), x[:, 2:4], atol=1e-6)
+
+    sel, _ = L.SelectTable(1).apply({}, {}, [jnp.zeros(3), jnp.asarray(x)])
+    np.testing.assert_allclose(np.asarray(sel), x)
+
+    ex, _ = L.Expand((2, 6, 4)).apply({}, {}, jnp.asarray(x[:, :1, :]))
+    assert ex.shape == (2, 6, 4)
+    np.testing.assert_allclose(np.asarray(ex)[:, 3], x[:, 0], atol=1e-6)
+
+
+def test_gaussian_sampler_and_wrapper():
+    rng = np.random.default_rng(4)
+    mean = rng.standard_normal((8, 3)).astype("float32")
+    log_var = np.full((8, 3), -10.0, dtype="float32")  # tiny variance
+    layer = L.GaussianSampler()
+    y, _ = layer.apply({}, {}, [jnp.asarray(mean), jnp.asarray(log_var)],
+                       training=True, rng=jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(y), mean, atol=0.05)
+    y_eval, _ = layer.apply({}, {}, [jnp.asarray(mean), jnp.asarray(log_var)])
+    np.testing.assert_allclose(np.asarray(y_eval), mean)  # deterministic eval
+
+    wrapped = L.KerasLayerWrapper(L.Dense(4))
+    y, params = run(wrapped, mean)
+    assert y.shape == (8, 4) and "kernel" in params
+    fn_wrapped = L.KerasLayerWrapper(lambda x: x * 2)
+    y2, _ = run(fn_wrapped, mean)
+    np.testing.assert_allclose(y2, mean * 2)
+
+
+# ------------------------------------------------------- advanced activations
+def test_parametric_activations_match_torch():
+    x = np.random.default_rng(5).standard_normal((5, 6)).astype("float32")
+    xt = torch.from_numpy(x)
+    got, _ = run(L.LeakyReLU(0.3), x)
+    np.testing.assert_allclose(got, torch.nn.LeakyReLU(0.3)(xt).numpy(), atol=1e-6)
+    got, _ = run(L.ELU(1.2), x)
+    np.testing.assert_allclose(got, torch.nn.ELU(1.2)(xt).numpy(), atol=1e-6)
+    got, _ = run(L.PReLU(), x)  # alpha=0.25 shared, torch default
+    np.testing.assert_allclose(got, torch.nn.PReLU()(xt).detach().numpy(),
+                               atol=1e-6)
+    got, _ = run(L.ThresholdedReLU(0.8), x)
+    np.testing.assert_allclose(got, np.where(x > 0.8, x, 0.0), atol=1e-6)
+    got, _ = run(L.Softmax(), x)
+    np.testing.assert_allclose(got, torch.softmax(xt, -1).numpy(), atol=1e-6)
+    # RReLU eval mode = LeakyReLU with mean slope
+    got, _ = run(L.RReLU(0.1, 0.3), x)
+    np.testing.assert_allclose(got, np.where(x >= 0, x, 0.2 * x), atol=1e-6)
+    # RReLU training mode: slope bounded by (lower, upper)
+    got, _ = run(L.RReLU(0.1, 0.3), x, training=True,
+                 rng_key=jax.random.PRNGKey(7))
+    neg = x < 0
+    ratio = got[neg] / x[neg]
+    assert (ratio >= 0.1 - 1e-6).all() and (ratio <= 0.3 + 1e-6).all()
+
+
+def test_srelu_piecewise_formula():
+    x = np.linspace(-3, 3, 61, dtype="float32").reshape(1, 61)
+    layer = L.SReLU()
+    params = {"t_left": jnp.full((61,), -1.0), "a_left": jnp.full((61,), 0.1),
+              "t_right": jnp.full((61,), 1.0), "a_right": jnp.full((61,), 2.0)}
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    y = np.asarray(y)[0]
+    xf = x[0]
+    want = np.where(xf >= 1.0, 1.0 + 2.0 * (xf - 1.0),
+                    np.where(xf <= -1.0, -1.0 + 0.1 * (xf + 1.0), xf))
+    np.testing.assert_allclose(y, want, atol=1e-6)
+    # shared_axes collapses parameter dims
+    l2 = L.SReLU(shared_axes=(1, 2))
+    p2, _ = l2.build(jax.random.PRNGKey(0), (4, 5, 3))
+    assert p2["t_left"].shape == (1, 1, 3)
+
+
+def test_spatial_dropout_drops_whole_channels():
+    x = np.ones((4, 6, 6, 8), dtype="float32")
+    layer = L.SpatialDropout2D(0.5)
+    y, _ = layer.apply({}, {}, jnp.asarray(x), training=True,
+                       rng=jax.random.PRNGKey(3))
+    y = np.asarray(y)
+    # each (sample, channel) map is either all zero or all 1/keep
+    per_map = y.reshape(4, 36, 8)
+    assert ((per_map == 0).all(axis=1) | (per_map == 2.0).all(axis=1)).all()
+    # eval = identity
+    y_eval, _ = layer.apply({}, {}, jnp.asarray(x), training=False)
+    np.testing.assert_allclose(np.asarray(y_eval), x)
+    y1, _ = L.SpatialDropout1D(0.5).apply({}, {}, jnp.ones((2, 5, 4)),
+                                          training=True,
+                                          rng=jax.random.PRNGKey(1))
+    per = np.asarray(y1).reshape(2, 5, 4)
+    assert ((per == 0).all(axis=1) | (per == 2.0).all(axis=1)).all()
+
+
+# ------------------------------------------------------------------ dense ext
+def test_highway_formula_and_grad():
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((8, 5)).astype("float32")
+    layer = L.Highway(activation="relu")
+    params, _ = layer.build(jax.random.PRNGKey(2), (5,))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    k = np.asarray(params["kernel"])
+    b = np.asarray(params["bias"])
+    z = x @ k + b
+    gate = 1 / (1 + np.exp(-z[:, :5]))
+    want = gate * np.maximum(z[:, 5:], 0) + (1 - gate) * x
+    np.testing.assert_allclose(np.asarray(y), want, atol=1e-5)
+    g = jax.grad(lambda p: layer.apply(p, {}, jnp.asarray(x))[0].sum())(params)
+    assert np.isfinite(np.asarray(g["kernel"])).all()
+
+
+def test_maxout_dense():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((6, 4)).astype("float32")
+    layer = L.MaxoutDense(3, nb_feature=4)
+    params, _ = layer.build(jax.random.PRNGKey(1), (4,))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    k = np.asarray(params["kernel"])
+    b = np.asarray(params["bias"])
+    want = (x @ k + b).reshape(6, 4, 3).max(axis=1)
+    np.testing.assert_allclose(np.asarray(y), want, atol=1e-5)
+    assert layer.compute_output_shape((4,)) == (3,)
+
+
+# ----------------------------------------------------------------- conv family
+def test_conv3d_matches_torch():
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((2, 6, 7, 8, 3)).astype("float32")
+    layer = L.Convolution3D(4, 3, 3, 3, subsample=(1, 2, 1))
+    params, _ = layer.build(jax.random.PRNGKey(4), (6, 7, 8, 3))
+    tm = torch.nn.Conv3d(3, 4, 3, stride=(1, 2, 1))
+    with torch.no_grad():
+        tm.weight.copy_(torch.from_numpy(
+            np.transpose(np.asarray(params["kernel"]), (4, 3, 0, 1, 2))))
+        tm.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    with torch.no_grad():
+        yt = tm(torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)))).numpy()
+    np.testing.assert_allclose(np.asarray(y), np.transpose(yt, (0, 2, 3, 4, 1)),
+                               atol=1e-4)
+    assert layer.compute_output_shape((6, 7, 8, 3)) == np.asarray(y).shape[1:]
+
+
+def test_deconvolution2d_matches_torch():
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((2, 5, 5, 3)).astype("float32")
+    layer = L.Deconvolution2D(4, 3, 3, subsample=(2, 2))
+    params, _ = layer.build(jax.random.PRNGKey(5), (5, 5, 3))
+    tm = torch.nn.ConvTranspose2d(3, 4, 3, stride=2)
+    with torch.no_grad():
+        # jax conv_transpose HWIO vs torch (in, out, kH, kW) with flipped taps
+        w = np.asarray(params["kernel"])  # (kh, kw, in, out)
+        tm.weight.copy_(torch.from_numpy(
+            np.transpose(w[::-1, ::-1], (2, 3, 0, 1)).copy()))
+        tm.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    with torch.no_grad():
+        yt = tm(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+    np.testing.assert_allclose(np.asarray(y), np.transpose(yt, (0, 2, 3, 1)),
+                               atol=1e-4)
+    assert layer.compute_output_shape((5, 5, 3)) == (11, 11, 4)
+
+
+def test_atrous_convolution_matches_torch():
+    rng = np.random.default_rng(10)
+    x = rng.standard_normal((2, 12, 12, 3)).astype("float32")
+    layer = L.AtrousConvolution2D(5, 3, 3, atrous_rate=(2, 2))
+    params, _ = layer.build(jax.random.PRNGKey(6), (12, 12, 3))
+    tm = torch.nn.Conv2d(3, 5, 3, dilation=2)
+    with torch.no_grad():
+        tm.weight.copy_(torch.from_numpy(
+            np.transpose(np.asarray(params["kernel"]), (3, 2, 0, 1))))
+        tm.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    with torch.no_grad():
+        yt = tm(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+    np.testing.assert_allclose(np.asarray(y), np.transpose(yt, (0, 2, 3, 1)),
+                               atol=1e-4)
+
+    x1 = rng.standard_normal((2, 20, 4)).astype("float32")
+    l1 = L.AtrousConvolution1D(6, 3, atrous_rate=3)
+    p1, _ = l1.build(jax.random.PRNGKey(7), (20, 4))
+    t1 = torch.nn.Conv1d(4, 6, 3, dilation=3)
+    with torch.no_grad():
+        t1.weight.copy_(torch.from_numpy(
+            np.transpose(np.asarray(p1["kernel"]), (2, 1, 0))))
+        t1.bias.copy_(torch.from_numpy(np.asarray(p1["bias"])))
+    y1, _ = l1.apply(p1, {}, jnp.asarray(x1))
+    with torch.no_grad():
+        yt1 = t1(torch.from_numpy(np.transpose(x1, (0, 2, 1)))).numpy()
+    np.testing.assert_allclose(np.asarray(y1), np.transpose(yt1, (0, 2, 1)),
+                               atol=1e-4)
+
+
+def test_separable_conv_matches_torch_compose():
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((2, 8, 8, 4)).astype("float32")
+    layer = L.SeparableConvolution2D(6, 3, 3, depth_multiplier=2)
+    params, _ = layer.build(jax.random.PRNGKey(8), (8, 8, 4))
+    dw = torch.nn.Conv2d(4, 8, 3, groups=4, bias=False)
+    pw = torch.nn.Conv2d(8, 6, 1)
+    with torch.no_grad():
+        dwk = np.asarray(params["depthwise_kernel"])  # (3,3,1,8)
+        dw.weight.copy_(torch.from_numpy(np.transpose(dwk, (3, 2, 0, 1))))
+        pwk = np.asarray(params["pointwise_kernel"])  # (1,1,8,6)
+        pw.weight.copy_(torch.from_numpy(np.transpose(pwk, (3, 2, 0, 1))))
+        pw.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    with torch.no_grad():
+        yt = pw(dw(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))).numpy()
+    np.testing.assert_allclose(np.asarray(y), np.transpose(yt, (0, 2, 3, 1)),
+                               atol=1e-4)
+
+
+def test_share_convolution_padding_and_stopgrad():
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((2, 7, 7, 3)).astype("float32")
+    layer = L.ShareConvolution2D(4, 3, 3, pad_h=1, pad_w=1)
+    params, _ = layer.build(jax.random.PRNGKey(9), (7, 7, 3))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    assert y.shape == (2, 7, 7, 4)
+    # same math as Convolution2D with SAME padding for odd kernels
+    ref = L.Convolution2D(4, 3, 3, border_mode="same")
+    y2, _ = ref.apply(params, {}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y2), atol=1e-5)
+    # propagate_back=False blocks input grads but not weight grads
+    nb = L.ShareConvolution2D(4, 3, 3, propagate_back=False)
+    pnb, _ = nb.build(jax.random.PRNGKey(9), (7, 7, 3))
+    gx = jax.grad(lambda xx: nb.apply(pnb, {}, xx)[0].sum())(jnp.asarray(x))
+    assert float(jnp.abs(gx).max()) == 0.0
+
+
+def test_locally_connected_layers():
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal((2, 6, 6, 3)).astype("float32")
+    layer = L.LocallyConnected2D(4, 3, 3, subsample=(1, 1))
+    params, _ = layer.build(jax.random.PRNGKey(10), (6, 6, 3))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    assert y.shape == (2, 4, 4, 4)
+    # position (0,0) equals a manual dot of the first patch with its own weight
+    k = np.asarray(params["kernel"])  # (4, 4, 27, 4)
+    patch = np.stack([x[0, i:i + 1, j:j + 4 - 3:1, :]
+                      for i in range(3) for j in range(3)])
+    patch00 = np.concatenate([x[0, i, j, :] for i in range(3) for j in range(3)])
+    want00 = patch00 @ k[0, 0] + np.asarray(params["bias"])[0, 0]
+    np.testing.assert_allclose(np.asarray(y)[0, 0, 0], want00, atol=1e-4)
+    # unshared: zeroing one position's weight changes only that position
+    k2 = k.copy()
+    k2[1, 1] = 0.0
+    y2, _ = layer.apply({"kernel": jnp.asarray(k2), "bias": params["bias"]},
+                        {}, jnp.asarray(x))
+    diff = np.abs(np.asarray(y) - np.asarray(y2))
+    assert diff[:, 1, 1].max() > 0 and diff[:, 0, 0].max() == 0
+
+    x1 = rng.standard_normal((2, 9, 3)).astype("float32")
+    l1 = L.LocallyConnected1D(5, 3, subsample_length=2)
+    p1, _ = l1.build(jax.random.PRNGKey(11), (9, 3))
+    y1, _ = l1.apply(p1, {}, jnp.asarray(x1))
+    assert y1.shape == (2, 4, 5)
+    patch0 = x1[0, 0:3].reshape(-1)
+    want0 = patch0 @ np.asarray(p1["kernel"])[0] + np.asarray(p1["bias"])[0]
+    np.testing.assert_allclose(np.asarray(y1)[0, 0], want0, atol=1e-4)
+
+
+def test_crop_pad_upsample():
+    rng = np.random.default_rng(14)
+    x = rng.standard_normal((2, 8, 6, 3)).astype("float32")
+    y, _ = run(L.Cropping2D(((1, 2), (0, 3))), x)
+    np.testing.assert_allclose(y, x[:, 1:6, 0:3, :])
+    x1 = rng.standard_normal((2, 8, 3)).astype("float32")
+    y, _ = run(L.Cropping1D((2, 1)), x1)
+    np.testing.assert_allclose(y, x1[:, 2:7, :])
+    x3 = rng.standard_normal((2, 5, 6, 7, 3)).astype("float32")
+    y, _ = run(L.Cropping3D(((1, 1), (2, 0), (0, 2))), x3)
+    np.testing.assert_allclose(y, x3[:, 1:4, 2:6, 0:5, :])
+
+    y, _ = run(L.ZeroPadding1D(2), x1)
+    assert y.shape == (2, 12, 3) and (y[:, :2] == 0).all()
+    np.testing.assert_allclose(y[:, 2:10], x1)
+    y, _ = run(L.ZeroPadding3D((1, 2, 3)), x3)
+    assert y.shape == (2, 7, 10, 13, 3)
+    np.testing.assert_allclose(y[:, 1:6, 2:8, 3:10], x3)
+
+    y, _ = run(L.UpSampling1D(3), x1)
+    assert y.shape == (2, 24, 3)
+    np.testing.assert_allclose(y[:, 0], y[:, 2])
+    y, _ = run(L.UpSampling3D((2, 1, 2)), x3)
+    assert y.shape == (2, 10, 6, 14, 3)
+    np.testing.assert_allclose(y[:, 0], y[:, 1])
+
+
+def test_pool3d_matches_torch():
+    rng = np.random.default_rng(15)
+    x = rng.standard_normal((2, 6, 8, 4, 3)).astype("float32")
+    xt = torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)))
+    y, _ = run(L.MaxPooling3D((2, 2, 2)), x)
+    with torch.no_grad():
+        yt = torch.nn.MaxPool3d(2)(xt).numpy()
+    np.testing.assert_allclose(y, np.transpose(yt, (0, 2, 3, 4, 1)), atol=1e-6)
+    y, _ = run(L.AveragePooling3D((2, 2, 2)), x)
+    with torch.no_grad():
+        yt = torch.nn.AvgPool3d(2)(xt).numpy()
+    np.testing.assert_allclose(y, np.transpose(yt, (0, 2, 3, 4, 1)), atol=1e-6)
+    y, _ = run(L.GlobalMaxPooling3D(), x)
+    np.testing.assert_allclose(y, x.max(axis=(1, 2, 3)), atol=1e-6)
+    y, _ = run(L.GlobalAveragePooling3D(), x)
+    np.testing.assert_allclose(y, x.mean(axis=(1, 2, 3)), atol=1e-6)
+
+
+def test_resize_bilinear():
+    rng = np.random.default_rng(16)
+    x = rng.standard_normal((2, 4, 6, 3)).astype("float32")
+    # identity when output size == input size
+    y, _ = run(L.ResizeBilinear(4, 6), x)
+    np.testing.assert_allclose(y, x, atol=1e-6)
+    # align_corners=True matches torch
+    y, _ = run(L.ResizeBilinear(7, 9, align_corners=True), x)
+    with torch.no_grad():
+        yt = torch.nn.functional.interpolate(
+            torch.from_numpy(np.transpose(x, (0, 3, 1, 2))), size=(7, 9),
+            mode="bilinear", align_corners=True).numpy()
+    np.testing.assert_allclose(y, np.transpose(yt, (0, 2, 3, 1)), atol=1e-5)
+    # legacy TF semantics (align_corners=False): src = i * in/out
+    y, _ = run(L.ResizeBilinear(8, 12), x)
+    assert y.shape == (2, 8, 12, 3)
+    np.testing.assert_allclose(np.asarray(y)[:, 0, 0], x[:, 0, 0], atol=1e-6)
+
+
+def test_lrn_matches_torch():
+    rng = np.random.default_rng(17)
+    x = rng.standard_normal((2, 5, 5, 7)).astype("float32")
+    layer = L.LRN2D(alpha=1e-3, k=1.2, beta=0.6, n=5)
+    y, _ = run(layer, x)
+    with torch.no_grad():
+        yt = torch.nn.LocalResponseNorm(5, alpha=1e-3, beta=0.6, k=1.2)(
+            torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+    np.testing.assert_allclose(y, np.transpose(yt, (0, 2, 3, 1)), atol=1e-5)
+
+    wl = L.WithinChannelLRN2D(size=3, alpha=0.9, beta=0.75)
+    y, _ = run(wl, x)
+    sq = x ** 2
+    pad = np.pad(sq, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    ssum = sum(pad[:, i:i + 5, j:j + 5] for i in range(3) for j in range(3))
+    want = x / (1.0 + (0.9 / 9) * ssum) ** 0.75
+    np.testing.assert_allclose(y, want, atol=1e-5)
+
+
+def test_conv_lstm_2d_shapes_and_dynamics():
+    rng = np.random.default_rng(18)
+    x = rng.standard_normal((2, 4, 6, 6, 3)).astype("float32")
+    layer = L.ConvLSTM2D(5, 3, border_mode="same", return_sequences=True)
+    params, _ = layer.build(jax.random.PRNGKey(12), (4, 6, 6, 3))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    assert y.shape == (2, 4, 6, 6, 5)
+    last = L.ConvLSTM2D(5, 3, border_mode="valid")
+    p2, _ = last.build(jax.random.PRNGKey(13), (4, 6, 6, 3))
+    y2, _ = last.apply(p2, {}, jnp.asarray(x))
+    assert y2.shape == (2, 4, 4, 5)
+    assert last.compute_output_shape((4, 6, 6, 3)) == (4, 4, 5)
+    # gradients flow through the scan
+    g = jax.grad(lambda p: last.apply(p, {}, jnp.asarray(x))[0].sum())(p2)
+    assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+    # recurrence actually mixes time: permuting input steps changes the output
+    y3, _ = last.apply(p2, {}, jnp.asarray(x[:, ::-1]))
+    assert np.abs(np.asarray(y3) - np.asarray(y2)).max() > 1e-4
+
+
+def test_conv_lstm_3d_shapes():
+    rng = np.random.default_rng(19)
+    x = rng.standard_normal((1, 3, 4, 4, 4, 2)).astype("float32")
+    layer = L.ConvLSTM3D(3, 2, border_mode="same", return_sequences=False)
+    params, _ = layer.build(jax.random.PRNGKey(14), (3, 4, 4, 4, 2))
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    assert y.shape == (1, 4, 4, 4, 3)
+
+
+def test_new_layers_work_in_sequential():
+    """Integration: extended layers compile and train one step end-to-end."""
+    from analytics_zoo_tpu.nn.topology import Sequential
+
+    rng = np.random.default_rng(20)
+    x = rng.standard_normal((16, 8, 8, 3)).astype("float32")
+    y = rng.integers(0, 3, 16).astype("int32")
+    m = Sequential([
+        L.InputLayer((8, 8, 3)),
+        L.AtrousConvolution2D(4, 3, 3, atrous_rate=(1, 1), border_mode="same"),
+        L.PReLU(),
+        L.LRN2D(),
+        L.SpatialDropout2D(0.1),
+        L.MaxPooling2D((2, 2)),
+        L.Flatten(),
+        L.MaxoutDense(8, nb_feature=2),
+        L.Highway(activation="relu"),
+        L.Dense(3, activation="softmax"),
+    ])
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x, y, batch_size=8, nb_epoch=1)
+    out = m.predict(x)
+    assert out.shape == (16, 3)
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, atol=1e-5)
