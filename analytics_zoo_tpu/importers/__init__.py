@@ -10,7 +10,9 @@ donors for framework-native models.
 
 from .net import Net
 from .onnx_loader import OnnxModel, load_onnx
+from .tf_net import TFNet, from_frozen_graph, from_saved_model
 from .torch_loader import load_torch_state_dict, assign_torch_weights
 
-__all__ = ["Net", "OnnxModel", "load_onnx", "load_torch_state_dict",
+__all__ = ["Net", "OnnxModel", "TFNet", "from_frozen_graph",
+           "from_saved_model", "load_onnx", "load_torch_state_dict",
            "assign_torch_weights"]

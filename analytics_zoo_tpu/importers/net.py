@@ -12,16 +12,27 @@ class Net:
     def load(path: str, kind: Optional[str] = None):
         """Auto-detecting loader:
         * ``.onnx`` → :func:`load_onnx` (executable model)
+        * ``.pb`` → frozen TF GraphDef → executable TFNet
+        * directory with ``saved_model.pb`` → TF SavedModel → executable TFNet
         * ``.pt``/``.pth`` → torch state_dict (weight donor dict)
         * ``.h5``/``.keras`` → Keras weight-donor dict
         * directory with ``config.json`` → zoo model bundle
-        * ``kind='tf'`` → TF checkpoint donor dict (needs tensorflow)
+        * ``kind='tf'`` → TF checkpoint-bundle donor dict (no tensorflow
+          needed — built-in bundle codec)
         """
         kind = kind or Net._detect(path)
         if kind == "onnx":
             from .onnx_loader import load_onnx
 
             return load_onnx(path)
+        if kind == "tf_frozen":
+            from .tf_net import from_frozen_graph
+
+            return from_frozen_graph(path)
+        if kind == "tf_saved_model":
+            from .tf_net import from_saved_model
+
+            return from_saved_model(path)
         if kind == "torch":
             from .torch_loader import load_torch_state_dict
 
@@ -37,8 +48,9 @@ class Net:
 
             model, _ = load_model_bundle(path)
             return model
-        raise ValueError(f"cannot determine artifact kind for {path!r}; "
-                         f"pass kind='onnx'|'torch'|'keras'|'tf'|'zoo'")
+        raise ValueError(
+            f"cannot determine artifact kind for {path!r}; pass kind='onnx'|"
+            "'tf_frozen'|'tf_saved_model'|'torch'|'keras'|'tf'|'zoo'")
 
     @staticmethod
     def _detect(path: str) -> Optional[str]:
@@ -49,6 +61,11 @@ class Net:
             return "torch"
         if low.endswith((".h5", ".hdf5", ".keras")):
             return "keras"
+        if low.endswith(".pb"):
+            return "tf_frozen"
+        if os.path.isdir(path) and os.path.exists(
+                os.path.join(path, "saved_model.pb")):
+            return "tf_saved_model"
         if os.path.isdir(path) and os.path.exists(
                 os.path.join(path, "config.json")):
             return "zoo"
@@ -77,28 +94,45 @@ class Net:
 
     @staticmethod
     def load_tf(path: str) -> Dict:
-        """TF checkpoint → {var_name: array} donor dict. Requires the
-        ``tensorflow`` package (not bundled in TPU images); SavedModel graphs
-        should be exported to ONNX instead (Net.load_onnx)."""
-        try:
-            import tensorflow as tf  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "Net.load_tf needs the tensorflow package to read checkpoint "
-                "files. For graph import, convert the SavedModel to ONNX "
-                "(tf2onnx) and use Net.load_onnx — the executor runs it "
-                "natively on TPU.") from e
+        """TF checkpoint prefix → {var_name: array} donor dict, read by the
+        built-in bundle codec (``tf_proto.read_checkpoint_bundle``) — no
+        tensorflow dependency. ``path`` is the checkpoint prefix (the part
+        before ``.index``); falls back to the tensorflow reader only for
+        pre-bundle (V1) checkpoints if tensorflow happens to be installed."""
         import numpy as np
 
-        reader = tf.train.load_checkpoint(path)
+        if os.path.exists(path + ".index"):
+            from .tf_proto import read_checkpoint_bundle
+
+            return read_checkpoint_bundle(path)
+        try:
+            import tensorflow as tf  # pragma: no cover - legacy V1 path
+        except ImportError as e:
+            raise FileNotFoundError(
+                f"{path}.index not found — expected a TF2 checkpoint bundle "
+                "prefix (V1 checkpoints need the tensorflow package)") from e
+        reader = tf.train.load_checkpoint(path)  # pragma: no cover
         out = {}
-        for name in reader.get_variable_to_shape_map():
+        for name in reader.get_variable_to_shape_map():  # pragma: no cover
             arr = np.asarray(reader.get_tensor(name))
-            # skip bookkeeping entries (_CHECKPOINTABLE_OBJECT_GRAPH proto
-            # bytes, save counters' object dtype) — donor dicts hold arrays
             if arr.dtype.kind in "fiu":
                 out[name] = arr
-        return out
+        return out  # pragma: no cover
+
+    @staticmethod
+    def load_tf_graph(path: str, inputs=None, outputs=None):
+        """Frozen GraphDef ``.pb`` → executable TFNet (TFNet.scala:56)."""
+        from .tf_net import from_frozen_graph
+
+        return from_frozen_graph(path, inputs, outputs)
+
+    @staticmethod
+    def load_tf_saved_model(path: str, signature: str = "serving_default",
+                            inputs=None, outputs=None):
+        """SavedModel dir → executable TFNet (TFNetForInference.scala)."""
+        from .tf_net import from_saved_model
+
+        return from_saved_model(path, signature, inputs, outputs)
 
     @staticmethod
     def load_caffe(def_path: str, model_path: str):

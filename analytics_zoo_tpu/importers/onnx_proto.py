@@ -68,6 +68,11 @@ def _write_varint(v: int) -> bytes:
             return bytes(out)
 
 
+def _s64(v: int) -> int:
+    """Re-sign a varint decoded as unsigned 64-bit."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
 def _iter_fields(buf: bytes):
     """Yield (field_number, wire_type, value) — value is int for varint/fixed,
     bytes for length-delimited."""
@@ -143,7 +148,7 @@ class Tensor:
                     p = 0
                     while p < len(v):
                         d, p = _read_varint(v, p)
-                        ints.append(d - (1 << 64) if d >= (1 << 63) else d)
+                        ints.append(_s64(d))
                 else:
                     ints.append(v)
             elif fnum == 8:
@@ -210,7 +215,7 @@ class Attribute:
                 a.f = struct.unpack("<f", struct.pack("<i", v))[0] \
                     if wtype == 5 else float(v)
             elif fnum == 3:
-                a.i = v - (1 << 64) if v >= (1 << 63) else v
+                a.i = _s64(v)
             elif fnum == 4:
                 a.s = v
             elif fnum == 5:
@@ -225,9 +230,9 @@ class Attribute:
                     p = 0
                     while p < len(v):
                         d, p = _read_varint(v, p)
-                        ints.append(d - (1 << 64) if d >= (1 << 63) else d)
+                        ints.append(_s64(d))
                 else:
-                    ints.append(v - (1 << 64) if v >= (1 << 63) else v)
+                    ints.append(_s64(v))
         a.floats = tuple(floats)
         a.ints = tuple(ints)
         return a

@@ -1,0 +1,548 @@
+"""TFNet — run TF frozen graphs / SavedModels natively on TPU.
+
+Reference parity: ``zoo/.../pipeline/api/net/TFNet.scala:56`` (wraps a frozen
+inference GraphDef as a forward-only module) and ``TFNetForInference.scala``
+(SavedModel loading). Redesign: instead of embedding libtensorflow via JNI, the
+GraphDef executes directly as one traced jnp program (the ONNX-loader pattern,
+``onnx_loader.py``) — node loop unrolls at trace time, XLA fuses and lowers the
+whole graph to the MXU. TF's native layout is NHWC, which is also the TPU-native
+layout, so imported weights need no transposition anywhere.
+
+Weights come from Const nodes (frozen graphs) or from the checkpoint bundle
+under ``variables/`` (SavedModels) read by ``tf_proto.read_checkpoint_bundle``
+— no tensorflow import on either path.
+
+Supported ops: Placeholder/PlaceholderWithDefault, Const, Identity(N), NoOp,
+VariableV2/VarHandleOp/ReadVariableOp, MatMul, BatchMatMul(V2), BiasAdd, Add,
+AddV2, AddN, Sub, Mul, RealDiv, Maximum, Minimum, SquaredDifference, Neg, Abs,
+Exp, Log, Sqrt, Rsqrt, Square, Pow, Relu, Relu6, LeakyRelu, Elu, Selu, Sigmoid,
+Tanh, Softmax, LogSoftmax, Softplus, Erf, Conv2D, DepthwiseConv2dNative,
+Conv2DBackpropInput, MaxPool, AvgPool, FusedBatchNorm(V2/V3), Mean, Sum, Max,
+Min, Prod, ArgMax, Pad, PadV2, ConcatV2, Reshape, Squeeze, ExpandDims,
+Transpose, Shape, StridedSlice, Slice, Pack, Unpack, Fill, Cast, Rank, Tile,
+GatherV2, Greater, Less, Select/SelectV2, StopGradient.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tf_proto import (SavedModel, TFGraph, TFNode, read_checkpoint_bundle,
+                       _TF_NP)
+
+
+def _base(name: str) -> Tuple[str, int]:
+    """'node:1' → ('node', 1); control inputs '^node' → ('node', -1)."""
+    if name.startswith("^"):
+        return name[1:], -1
+    if ":" in name:
+        base, idx = name.rsplit(":", 1)
+        return base, int(idx)
+    return name, 0
+
+
+class _TFExecutor:
+    """Per-node dispatch; mirrors onnx_loader._Executor."""
+
+    def __init__(self, variables: Dict[str, jnp.ndarray]):
+        self.variables = variables
+
+    def run(self, node: TFNode, ins: List):
+        h = getattr(self, f"op_{node.op}", None)
+        if h is None:
+            raise NotImplementedError(
+                f"TF op {node.op!r} not supported (node {node.name!r}); "
+                "supported set listed in tf_net.py module docstring")
+        out = h(node, ins)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    # --------------------------------------------------------------- variables
+    def op_VariableV2(self, n, ins):
+        return self.variables[n.name]
+
+    op_VarHandleOp = op_VariableV2
+
+    def op_ReadVariableOp(self, n, ins):
+        return ins[0]
+
+    # ------------------------------------------------------------- structural
+    def op_Placeholder(self, n, ins):
+        raise RuntimeError(f"unbound placeholder {n.name!r} — pass it as input")
+
+    def op_PlaceholderWithDefault(self, n, ins):
+        return ins[0]
+
+    def op_Const(self, n, ins):
+        return n.attr("value")
+
+    def op_Identity(self, n, ins):
+        return ins[0]
+
+    op_StopGradient = op_Identity
+    op_PreventGradient = op_Identity
+
+    def op_IdentityN(self, n, ins):
+        return list(ins)
+
+    def op_NoOp(self, n, ins):
+        return []
+
+    # --------------------------------------------------------------- arithmetic
+    def op_Add(self, n, ins):
+        return ins[0] + ins[1]
+
+    op_AddV2 = op_Add
+
+    def op_AddN(self, n, ins):
+        out = ins[0]
+        for x in ins[1:]:
+            out = out + x
+        return out
+
+    def op_Sub(self, n, ins):
+        return ins[0] - ins[1]
+
+    def op_Mul(self, n, ins):
+        return ins[0] * ins[1]
+
+    def op_RealDiv(self, n, ins):
+        return ins[0] / ins[1]
+
+    op_Div = op_RealDiv
+
+    def op_Maximum(self, n, ins):
+        return jnp.maximum(ins[0], ins[1])
+
+    def op_Minimum(self, n, ins):
+        return jnp.minimum(ins[0], ins[1])
+
+    def op_SquaredDifference(self, n, ins):
+        d = ins[0] - ins[1]
+        return d * d
+
+    def op_Neg(self, n, ins):
+        return -ins[0]
+
+    def op_Abs(self, n, ins):
+        return jnp.abs(ins[0])
+
+    def op_Exp(self, n, ins):
+        return jnp.exp(ins[0])
+
+    def op_Log(self, n, ins):
+        return jnp.log(ins[0])
+
+    def op_Sqrt(self, n, ins):
+        return jnp.sqrt(ins[0])
+
+    def op_Rsqrt(self, n, ins):
+        return jax.lax.rsqrt(ins[0])
+
+    def op_Square(self, n, ins):
+        return ins[0] * ins[0]
+
+    def op_Pow(self, n, ins):
+        return ins[0] ** ins[1]
+
+    def op_Greater(self, n, ins):
+        return ins[0] > ins[1]
+
+    def op_Less(self, n, ins):
+        return ins[0] < ins[1]
+
+    def op_Select(self, n, ins):
+        return jnp.where(ins[0], ins[1], ins[2])
+
+    op_SelectV2 = op_Select
+
+    # -------------------------------------------------------------- activations
+    def op_Relu(self, n, ins):
+        return jax.nn.relu(ins[0])
+
+    def op_Relu6(self, n, ins):
+        return jnp.clip(ins[0], 0, 6)
+
+    def op_LeakyRelu(self, n, ins):
+        return jax.nn.leaky_relu(ins[0], n.attr("alpha", 0.2))
+
+    def op_Elu(self, n, ins):
+        return jax.nn.elu(ins[0])
+
+    def op_Selu(self, n, ins):
+        return jax.nn.selu(ins[0])
+
+    def op_Sigmoid(self, n, ins):
+        return jax.nn.sigmoid(ins[0])
+
+    def op_Tanh(self, n, ins):
+        return jnp.tanh(ins[0])
+
+    def op_Softmax(self, n, ins):
+        return jax.nn.softmax(ins[0], axis=-1)
+
+    def op_LogSoftmax(self, n, ins):
+        return jax.nn.log_softmax(ins[0], axis=-1)
+
+    def op_Softplus(self, n, ins):
+        return jax.nn.softplus(ins[0])
+
+    def op_Erf(self, n, ins):
+        return jax.lax.erf(ins[0])
+
+    # ------------------------------------------------------------------ matmul
+    def op_MatMul(self, n, ins):
+        a, b = ins[0], ins[1]
+        if n.attr("transpose_a", False):
+            a = a.T
+        if n.attr("transpose_b", False):
+            b = b.T
+        return a @ b
+
+    def op_BatchMatMul(self, n, ins):
+        a, b = ins[0], ins[1]
+        if n.attr("adj_x", False):
+            a = jnp.swapaxes(a, -1, -2)
+        if n.attr("adj_y", False):
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b
+
+    op_BatchMatMulV2 = op_BatchMatMul
+
+    def op_BiasAdd(self, n, ins):
+        fmt = n.attr("data_format", b"NHWC")
+        if fmt == b"NCHW" and ins[0].ndim > 2:
+            shape = (1, -1) + (1,) * (ins[0].ndim - 2)
+            return ins[0] + jnp.reshape(ins[1], shape)
+        return ins[0] + ins[1]
+
+    # -------------------------------------------------------------------- conv
+    def _conv_padding(self, n):
+        p = n.attr("padding", b"VALID")
+        return p.decode() if isinstance(p, bytes) else p
+
+    def _strides_2d(self, n):
+        s = n.attr("strides", (1, 1, 1, 1))
+        return (int(s[1]), int(s[2]))
+
+    def op_Conv2D(self, n, ins):
+        if n.attr("data_format", b"NHWC") != b"NHWC":
+            raise NotImplementedError("Conv2D NCHW data_format")
+        dil = n.attr("dilations", (1, 1, 1, 1))
+        return jax.lax.conv_general_dilated(
+            ins[0], ins[1], window_strides=self._strides_2d(n),
+            padding=self._conv_padding(n),
+            rhs_dilation=(int(dil[1]), int(dil[2])),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def op_DepthwiseConv2dNative(self, n, ins):
+        x, w = ins[0], ins[1]           # w: (kh, kw, in, mult)
+        kh, kw, in_ch, mult = w.shape
+        w = w.reshape(kh, kw, 1, in_ch * mult)
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=self._strides_2d(n),
+            padding=self._conv_padding(n),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=in_ch)
+
+    def op_Conv2DBackpropInput(self, n, ins):
+        # (output_shape, filter, grad) — the deconv forward pass
+        out_shape = tuple(int(s) for s in np.asarray(ins[0]))
+        w, x = ins[1], ins[2]
+        pad = self._conv_padding(n)
+        y = jax.lax.conv_transpose(
+            x, w, strides=self._strides_2d(n), padding=pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            transpose_kernel=True)
+        if y.shape != out_shape:
+            raise NotImplementedError(
+                f"Conv2DBackpropInput output shape {y.shape} != requested "
+                f"{out_shape} (padding {pad})")
+        return y
+
+    def _pool(self, n, x, op, init):
+        k = n.attr("ksize", (1, 2, 2, 1))
+        s = n.attr("strides", (1, 2, 2, 1))
+        return jax.lax.reduce_window(
+            x, init, op, window_dimensions=tuple(int(v) for v in k),
+            window_strides=tuple(int(v) for v in s),
+            padding=self._conv_padding(n))
+
+    def op_MaxPool(self, n, ins):
+        return self._pool(n, ins[0], jax.lax.max, -jnp.inf)
+
+    def op_AvgPool(self, n, ins):
+        k = n.attr("ksize", (1, 2, 2, 1))
+        summed = self._pool(n, ins[0], jax.lax.add, 0.0)
+        if self._conv_padding(n) == "VALID":
+            return summed / float(np.prod([int(v) for v in k]))
+        counts = self._pool(n, jnp.ones_like(ins[0]), jax.lax.add, 0.0)
+        return summed / counts
+
+    def op_FusedBatchNorm(self, n, ins):
+        if n.attr("data_format", b"NHWC") not in (b"NHWC", None):
+            raise NotImplementedError("FusedBatchNorm NCHW data_format")
+        x, scale, bias, mean, var = ins[:5]
+        eps = n.attr("epsilon", 1e-4)
+        y = (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+        return [y, mean, var, mean, var]
+
+    op_FusedBatchNormV2 = op_FusedBatchNorm
+    op_FusedBatchNormV3 = op_FusedBatchNorm
+
+    # --------------------------------------------------------------- reductions
+    def _axes(self, ins):
+        return tuple(int(a) for a in np.ravel(np.asarray(ins[1])))
+
+    def op_Mean(self, n, ins):
+        return jnp.mean(ins[0], axis=self._axes(ins),
+                        keepdims=bool(n.attr("keep_dims", False)))
+
+    def op_Sum(self, n, ins):
+        return jnp.sum(ins[0], axis=self._axes(ins),
+                       keepdims=bool(n.attr("keep_dims", False)))
+
+    def op_Max(self, n, ins):
+        return jnp.max(ins[0], axis=self._axes(ins),
+                       keepdims=bool(n.attr("keep_dims", False)))
+
+    def op_Min(self, n, ins):
+        return jnp.min(ins[0], axis=self._axes(ins),
+                       keepdims=bool(n.attr("keep_dims", False)))
+
+    def op_Prod(self, n, ins):
+        return jnp.prod(ins[0], axis=self._axes(ins),
+                        keepdims=bool(n.attr("keep_dims", False)))
+
+    def op_ArgMax(self, n, ins):
+        axis = int(np.asarray(ins[1])) if len(ins) > 1 else -1
+        return jnp.argmax(ins[0], axis=axis).astype(jnp.int64)
+
+    # ------------------------------------------------------------------- shape
+    def op_Reshape(self, n, ins):
+        shape = tuple(int(s) for s in np.asarray(ins[1]))
+        return jnp.reshape(ins[0], shape)
+
+    def op_Squeeze(self, n, ins):
+        dims = n.attr("squeeze_dims", ()) or n.attr("axis", ())
+        return jnp.squeeze(ins[0], axis=tuple(dims) or None)
+
+    def op_ExpandDims(self, n, ins):
+        return jnp.expand_dims(ins[0], int(np.asarray(ins[1])))
+
+    def op_Transpose(self, n, ins):
+        return jnp.transpose(ins[0], tuple(int(p) for p in np.asarray(ins[1])))
+
+    def op_Shape(self, n, ins):
+        return np.asarray(ins[0].shape, dtype=np.int32)
+
+    def op_Rank(self, n, ins):
+        return np.asarray(ins[0].ndim, dtype=np.int32)
+
+    def op_Fill(self, n, ins):
+        return jnp.full(tuple(int(s) for s in np.asarray(ins[0])), ins[1])
+
+    def op_Cast(self, n, ins):
+        dst = n.attr("DstT", 1)
+        return jnp.asarray(ins[0], _TF_NP.get(dst, np.float32))
+
+    def op_Tile(self, n, ins):
+        return jnp.tile(ins[0], tuple(int(m) for m in np.asarray(ins[1])))
+
+    def op_Pack(self, n, ins):
+        return jnp.stack(ins, axis=int(n.attr("axis", 0)))
+
+    def op_Unpack(self, n, ins):
+        axis = int(n.attr("axis", 0))
+        num = int(n.attr("num", ins[0].shape[axis]))
+        return [jnp.squeeze(s, axis)
+                for s in jnp.split(ins[0], num, axis=axis)]
+
+    def op_ConcatV2(self, n, ins):
+        axis = int(np.asarray(ins[-1]))
+        return jnp.concatenate(ins[:-1], axis=axis)
+
+    def op_Pad(self, n, ins):
+        pads = [(int(a), int(b)) for a, b in np.asarray(ins[1])]
+        const = float(np.asarray(ins[2])) if len(ins) > 2 else 0.0
+        return jnp.pad(ins[0], pads, constant_values=const)
+
+    op_PadV2 = op_Pad
+
+    def op_Slice(self, n, ins):
+        begin = [int(b) for b in np.asarray(ins[1])]
+        size = [int(s) for s in np.asarray(ins[2])]
+        lims = [b + (s if s != -1 else ins[0].shape[i] - b)
+                for i, (b, s) in enumerate(zip(begin, size))]
+        return jax.lax.slice(ins[0], begin, lims)
+
+    def op_StridedSlice(self, n, ins):
+        x = ins[0]
+        begin = np.asarray(ins[1])
+        end = np.asarray(ins[2])
+        strides = np.asarray(ins[3]) if len(ins) > 3 else np.ones_like(begin)
+        bm = int(n.attr("begin_mask", 0))
+        em = int(n.attr("end_mask", 0))
+        sm = int(n.attr("shrink_axis_mask", 0))
+        nm = int(n.attr("new_axis_mask", 0))
+        el = int(n.attr("ellipsis_mask", 0))
+        if nm or el:
+            raise NotImplementedError(
+                "StridedSlice new_axis_mask/ellipsis_mask")
+        idx = []
+        for i in range(len(begin)):
+            if sm & (1 << i):
+                idx.append(int(begin[i]))
+                continue
+            b = None if bm & (1 << i) else int(begin[i])
+            e = None if em & (1 << i) else int(end[i])
+            idx.append(slice(b, e, int(strides[i])))
+        return x[tuple(idx)]
+
+    def op_GatherV2(self, n, ins):
+        axis = int(np.asarray(ins[2])) if len(ins) > 2 else 0
+        return jnp.take(ins[0], jnp.asarray(ins[1], jnp.int32), axis=axis)
+
+    op_Gather = op_GatherV2
+
+
+class TFNet:
+    """Executable TF graph (TFNet.scala parity: forward-only module).
+
+    ``predict(x)`` runs the jit-compiled graph; ``__call__`` composes into
+    larger jax programs. Use :func:`from_frozen_graph` / :func:`from_saved_model`.
+    """
+
+    def __init__(self, graph: TFGraph, input_names: Sequence[str],
+                 output_names: Sequence[str],
+                 variables: Optional[Dict[str, np.ndarray]] = None):
+        self.graph = graph
+        self.input_names = [_base(s)[0] for s in input_names]
+        self.output_names = list(output_names)
+        self.variables = {k: np.asarray(v) for k, v in (variables or {}).items()}
+        self._nodes = {n.name: n for n in graph.nodes}
+        self._jit = jax.jit(self._run)
+
+    # -- graph evaluation ------------------------------------------------------
+    def _run(self, *inputs, variables: Optional[Dict] = None):
+        if len(inputs) != len(self.input_names):
+            raise ValueError(
+                f"graph takes {len(self.input_names)} inputs "
+                f"{self.input_names}, got {len(inputs)}")
+        env: Dict[str, List] = {}
+        for name, x in zip(self.input_names, inputs):
+            env[name] = [x]
+        ex = _TFExecutor({k: jnp.asarray(v) for k, v in
+                          (self.variables if variables is None
+                           else variables).items()})
+
+        def ensure(name: str):
+            """Iterative post-order evaluation (deep graphs would overflow
+            Python recursion)."""
+            stack = [(name, False)]
+            while stack:
+                cur, expanded = stack.pop()
+                if cur in env:
+                    continue
+                node = self._nodes.get(cur)
+                if node is None:
+                    raise KeyError(f"graph references unknown node {cur!r}")
+                deps = [_base(r)[0] for r in node.inputs]
+                if not expanded:
+                    stack.append((cur, True))
+                    stack.extend((d, False) for d in reversed(deps)
+                                 if d not in env)
+                    continue
+                ins = []
+                for ref in node.inputs:
+                    base, idx = _base(ref)
+                    if idx >= 0:                     # drop control deps (^x)
+                        ins.append(env[base][idx])
+                env[cur] = ex.run(node, ins)
+
+        outs = []
+        for ref in self.output_names:
+            base, idx = _base(ref)
+            ensure(base)
+            outs.append(env[base][max(idx, 0)])
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def __call__(self, *inputs):
+        return self._run(*[jnp.asarray(x) for x in inputs])
+
+    def predict(self, *inputs):
+        out = self._jit(*[jnp.asarray(x) for x in inputs])
+        return (np.asarray(out) if not isinstance(out, tuple)
+                else tuple(np.asarray(o) for o in out))
+
+    # -- introspection ---------------------------------------------------------
+    @property
+    def ops_used(self):
+        return sorted({n.op for n in self.graph.nodes})
+
+
+def _find_io(graph: TFGraph) -> Tuple[List[str], List[str]]:
+    """Infer inputs (Placeholders, incl. with-default) and outputs (nodes
+    nobody consumes). A PlaceholderWithDefault counts as an input — treating
+    it as a constant would make predict() silently ignore the user's data."""
+    inputs = [n.name for n in graph.nodes
+              if n.op in ("Placeholder", "PlaceholderWithDefault")]
+    consumed = {_base(r)[0] for n in graph.nodes for r in n.inputs}
+    terminal_skip = {"Const", "Placeholder", "NoOp", "Assert", "SaveV2",
+                     "RestoreV2", "VariableV2", "VarHandleOp"}
+    outputs = [n.name for n in graph.nodes
+               if n.name not in consumed and n.op not in terminal_skip]
+    return inputs, outputs
+
+
+def from_frozen_graph(path: str, inputs: Optional[Sequence[str]] = None,
+                      outputs: Optional[Sequence[str]] = None) -> TFNet:
+    """Load a frozen GraphDef ``.pb`` (TFNet.scala:56 capability)."""
+    with open(path, "rb") as f:
+        graph = TFGraph.decode(f.read())
+    auto_in, auto_out = _find_io(graph)
+    return TFNet(graph, list(inputs or auto_in), list(outputs or auto_out))
+
+
+def from_saved_model(path: str, signature: str = "serving_default",
+                     inputs: Optional[Sequence[str]] = None,
+                     outputs: Optional[Sequence[str]] = None) -> TFNet:
+    """Load a SavedModel dir (saved_model.pb + variables/) —
+    TFNetForInference.scala capability, no tensorflow needed."""
+    with open(os.path.join(path, "saved_model.pb"), "rb") as f:
+        sm = SavedModel.decode(f.read())
+    sig = sm.signatures.get(signature)
+    if sig is None and sm.signatures:
+        if signature == "serving_default" and len(sm.signatures) == 1:
+            sig = next(iter(sm.signatures.values()))   # the only one there is
+        else:
+            raise KeyError(
+                f"signature {signature!r} not in SavedModel; available: "
+                f"{sorted(sm.signatures)}")
+    if inputs is None:
+        inputs = (sorted(sig.inputs.values()) if sig and sig.inputs
+                  else _find_io(sm.graph)[0])
+    if outputs is None:
+        outputs = (sorted(sig.outputs.values()) if sig and sig.outputs
+                   else _find_io(sm.graph)[1])
+
+    variables: Dict[str, np.ndarray] = {}
+    prefix = os.path.join(path, "variables", "variables")
+    if os.path.exists(prefix + ".index"):
+        bundle = read_checkpoint_bundle(prefix)
+        var_nodes = [n.name for n in sm.graph.nodes
+                     if n.op in ("VariableV2", "VarHandleOp")]
+        for name in var_nodes:
+            for key in (name, f"{name}/.ATTRIBUTES/VARIABLE_VALUE"):
+                if key in bundle:
+                    variables[name] = bundle[key]
+                    break
+            else:
+                raise KeyError(
+                    f"variable {name!r} not found in checkpoint bundle "
+                    f"(keys: {sorted(bundle)[:8]}...)")
+    return TFNet(sm.graph, list(inputs), list(outputs), variables)

@@ -125,6 +125,31 @@ class InferenceModel:
             model.compile(optimizer="sgd", loss="mse")
         return self.load(model)
 
+    def load_tf(self, path: str, signature: str = "serving_default",
+                inputs=None, outputs=None) -> "InferenceModel":
+        """Load a TF frozen graph (``.pb``) or SavedModel dir and serve it
+        (InferenceModel.doLoadTF parity, InferenceModel.scala:83-300 — the
+        reference embeds libtensorflow; here the graph executes as a traced
+        jnp program via importers.tf_net)."""
+        import os
+
+        from ..importers.tf_net import from_frozen_graph, from_saved_model
+
+        if os.path.isdir(path):
+            net = from_saved_model(path, signature=signature, inputs=inputs,
+                                   outputs=outputs)
+        else:
+            net = from_frozen_graph(path, inputs=inputs, outputs=outputs)
+
+        # SavedModel variables ride the params pytree so quantize_int8 applies
+        # to them; frozen-graph weights are Const nodes inside the traced
+        # program and stay full-precision (params is empty then)
+        def apply(p, s, x, net=net):
+            xs = list(x) if isinstance(x, (list, tuple)) else [x]
+            return net._run(*xs, variables=p)
+
+        return self.load_fn(apply, params=dict(net.variables), state=None)
+
     def load_fn(self, fn, params, state=None) -> "InferenceModel":
         """Load a bare ``fn(params, state, x) -> y`` (escape hatch for imported
         graphs — the TFNet/TorchNet capability lands here via importers)."""

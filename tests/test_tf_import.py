@@ -1,0 +1,295 @@
+"""TF frozen-graph / SavedModel ingestion tests (TFNet parity, VERDICT
+Missing #2). tensorflow is not installed in the image, so artifacts are
+synthesized with the tf_proto encoders (the onnx_proto round-trip strategy)
+and results are checked against numpy/torch oracles.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.importers.tf_proto import (
+    AttrValue, SavedModel, SignatureDef, TFGraph, TFNode,
+    read_checkpoint_bundle, write_checkpoint_bundle, TF_FLOAT)
+from analytics_zoo_tpu.importers.tf_net import (TFNet, from_frozen_graph,
+                                                from_saved_model)
+from analytics_zoo_tpu.importers.net import Net
+
+
+def node(name, op, inputs=(), **attrs):
+    n = TFNode(name=name, op=op, inputs=list(inputs))
+    for k, v in attrs.items():
+        if isinstance(v, np.ndarray):
+            n.attrs[k] = AttrValue(tensor=v)
+        elif isinstance(v, bool):
+            n.attrs[k] = AttrValue(b=v)
+        elif isinstance(v, int):
+            n.attrs[k] = AttrValue(i=v)
+        elif isinstance(v, float):
+            n.attrs[k] = AttrValue(f=v)
+        elif isinstance(v, bytes):
+            n.attrs[k] = AttrValue(s=v)
+        elif isinstance(v, (tuple, list)):
+            n.attrs[k] = AttrValue(list_i=tuple(v))
+        else:
+            raise TypeError(type(v))
+    return n
+
+
+def mlp_graph(w1, b1, w2, b2):
+    """x → relu(x@w1+b1) @ w2 + b2 → softmax, as a frozen graph."""
+    return TFGraph(nodes=[
+        node("x", "Placeholder"),
+        node("w1", "Const", value=w1),
+        node("b1", "Const", value=b1),
+        node("w2", "Const", value=w2),
+        node("b2", "Const", value=b2),
+        node("mm1", "MatMul", ["x", "w1"]),
+        node("add1", "BiasAdd", ["mm1", "b1"]),
+        node("relu", "Relu", ["add1"]),
+        node("mm2", "MatMul", ["relu", "w2"]),
+        node("logits", "BiasAdd", ["mm2", "b2"]),
+        node("probs", "Softmax", ["logits"]),
+    ])
+
+
+def mlp_oracle(x, w1, b1, w2, b2):
+    h = np.maximum(x @ w1 + b1, 0)
+    logits = h @ w2 + b2
+    e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+@pytest.fixture
+def mlp_weights():
+    rng = np.random.default_rng(0)
+    return (rng.standard_normal((6, 8)).astype("float32"),
+            rng.standard_normal(8).astype("float32"),
+            rng.standard_normal((8, 3)).astype("float32"),
+            rng.standard_normal(3).astype("float32"))
+
+
+def test_frozen_graph_roundtrip_and_predict(tmp_path, mlp_weights):
+    w1, b1, w2, b2 = mlp_weights
+    path = str(tmp_path / "model.pb")
+    with open(path, "wb") as f:
+        f.write(mlp_graph(w1, b1, w2, b2).encode())
+
+    net = from_frozen_graph(path)
+    assert net.input_names == ["x"] and net.output_names == ["probs"]
+    x = np.random.default_rng(1).standard_normal((5, 6)).astype("float32")
+    got = net.predict(x)
+    np.testing.assert_allclose(got, mlp_oracle(x, *mlp_weights), atol=1e-5)
+    # Net front door auto-detects .pb
+    net2 = Net.load(path)
+    np.testing.assert_allclose(net2.predict(x), got, atol=1e-6)
+
+
+def test_checkpoint_bundle_roundtrip(tmp_path):
+    rng = np.random.default_rng(2)
+    tensors = {
+        "dense/kernel": rng.standard_normal((4, 7)).astype("float32"),
+        "dense/bias": rng.standard_normal(7).astype("float32"),
+        "step": np.asarray(42, dtype=np.int64),
+        "embed": rng.standard_normal((10, 3)).astype("float64"),
+    }
+    prefix = str(tmp_path / "variables" / "variables")
+    write_checkpoint_bundle(prefix, tensors)
+    back = read_checkpoint_bundle(prefix)
+    assert set(back) == set(tensors)
+    for k in tensors:
+        np.testing.assert_array_equal(back[k], tensors[k])
+        assert back[k].dtype == tensors[k].dtype
+    # Net.load_tf now reads bundles without tensorflow
+    donor = Net.load_tf(prefix)
+    np.testing.assert_array_equal(donor["dense/kernel"],
+                                  tensors["dense/kernel"])
+
+
+def test_saved_model_with_variables(tmp_path, mlp_weights):
+    w1, b1, w2, b2 = mlp_weights
+    graph = TFGraph(nodes=[
+        node("x", "Placeholder"),
+        node("w1", "VarHandleOp"),
+        node("w1/Read", "ReadVariableOp", ["w1"]),
+        node("b1", "VarHandleOp"),
+        node("b1/Read", "ReadVariableOp", ["b1"]),
+        node("w2", "VariableV2"),
+        node("b2", "VariableV2"),
+        node("mm1", "MatMul", ["x", "w1/Read"]),
+        node("add1", "BiasAdd", ["mm1", "b1/Read"]),
+        node("relu", "Relu", ["add1"]),
+        node("mm2", "MatMul", ["relu", "w2"]),
+        node("logits", "BiasAdd", ["mm2", "b2"]),
+        node("probs", "Softmax", ["logits"]),
+    ])
+    sm = SavedModel(graph=graph, signatures={
+        "serving_default": SignatureDef(inputs={"features": "x:0"},
+                                        outputs={"probabilities": "probs:0"})})
+    d = tmp_path / "saved"
+    os.makedirs(d)
+    with open(d / "saved_model.pb", "wb") as f:
+        f.write(sm.encode())
+    # TF2 object-graph style keys for two, plain keys for the others
+    write_checkpoint_bundle(str(d / "variables" / "variables"), {
+        "w1/.ATTRIBUTES/VARIABLE_VALUE": w1,
+        "b1/.ATTRIBUTES/VARIABLE_VALUE": b1,
+        "w2": w2,
+        "b2": b2,
+    })
+
+    net = from_saved_model(str(d))
+    x = np.random.default_rng(3).standard_normal((4, 6)).astype("float32")
+    np.testing.assert_allclose(net.predict(x), mlp_oracle(x, *mlp_weights),
+                               atol=1e-5)
+    # auto-detect via the front door
+    net2 = Net.load(str(d))
+    np.testing.assert_allclose(net2.predict(x), net.predict(x), atol=1e-6)
+
+
+def test_saved_model_missing_variable_errors(tmp_path, mlp_weights):
+    w1, b1, w2, b2 = mlp_weights
+    graph = TFGraph(nodes=[
+        node("x", "Placeholder"),
+        node("w1", "VariableV2"),
+        node("y", "MatMul", ["x", "w1"]),
+    ])
+    d = tmp_path / "sm"
+    os.makedirs(d)
+    with open(d / "saved_model.pb", "wb") as f:
+        f.write(SavedModel(graph=graph).encode())
+    write_checkpoint_bundle(str(d / "variables" / "variables"),
+                            {"other": w1})
+    with pytest.raises(KeyError, match="w1"):
+        from_saved_model(str(d))
+
+
+def test_conv_graph_matches_torch(tmp_path):
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 8, 8, 3)).astype("float32")
+    w = rng.standard_normal((3, 3, 3, 5)).astype("float32")
+    b = rng.standard_normal(5).astype("float32")
+    graph = TFGraph(nodes=[
+        node("input", "Placeholder"),
+        node("w", "Const", value=w),
+        node("b", "Const", value=b),
+        # stride 1: TF SAME pads symmetrically (1,1) here, same as torch's
+        # padding=1 — with stride 2 the two paddings are aligned differently
+        node("conv", "Conv2D", ["input", "w"], strides=(1, 1, 1, 1),
+             padding=b"SAME"),
+        node("bias", "BiasAdd", ["conv", "b"]),
+        node("act", "Relu6", ["bias"]),
+        node("pool", "MaxPool", ["act"], ksize=(1, 2, 2, 1),
+             strides=(1, 2, 2, 1), padding=b"VALID"),
+        node("mean", "Mean", ["pool", "axes"], keep_dims=False),
+        node("axes", "Const", value=np.asarray([1, 2], np.int32)),
+    ])
+    path = str(tmp_path / "conv.pb")
+    with open(path, "wb") as f:
+        f.write(graph.encode())
+    net = from_frozen_graph(path, inputs=["input"], outputs=["mean"])
+    got = net.predict(x)
+
+    with torch.no_grad():
+        xt = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))
+        conv = torch.nn.functional.conv2d(
+            xt, torch.from_numpy(np.transpose(w, (3, 2, 0, 1))),
+            torch.from_numpy(b), stride=1, padding=1)
+        act = torch.clamp(conv, 0, 6)
+        pool = torch.nn.functional.max_pool2d(act, 2)
+        want = pool.mean(dim=(2, 3)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_misc_ops_and_strided_slice(tmp_path):
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((3, 10, 4)).astype("float32")
+    graph = TFGraph(nodes=[
+        node("x", "Placeholder"),
+        node("begin", "Const", value=np.asarray([0, 2, 0], np.int32)),
+        node("end", "Const", value=np.asarray([0, 8, 0], np.int32)),
+        node("strides", "Const", value=np.asarray([1, 2, 1], np.int32)),
+        node("sl", "StridedSlice", ["x", "begin", "end", "strides"],
+             begin_mask=0b101, end_mask=0b101),
+        node("perm", "Const", value=np.asarray([0, 2, 1], np.int32)),
+        node("tr", "Transpose", ["sl", "perm"]),
+        node("shape", "Const", value=np.asarray([3, -1], np.int32)),
+        node("flat", "Reshape", ["tr", "shape"]),
+        node("out", "Tanh", ["flat"]),
+    ])
+    p = str(tmp_path / "g.pb")
+    with open(p, "wb") as f:
+        f.write(graph.encode())
+    net = from_frozen_graph(p, inputs=["x"], outputs=["out"])
+    want = np.tanh(np.transpose(x[:, 2:8:2, :], (0, 2, 1)).reshape(3, -1))
+    np.testing.assert_allclose(net.predict(x), want, atol=1e-6)
+
+
+def test_fused_batchnorm_and_multi_output():
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((2, 4, 4, 3)).astype("float32")
+    scale = np.asarray([1.5, 0.5, 2.0], np.float32)
+    bias = np.asarray([0.1, -0.2, 0.0], np.float32)
+    mean = np.asarray([0.3, -0.1, 0.2], np.float32)
+    var = np.asarray([1.2, 0.8, 1.0], np.float32)
+    graph = TFGraph(nodes=[
+        node("x", "Placeholder"),
+        node("scale", "Const", value=scale),
+        node("bias", "Const", value=bias),
+        node("mean", "Const", value=mean),
+        node("var", "Const", value=var),
+        node("bn", "FusedBatchNormV3", ["x", "scale", "bias", "mean", "var"],
+             epsilon=1e-3),
+    ])
+    net = TFNet(graph, ["x"], ["bn:0"])
+    got = net.predict(x)
+    want = (x - mean) / np.sqrt(var + 1e-3) * scale + bias
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_served_through_inference_model(tmp_path, mlp_weights):
+    from analytics_zoo_tpu.inference import InferenceModel
+
+    w1, b1, w2, b2 = mlp_weights
+    path = str(tmp_path / "m.pb")
+    with open(path, "wb") as f:
+        f.write(mlp_graph(w1, b1, w2, b2).encode())
+    im = InferenceModel().load_tf(path)
+    x = np.random.default_rng(7).standard_normal((5, 6)).astype("float32")
+    got = im.predict(x)
+    np.testing.assert_allclose(np.asarray(got),
+                               mlp_oracle(x, *mlp_weights), atol=1e-5)
+
+
+def test_placeholder_with_default_is_an_input(tmp_path):
+    """Regression: PlaceholderWithDefault must bind user data, not silently
+    return its baked-in default."""
+    default = np.ones((2, 3), np.float32)
+    graph = TFGraph(nodes=[
+        node("dflt", "Const", value=default),
+        node("x", "PlaceholderWithDefault", ["dflt"]),
+        node("y", "Mul", ["x", "x"]),
+    ])
+    p = str(tmp_path / "pwd.pb")
+    with open(p, "wb") as f:
+        f.write(graph.encode())
+    net = from_frozen_graph(p)
+    assert net.input_names == ["x"]
+    data = np.full((2, 3), 3.0, np.float32)
+    np.testing.assert_allclose(net.predict(data), data * data)
+    # and surplus/missing inputs error instead of being zip-dropped
+    with pytest.raises(ValueError, match="takes 1 inputs"):
+        net.predict(data, data)
+
+
+def test_unsupported_op_refuses_clearly(tmp_path):
+    graph = TFGraph(nodes=[
+        node("x", "Placeholder"),
+        node("y", "SparseTensorDenseMatMul", ["x"]),
+    ])
+    net = TFNet(graph, ["x"], ["y"])
+    with pytest.raises(NotImplementedError, match="SparseTensorDenseMatMul"):
+        net.predict(np.zeros((2, 2), np.float32))
