@@ -8,11 +8,12 @@ package needed) and executed as one jnp program; torch checkpoints are weight
 donors for framework-native models.
 """
 
+from .caffe import CaffeModel, load_caffe
 from .net import Net
 from .onnx_loader import OnnxModel, load_onnx
 from .tf_net import TFNet, from_frozen_graph, from_saved_model
 from .torch_loader import load_torch_state_dict, assign_torch_weights
 
-__all__ = ["Net", "OnnxModel", "TFNet", "from_frozen_graph",
-           "from_saved_model", "load_onnx", "load_torch_state_dict",
-           "assign_torch_weights"]
+__all__ = ["CaffeModel", "Net", "OnnxModel", "TFNet", "from_frozen_graph",
+           "from_saved_model", "load_caffe", "load_onnx",
+           "load_torch_state_dict", "assign_torch_weights"]

@@ -135,11 +135,10 @@ class Net:
         return from_saved_model(path, signature, inputs, outputs)
 
     @staticmethod
-    def load_caffe(def_path: str, model_path: str):
-        """Extension point (reference CaffeLoader.scala): Caffe ingestion is
-        not built in — convert caffemodel to ONNX (e.g. caffe2onnx) and use
-        Net.load_onnx."""
-        raise NotImplementedError(
-            "Caffe import is an extension point: convert the model to ONNX "
-            "and load with Net.load_onnx, or contribute a prototxt mapper "
-            "targeting analytics_zoo_tpu.nn.layers.")
+    def load_caffe(def_path: str, model_path: Optional[str] = None):
+        """prototxt + caffemodel → executable/trainable CaffeModel
+        (reference CaffeLoader.scala capability; built-in text-proto and
+        NetParameter codecs — no caffe/protobuf dependency)."""
+        from .caffe import load_caffe
+
+        return load_caffe(def_path, model_path)

@@ -419,10 +419,15 @@ class TFNet:
 
     def __init__(self, graph: TFGraph, input_names: Sequence[str],
                  output_names: Sequence[str],
-                 variables: Optional[Dict[str, np.ndarray]] = None):
+                 variables: Optional[Dict[str, np.ndarray]] = None,
+                 input_args: Optional[Sequence[str]] = None):
         self.graph = graph
         self.input_names = [_base(s)[0] for s in input_names]
         self.output_names = list(output_names)
+        # signature argument names aligned with input_names — positional
+        # predict() binds in this (sorted-by-arg-name) order; predict can also
+        # be called with these as keywords
+        self.input_args = list(input_args) if input_args else list(self.input_names)
         self.variables = {k: np.asarray(v) for k, v in (variables or {}).items()}
         self._nodes = {n.name: n for n in graph.nodes}
         self._jit = jax.jit(self._run)
@@ -471,11 +476,26 @@ class TFNet:
             outs.append(env[base][max(idx, 0)])
         return outs[0] if len(outs) == 1 else tuple(outs)
 
-    def __call__(self, *inputs):
-        return self._run(*[jnp.asarray(x) for x in inputs])
+    def _bind(self, inputs, kwargs):
+        if kwargs:
+            if inputs:
+                raise TypeError("pass inputs positionally or by signature "
+                                "arg name, not both")
+            try:
+                return [jnp.asarray(kwargs[a]) for a in self.input_args]
+            except KeyError as e:
+                raise KeyError(f"missing input {e.args[0]!r}; signature args: "
+                               f"{self.input_args}") from None
+        return [jnp.asarray(x) for x in inputs]
 
-    def predict(self, *inputs):
-        out = self._jit(*[jnp.asarray(x) for x in inputs])
+    def __call__(self, *inputs, **kwargs):
+        return self._run(*self._bind(inputs, kwargs))
+
+    def predict(self, *inputs, **kwargs):
+        """Run the jit-compiled graph. Positional inputs bind to
+        ``input_args`` order (sorted signature arg names for SavedModels);
+        keywords bind by signature arg name."""
+        out = self._jit(*self._bind(inputs, kwargs))
         return (np.asarray(out) if not isinstance(out, tuple)
                 else tuple(np.asarray(o) for o in out))
 
@@ -523,9 +543,15 @@ def from_saved_model(path: str, signature: str = "serving_default",
             raise KeyError(
                 f"signature {signature!r} not in SavedModel; available: "
                 f"{sorted(sm.signatures)}")
+    input_args = None
     if inputs is None:
-        inputs = (sorted(sig.inputs.values()) if sig and sig.inputs
-                  else _find_io(sm.graph)[0])
+        if sig and sig.inputs:
+            # deterministic order by signature ARG name; predict() also
+            # accepts these names as keywords so callers need not rely on it
+            input_args = sorted(sig.inputs)
+            inputs = [sig.inputs[a] for a in input_args]
+        else:
+            inputs = _find_io(sm.graph)[0]
     if outputs is None:
         outputs = (sorted(sig.outputs.values()) if sig and sig.outputs
                    else _find_io(sm.graph)[1])
@@ -545,4 +571,5 @@ def from_saved_model(path: str, signature: str = "serving_default",
                 raise KeyError(
                     f"variable {name!r} not found in checkpoint bundle "
                     f"(keys: {sorted(bundle)[:8]}...)")
-    return TFNet(sm.graph, list(inputs), list(outputs), variables)
+    return TFNet(sm.graph, list(inputs), list(outputs), variables,
+                 input_args=input_args)

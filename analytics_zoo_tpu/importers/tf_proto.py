@@ -33,6 +33,7 @@ import struct
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+import ml_dtypes  # ships with jax
 import numpy as np
 
 from .onnx_proto import (_field, _iter_fields, _ld, _read_varint, _s64,
@@ -41,9 +42,11 @@ from .onnx_proto import (_field, _iter_fields, _ld, _read_varint, _s64,
 # TF DataType enum (tensorflow/core/framework/types.proto)
 TF_FLOAT, TF_DOUBLE, TF_INT32, TF_UINT8, TF_INT16, TF_INT8 = 1, 2, 3, 4, 5, 6
 TF_STRING, TF_INT64, TF_BOOL = 7, 9, 10
+TF_BFLOAT16, TF_HALF = 14, 19
 _TF_NP = {TF_FLOAT: np.float32, TF_DOUBLE: np.float64, TF_INT32: np.int32,
           TF_UINT8: np.uint8, TF_INT16: np.int16, TF_INT8: np.int8,
-          TF_INT64: np.int64, TF_BOOL: np.bool_}
+          TF_INT64: np.int64, TF_BOOL: np.bool_, TF_HALF: np.float16,
+          TF_BFLOAT16: ml_dtypes.bfloat16}
 _NP_TF = {np.dtype(np.float32): TF_FLOAT, np.dtype(np.float64): TF_DOUBLE,
           np.dtype(np.int32): TF_INT32, np.dtype(np.int64): TF_INT64,
           np.dtype(np.bool_): TF_BOOL, np.dtype(np.uint8): TF_UINT8}

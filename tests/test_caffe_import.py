@@ -1,0 +1,255 @@
+"""Caffe importer tests (CaffeLoader parity, VERDICT Missing #4). caffe is not
+installed, so caffemodel fixtures are synthesized with encode_caffemodel and
+predictions are checked against torch / hand-computed numpy oracles."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.importers.caffe import (CaffeModel, decode_caffemodel,
+                                               encode_caffemodel, load_caffe,
+                                               parse_prototxt)
+from analytics_zoo_tpu.importers.net import Net
+
+torch = pytest.importorskip("torch")
+
+
+LENET_PROTOTXT = """
+name: "MiniLeNet"
+layer { name: "data" type: "Input" top: "data"
+        input_param { shape { dim: 1 dim: 3 dim: 12 dim: 12 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+        convolution_param { num_output: 6 kernel_size: 3 stride: 1 pad: 1 } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+        pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+        inner_product_param { num_output: 4 } }
+layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+"""
+
+
+def test_prototxt_parser():
+    net = parse_prototxt(LENET_PROTOTXT)
+    assert net["name"] == "MiniLeNet"
+    layers = net["layer"]
+    assert [l["type"] for l in layers] == ["Input", "Convolution", "ReLU",
+                                           "Pooling", "InnerProduct", "Softmax"]
+    assert layers[1]["convolution_param"]["num_output"] == 6
+    assert layers[0]["input_param"]["shape"]["dim"] == [1, 3, 12, 12]
+    assert layers[3]["pooling_param"]["pool"] == "MAX"
+
+
+def test_caffemodel_codec_roundtrip():
+    rng = np.random.default_rng(0)
+    blobs = {"conv1": [rng.standard_normal((6, 3, 3, 3)).astype("float32"),
+                       rng.standard_normal(6).astype("float32")],
+             "ip1": [rng.standard_normal((4, 150)).astype("float32")]}
+    back = decode_caffemodel(encode_caffemodel(blobs))
+    assert set(back) == {"conv1", "ip1"}
+    for k in blobs:
+        for a, b in zip(blobs[k], back[k]):
+            np.testing.assert_array_equal(a, b)
+            assert a.shape == b.shape
+
+
+def test_lenet_matches_torch(tmp_path):
+    rng = np.random.default_rng(1)
+    w_conv = rng.standard_normal((6, 3, 3, 3)).astype("float32")
+    b_conv = rng.standard_normal(6).astype("float32")
+    w_ip = rng.standard_normal((4, 6 * 6 * 6)).astype("float32")
+    b_ip = rng.standard_normal(4).astype("float32")
+
+    proto = tmp_path / "net.prototxt"
+    proto.write_text(LENET_PROTOTXT)
+    weights = tmp_path / "net.caffemodel"
+    weights.write_bytes(encode_caffemodel({
+        "conv1": [w_conv, b_conv], "ip1": [w_ip, b_ip]}))
+
+    model = load_caffe(str(proto), str(weights))
+    assert model.input_names == ["data"]
+    assert model.output_names == ["prob"]
+    x = rng.standard_normal((2, 3, 12, 12)).astype("float32")
+    got = model.predict(x)
+
+    with torch.no_grad():
+        xt = torch.from_numpy(x)
+        h = torch.nn.functional.conv2d(xt, torch.from_numpy(w_conv),
+                                       torch.from_numpy(b_conv), padding=1)
+        h = torch.relu(h)
+        h = torch.nn.functional.max_pool2d(h, 2)
+        h = h.reshape(2, -1)
+        h = h @ torch.from_numpy(w_ip).T + torch.from_numpy(b_ip)
+        want = torch.softmax(h, dim=1).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    # trainable: gradients flow through imported blobs
+    import jax
+    import jax.numpy as jnp
+
+    params, _ = model.build(jax.random.PRNGKey(0))
+    g = jax.grad(lambda p: model.apply(p, {}, jnp.asarray(x))[0].sum())(params)
+    assert float(jnp.abs(g["conv1"][0]).max()) > 0
+
+
+def test_bn_scale_eltwise_concat(tmp_path):
+    proto = tmp_path / "n.prototxt"
+    proto.write_text("""
+name: "bn_net"
+input: "data"
+layer { name: "bn" type: "BatchNorm" bottom: "data" top: "bn"
+        batch_norm_param { eps: 0.001 } }
+layer { name: "sc" type: "Scale" bottom: "bn" top: "sc"
+        scale_param { bias_term: true } }
+layer { name: "sum" type: "Eltwise" bottom: "sc" bottom: "data" top: "sum" }
+layer { name: "cat" type: "Concat" bottom: "sum" bottom: "data" top: "cat" }
+""")
+    rng = np.random.default_rng(2)
+    mean = rng.standard_normal(3).astype("float32")
+    var = rng.uniform(0.5, 2.0, 3).astype("float32")
+    sf = np.asarray([2.0], np.float32)       # caffe stores mean*sf
+    gamma = rng.standard_normal(3).astype("float32")
+    beta = rng.standard_normal(3).astype("float32")
+    weights = tmp_path / "n.caffemodel"
+    weights.write_bytes(encode_caffemodel({
+        "bn": [mean * 2.0, var * 2.0, sf],
+        "sc": [gamma, beta]}))
+    model = load_caffe(str(proto), str(weights))
+    x = rng.standard_normal((2, 3, 4, 4)).astype("float32")
+    got = model.predict(x)
+
+    norm = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+        var.reshape(1, 3, 1, 1) + 1e-3)
+    scaled = norm * gamma.reshape(1, 3, 1, 1) + beta.reshape(1, 3, 1, 1)
+    summed = scaled + x
+    want = np.concatenate([summed, x], axis=1)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_ceil_mode_pooling_matches_torch():
+    """Caffe pooling is ceil-mode: 7→4 outputs with k=2,s=2 (torch floor: 3)."""
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((1, 2, 7, 7)).astype("float32")
+    net = parse_prototxt("""
+input: "data"
+layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+        pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+""")
+    model = CaffeModel(net, {})
+    got = model.predict(x)
+    with torch.no_grad():
+        want = torch.nn.functional.max_pool2d(
+            torch.from_numpy(x), 2, 2, ceil_mode=True).numpy()
+    assert got.shape == want.shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_ave_pool_global_and_deconv():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 3, 6, 6)).astype("float32")
+    net = parse_prototxt("""
+input: "data"
+layer { name: "g" type: "Pooling" bottom: "data" top: "g"
+        pooling_param { pool: AVE global_pooling: true } }
+""")
+    got = CaffeModel(net, {}).predict(x)
+    np.testing.assert_allclose(got.reshape(2, 3), x.mean(axis=(2, 3)),
+                               atol=1e-5)
+
+    w = rng.standard_normal((3, 5, 3, 3)).astype("float32")  # (in, out, k, k)
+    b = rng.standard_normal(5).astype("float32")
+    net2 = parse_prototxt("""
+input: "data"
+layer { name: "up" type: "Deconvolution" bottom: "data" top: "up"
+        convolution_param { num_output: 5 kernel_size: 3 stride: 2 pad: 1 } }
+""")
+    model = CaffeModel(net2, {"up": [w, b]})
+    got = model.predict(x)
+    with torch.no_grad():
+        want = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+            stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_grouped_deconv_and_axis_scale():
+    """Regression: FCN-style grouped Deconvolution + per-channel second-bottom
+    Scale must broadcast on the channel axis, not the trailing axis."""
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((1, 3, 5, 5)).astype("float32")
+    w = rng.standard_normal((3, 1, 4, 4)).astype("float32")  # group=3
+    net = parse_prototxt("""
+input: "data"
+layer { name: "up" type: "Deconvolution" bottom: "data" top: "up"
+        convolution_param { num_output: 3 group: 3 kernel_size: 4 stride: 2
+                            pad: 1 bias_term: false } }
+""")
+    got = CaffeModel(net, {"up": [w]}).predict(x)
+    with torch.no_grad():
+        want = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(w), stride=2, padding=1,
+            groups=3).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+    net2 = parse_prototxt("""
+input: "x"
+input: "s"
+layer { name: "sc" type: "Scale" bottom: "x" bottom: "s" top: "y" }
+""")
+    s = rng.standard_normal(3).astype("float32")
+    m2 = CaffeModel(net2, {})
+    ys = m2.predict([x, s]) if len(m2.input_names) == 2 else None
+    np.testing.assert_allclose(ys, x * s.reshape(1, 3, 1, 1), atol=1e-6)
+
+
+def test_elementwise_layer_zoo():
+    rng = np.random.default_rng(5)
+    x = rng.uniform(0.5, 1.5, (2, 3, 4, 4)).astype("float32")
+    net = parse_prototxt("""
+input: "data"
+layer { name: "pw" type: "Power" bottom: "data" top: "pw"
+        power_param { power: 2.0 scale: 0.5 shift: 1.0 } }
+layer { name: "lg" type: "Log" bottom: "pw" top: "lg" }
+layer { name: "ab" type: "AbsVal" bottom: "lg" top: "ab" }
+layer { name: "th" type: "Threshold" bottom: "ab" top: "th"
+        threshold_param { threshold: 0.5 } }
+""")
+    got = CaffeModel(net, {}).predict(x)
+    want = (np.abs(np.log((1.0 + 0.5 * x) ** 2)) > 0.5).astype("float32")
+    np.testing.assert_allclose(got, want)
+
+
+def test_slice_split_and_lrn():
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((2, 6, 4, 4)).astype("float32")
+    net = parse_prototxt("""
+input: "data"
+layer { name: "sl" type: "Slice" bottom: "data" top: "a" top: "b"
+        slice_param { axis: 1 slice_point: 2 } }
+layer { name: "lrn" type: "LRN" bottom: "b" top: "lrn"
+        lrn_param { local_size: 3 alpha: 0.9 beta: 0.75 } }
+""")
+    model = CaffeModel(net, {})
+    assert set(model.output_names) == {"a", "lrn"}
+    outs = dict(zip(model.output_names, model.predict(x)))
+    np.testing.assert_allclose(outs["a"], x[:, :2], atol=1e-6)
+    with torch.no_grad():
+        want = torch.nn.functional.local_response_norm(
+            torch.from_numpy(x[:, 2:]), 3, alpha=0.9, beta=0.75, k=1.0).numpy()
+    np.testing.assert_allclose(outs["lrn"], want, atol=1e-5)
+
+
+def test_net_front_door(tmp_path):
+    proto = tmp_path / "m.prototxt"
+    proto.write_text('input: "x"\n'
+                     'layer { name: "r" type: "ReLU" bottom: "x" top: "r" }')
+    model = Net.load_caffe(str(proto))
+    x = np.asarray([[-1.0, 2.0]], np.float32).reshape(1, 2, 1, 1)
+    np.testing.assert_allclose(Net.load_caffe(str(proto)).predict(x),
+                               np.maximum(x, 0))
+
+
+def test_unsupported_layer_refuses():
+    net = parse_prototxt('input: "x"\n'
+                         'layer { name: "r" type: "LSTM" bottom: "x" top: "r" }')
+    with pytest.raises(NotImplementedError, match="LSTM"):
+        CaffeModel(net, {}).predict(np.zeros((1, 2), np.float32))

@@ -340,9 +340,10 @@ def test_net_tf_checkpoint_donor(tmp_path):
 
 
 def test_net_caffe_and_detect_entries(tmp_path):
-    with pytest.raises(NotImplementedError, match="ONNX"):
-        Net.load_caffe("a.prototxt", "a.caffemodel")
+    with pytest.raises(FileNotFoundError):
+        Net.load_caffe("a.prototxt", "a.caffemodel")  # now a real loader
     assert Net._detect("weights.h5") == "keras"
+    assert Net._detect("frozen.pb") == "tf_frozen"
     assert Net._detect("model.keras") == "keras"
     with pytest.raises(Exception):  # h5py: not an HDF5 file
         Net.load(str(tmp_path / "x.h5"), kind="keras")

@@ -149,6 +149,32 @@ def test_saved_model_with_variables(tmp_path, mlp_weights):
     np.testing.assert_allclose(net2.predict(x), net.predict(x), atol=1e-6)
 
 
+def test_saved_model_multi_input_binds_by_arg_name(tmp_path):
+    """Regression: positional order is sorted signature ARG names (not tensor
+    names), and keywords bind explicitly."""
+    graph = TFGraph(nodes=[
+        node("input_1", "Placeholder"),   # mask
+        node("input_2", "Placeholder"),   # image
+        node("diff", "Sub", ["input_2", "input_1"]),
+    ])
+    sm = SavedModel(graph=graph, signatures={"serving_default": SignatureDef(
+        inputs={"image": "input_2:0", "mask": "input_1:0"},
+        outputs={"out": "diff:0"})})
+    d = tmp_path / "mi"
+    os.makedirs(d)
+    with open(d / "saved_model.pb", "wb") as f:
+        f.write(sm.encode())
+    net = from_saved_model(str(d))
+    assert net.input_args == ["image", "mask"]
+    image = np.full((2, 2), 5.0, np.float32)
+    mask = np.ones((2, 2), np.float32)
+    np.testing.assert_allclose(net.predict(image, mask), image - mask)
+    np.testing.assert_allclose(net.predict(mask=mask, image=image),
+                               image - mask)
+    with pytest.raises(KeyError, match="mask"):
+        net.predict(image=image)
+
+
 def test_saved_model_missing_variable_errors(tmp_path, mlp_weights):
     w1, b1, w2, b2 = mlp_weights
     graph = TFGraph(nodes=[
