@@ -437,8 +437,10 @@ class _CaffeExecutor:
         mean, var = blobs[0], blobs[1]
         if len(blobs) > 2:
             # caffe stores mean/var multiplied by a moving-average factor;
-            # keep the division traced — the factor is part of the params
-            sf = 1.0 / jnp.maximum(jnp.reshape(blobs[2], (-1,))[0], 1e-12)
+            # factor==0 (untrained net) means "use 0", not 1/0 — caffe's own
+            # rule is ``scale = f == 0 ? 0 : 1/f``. Keep the division traced.
+            f = jnp.reshape(blobs[2], (-1,))[0]
+            sf = jnp.where(f == 0, 0.0, 1.0 / jnp.where(f == 0, 1.0, f))
         else:
             sf = 1.0
         shape = (1, -1) + (1,) * (ins[0].ndim - 2)
@@ -496,9 +498,9 @@ class _CaffeExecutor:
         return jnp.concatenate(ins, axis=axis)
 
     def op_flatten(self, layer, ins):
+        # caffe Flatten collapses dims FROM axis onward, preserving the lead
         axis = int(layer.get("flatten_param", {}).get("axis", 1))
-        lead = int(np.prod(ins[0].shape[:axis])) if axis else 1
-        return ins[0].reshape(lead, -1)
+        return ins[0].reshape(ins[0].shape[:axis] + (-1,))
 
     def op_reshape(self, layer, ins):
         dims = [int(d) for d in

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from ...nn import layers as L
 from ...nn.graph import Input
+from ...nn.module import Layer
 from ...nn.topology import Model
 
 # ----------------------------------------------------------------- anchors
@@ -55,6 +56,38 @@ def generate_anchors(feature_sizes: Sequence[int],
         level = np.stack(per_cell, axis=1)          # (cells, n_ar, 4)
         out.append(level.reshape(-1, 4))
     return np.concatenate(out, axis=0).astype("float32")
+
+
+def generate_ssd_anchors(feature_sizes: Sequence[int],
+                         scales: Sequence[float],
+                         aspect_ratios_per_level: Sequence[Sequence[float]]
+                         ) -> np.ndarray:
+    """Paper-scheme SSD prior boxes (Liu et al. 2016 §2.2; reference
+    ssd/PriorBox): per level k with scale s_k — one ar=1 box at s_k, one extra
+    ar=1 box at sqrt(s_k·s_{k+1}), and one box per additional aspect ratio
+    (h = s/√ar, w = s·√ar). ``scales`` has ``len(feature_sizes)+1`` entries.
+    Ordering is cell-major, box-minor (must match the head reshape).
+    SSD-300: sizes [38,19,10,5,3,1] → 8732 anchors."""
+    out = []
+    for level, (fs, s_k) in enumerate(zip(feature_sizes, scales)):
+        s_next = scales[level + 1]
+        cy, cx = np.meshgrid(np.arange(fs), np.arange(fs), indexing="ij")
+        cy = (cy.reshape(-1) + 0.5) / fs
+        cx = (cx.reshape(-1) + 0.5) / fs
+        hw = [(s_k, s_k), (np.sqrt(s_k * s_next),) * 2]
+        for ar in aspect_ratios_per_level[level]:
+            if ar == 1.0:
+                continue
+            hw.append((s_k / np.sqrt(ar), s_k * np.sqrt(ar)))
+        per_cell = [np.stack([cy, cx, np.full_like(cy, h), np.full_like(cx, w)],
+                             axis=1) for h, w in hw]
+        out.append(np.stack(per_cell, axis=1).reshape(-1, 4))
+    return np.concatenate(out, axis=0).astype("float32")
+
+
+def boxes_per_cell(aspect_ratios: Sequence[float]) -> int:
+    """ar=1 contributes 2 boxes (s_k + the extra sqrt scale)."""
+    return len(aspect_ratios) + 1
 
 
 def corner_to_center(boxes: np.ndarray) -> np.ndarray:
@@ -243,17 +276,191 @@ class SSDModel(Model):
                                         aspect_ratios=self.aspect_ratios)
 
 
+class L2NormScale(Layer):
+    """Channel-wise L2 normalization with a learnable per-channel scale
+    (reference ssd NormalizeScale on conv4_3; init 20)."""
+
+    def __init__(self, init_scale: float = 20.0, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.init_scale = float(init_scale)
+
+    def build(self, rng, input_shape):
+        return {"scale": jnp.full((input_shape[-1],), self.init_scale,
+                                  jnp.float32)}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True) + 1e-10)
+        return x / norm * jnp.asarray(params["scale"], x.dtype), state
+
+
+# SSD-300 paper config (Liu et al. 2016, table in §3.1; reference
+# objectdetection zoo "ssd-vgg16-300x300" models)
+_SSD300_FEATURE_SIZES = (38, 19, 10, 5, 3, 1)
+_SSD300_SCALES = (0.1, 0.2, 0.375, 0.55, 0.725, 0.9, 1.075)
+_SSD300_ASPECT_RATIOS = ((1.0, 2.0, 0.5),
+                         (1.0, 2.0, 0.5, 3.0, 1.0 / 3),
+                         (1.0, 2.0, 0.5, 3.0, 1.0 / 3),
+                         (1.0, 2.0, 0.5, 3.0, 1.0 / 3),
+                         (1.0, 2.0, 0.5),
+                         (1.0, 2.0, 0.5))
+
+VOC_CLASSES = ("__background__", "aeroplane", "bicycle", "bird", "boat",
+               "bottle", "bus", "car", "cat", "chair", "cow", "diningtable",
+               "dog", "horse", "motorbike", "person", "pottedplant", "sheep",
+               "sofa", "train", "tvmonitor")
+
+
+class SSD300VGG(Model):
+    """Full SSD-300 with a VGG16 feature extractor (the reference's production
+    detector, ``models/image/objectdetection/`` ssd-vgg16-300x300):
+
+    * VGG16 conv1_1..conv4_3 (tap 1, 38×38, L2-normalized + scaled),
+    * conv5 + fc6 as a dilation-6 atrous 3×3 (MXU-friendly: XLA rhs_dilation,
+      no kernel materialization) + fc7 1×1 (tap 2, 19×19×1024),
+    * conv8..conv11 extra feature layers (taps 3-6: 10, 5, 3, 1),
+    * per level one conv head emitting n_boxes·(4+C), reshaped cell-major and
+      concatenated to the dense (B, 8732, 4+C) tensor multibox_loss consumes.
+    """
+
+    def __init__(self, num_classes: int, base_filters: int = 64):
+        self.num_classes_ = int(num_classes)
+        self.image_size = 300
+        bf = base_filters
+
+        def conv(x, f, k=3, s=1, mode="same", dil=1, activation="relu"):
+            return L.AtrousConvolution2D(
+                f, k, k, subsample=(s, s), atrous_rate=(dil, dil),
+                border_mode=mode, activation=activation)(x)
+
+        inp = Input((300, 300, 3))
+        x = inp
+        for f, n in ((bf, 2), (bf * 2, 2)):
+            for _ in range(n):
+                x = conv(x, f)
+            x = L.MaxPooling2D((2, 2), border_mode="same")(x)   # 150 → 75
+        for _ in range(3):
+            x = conv(x, bf * 4)
+        x = L.MaxPooling2D((2, 2), border_mode="same")(x)        # 75 → 38
+        for _ in range(3):
+            x = conv(x, bf * 8)
+        conv4_3 = L2NormScale()(x)                               # tap 1: 38
+        x = L.MaxPooling2D((2, 2), border_mode="same")(x)        # 38 → 19
+        for _ in range(3):
+            x = conv(x, bf * 8)
+        x = conv(x, bf * 16, k=3, dil=6)                         # fc6, atrous
+        fc7 = conv(x, bf * 16, k=1)                              # tap 2: 19
+        x = conv(fc7, bf * 4, k=1)
+        conv8 = conv(x, bf * 8, s=2)                             # tap 3: 10
+        x = conv(conv8, bf * 2, k=1)
+        conv9 = conv(x, bf * 4, s=2)                             # tap 4: 5
+        x = conv(conv9, bf * 2, k=1)
+        conv10 = conv(x, bf * 4, mode="valid")                   # tap 5: 3
+        x = conv(conv10, bf * 2, k=1)
+        conv11 = conv(x, bf * 4, mode="valid")                   # tap 6: 1
+
+        taps = (conv4_3, fc7, conv8, conv9, conv10, conv11)
+        heads = []
+        for tap, fs, ars in zip(taps, _SSD300_FEATURE_SIZES,
+                                _SSD300_ASPECT_RATIOS):
+            nb = boxes_per_cell(ars)
+            h = L.Convolution2D(nb * (4 + num_classes), 3, 3,
+                                border_mode="same")(tap)
+            heads.append(L.Reshape((fs * fs * nb, 4 + num_classes))(h))
+        out = L.Merge(mode="concat", concat_axis=0)(heads)
+        super().__init__(inp, out, name="ssd300_vgg")
+        self.feature_sizes = list(_SSD300_FEATURE_SIZES)
+        self.anchors = generate_ssd_anchors(
+            _SSD300_FEATURE_SIZES, _SSD300_SCALES, _SSD300_ASPECT_RATIOS)
+
+
+# config-driven zoo (reference ObjectDetector.loadObjectDetectionModel name
+# scheme "ssd-vgg16-300x300_PASCAL_*" + ImageClassificationConfig pattern)
+DETECTION_CONFIGS = {
+    "ssd-vgg16-300x300": dict(builder=lambda C, **kw: SSD300VGG(C, **kw),
+                              image_size=300, classes=VOC_CLASSES),
+    "ssd-vgg16-300x300-pascal": dict(
+        builder=lambda C, **kw: SSD300VGG(C, **kw), image_size=300,
+        classes=VOC_CLASSES),
+    "ssd-lite": dict(builder=lambda C, **kw: SSDModel(C, **kw),
+                     image_size=96, classes=None),
+}
+
+
 class ObjectDetector:
     """User-facing SSD detector (ObjectDetector.scala capability:
-    fit on (images, gt) and predictImageSet → [(label, score, box), ...])."""
+    fit on (images, gt) and predictImageSet → [(label, score, box), ...]).
+
+    ``model_name`` selects from DETECTION_CONFIGS (config-driven zoo loading);
+    the default 'ssd-lite' is the small generic-backbone variant, pass
+    'ssd-vgg16-300x300' for the full production architecture.
+    """
 
     def __init__(self, num_classes: int, image_size: int = 96,
-                 score_threshold: float = 0.3, iou_threshold: float = 0.45):
-        self.model = SSDModel(num_classes, image_size)
+                 score_threshold: float = 0.3, iou_threshold: float = 0.45,
+                 model_name: str = "ssd-lite", class_names=None, **model_kw):
+        cfg = DETECTION_CONFIGS.get(model_name)
+        if cfg is None:
+            raise ValueError(f"unknown detection model {model_name!r}; "
+                             f"known: {sorted(DETECTION_CONFIGS)}")
+        if model_name.startswith("ssd-lite"):
+            self.model = cfg["builder"](num_classes, image_size=image_size,
+                                        **model_kw)
+        else:
+            self.model = cfg["builder"](num_classes, **model_kw)
+            image_size = cfg["image_size"]
+        self.model_kw = dict(model_kw)   # persisted so load_model rebuilds
+        self.model_name = model_name
+        self.class_names = tuple(class_names or cfg.get("classes") or ())
         self.num_classes = int(num_classes)
         self.image_size = int(image_size)
         self.score_threshold = score_threshold
         self.iou_threshold = iou_threshold
+
+    @classmethod
+    def from_config(cls, model_name: str, num_classes: Optional[int] = None,
+                    **kw) -> "ObjectDetector":
+        """Zoo-style entry: ``ObjectDetector.from_config('ssd-vgg16-300x300')``
+        builds the named architecture with its dataset's class count."""
+        cfg = DETECTION_CONFIGS.get(model_name)
+        if cfg is None:
+            raise ValueError(f"unknown detection model {model_name!r}; "
+                             f"known: {sorted(DETECTION_CONFIGS)}")
+        if num_classes is None:
+            classes = cfg.get("classes")
+            if classes is None:
+                raise ValueError(f"{model_name!r} needs num_classes")
+            num_classes = len(classes)
+        return cls(num_classes, model_name=model_name, **kw)
+
+    # -- persistence (ZooModel bundle format) ---------------------------------
+    def save_model(self, path: str):
+        from ...models.common.zoo_model import save_model_bundle
+
+        save_model_bundle(path, self.model, config={
+            "model_name": self.model_name, "num_classes": self.num_classes,
+            "image_size": self.image_size, "class_names": list(self.class_names),
+            "score_threshold": self.score_threshold,
+            "iou_threshold": self.iou_threshold,
+            "model_kw": self.model_kw})
+
+    @classmethod
+    def load_model(cls, path: str) -> "ObjectDetector":
+        import json
+        import os
+
+        from ...models.common.zoo_model import load_model_bundle
+
+        with open(os.path.join(path, "config.json")) as f:
+            config = json.load(f)["config"]
+        det = cls(config["num_classes"], image_size=config["image_size"],
+                  score_threshold=config.get("score_threshold", 0.3),
+                  iou_threshold=config.get("iou_threshold", 0.45),
+                  model_name=config.get("model_name", "ssd-lite"),
+                  class_names=config.get("class_names") or None,
+                  **config.get("model_kw", {}))
+        load_model_bundle(path, model=det.model)
+        det.compile()
+        return det
 
     def compile(self, optimizer="adam", **kw):
         anchors = self.model.anchors

@@ -1,0 +1,165 @@
+"""Cross-request micro-batching for the HTTP serving path.
+
+Parity: the reference HTTP frontend rides an actor pipeline that coalesces
+concurrent requests into Redis-stream batches consumed ``coreNum`` at a time
+(serving/http/FrontEndApp.scala:45, engine/FlinkInference.scala:28-62). Here
+the same effect is in-process: every request thread submits its tensors and
+blocks; one batcher thread drains the queue up to ``max_batch`` (waiting at
+most ``max_delay_ms`` for stragglers), stacks compatible records into ONE
+device batch, and fans results back out. The XLA executable therefore sees a
+large MXU-efficient batch even when every client sends batch-1 requests.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class _Slot:
+    __slots__ = ("tensors", "event", "result", "error")
+
+    def __init__(self, tensors):
+        self.tensors = tensors
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[Exception] = None
+
+
+class MicroBatcher:
+    """Batch concurrent ``submit()`` calls into single ``predict_fn`` calls.
+
+    ``predict_fn(x)`` receives a stacked array (or list of arrays for
+    multi-input records) with a leading batch dim and must return array(s)
+    with the same leading dim.
+    """
+
+    def __init__(self, predict_fn: Callable, max_batch: int = 32,
+                 max_delay_ms: float = 2.0):
+        self.predict_fn = predict_fn
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self._q: "queue.Queue[_Slot]" = queue.Queue()
+        self._stop = threading.Event()
+        # observability: batching efficiency for /metrics and the bench
+        # (bounded — this object lives as long as the server process)
+        import collections
+
+        self.records_in = 0
+        self.batches_run = 0
+        self.max_batch_seen = 0
+        self.batch_sizes = collections.deque(maxlen=1000)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-microbatcher")
+        self._thread.start()
+
+    # ------------------------------------------------------------------ client
+    def submit_async(self, tensors: Dict[str, np.ndarray]) -> _Slot:
+        """Enqueue a record; pair with :meth:`wait`. Submitting all records of
+        a request before waiting lets them share one batch."""
+        slot = _Slot(tensors)
+        self._q.put(slot)
+        return slot
+
+    @staticmethod
+    def wait(slot: _Slot, timeout_s: float = 30.0):
+        if not slot.event.wait(timeout_s):
+            raise TimeoutError("micro-batch prediction timed out")
+        if slot.error is not None:
+            raise slot.error
+        return slot.result
+
+    def submit(self, tensors: Dict[str, np.ndarray], timeout_s: float = 30.0):
+        """Block until the batcher has run this record; returns the result."""
+        return self.wait(self.submit_async(tensors), timeout_s)
+
+    # ----------------------------------------------------------------- batcher
+    @staticmethod
+    def _signature(tensors: Dict[str, np.ndarray]) -> Tuple:
+        # preserve the caller's key order — multi-input models bind
+        # positionally in their declared input order, so reordering keys
+        # (e.g. sorting) would silently swap inputs
+        return tuple((k, v.shape, str(v.dtype)) for k, v in tensors.items())
+
+    def _drain(self) -> List[_Slot]:
+        """One blocking get, then opportunistically fill the batch for up to
+        ``max_delay_s`` — latency cost bounded, MXU batch maximized."""
+        try:
+            first = self._q.get(timeout=0.1)
+        except queue.Empty:
+            return []
+        slots = [first]
+        deadline = time.monotonic() + self.max_delay_s
+        while len(slots) < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                slots.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return slots
+
+    def _loop(self):
+        while not self._stop.is_set():
+            slots = self._drain()
+            if not slots:
+                continue
+            # group by tensor signature — only same-shaped records stack
+            groups: Dict[Tuple, List[_Slot]] = {}
+            for s in slots:
+                groups.setdefault(self._signature(s.tensors), []).append(s)
+            for group in groups.values():
+                self._run_group(group)
+
+    def _run_group(self, group: List[_Slot]):
+        self.records_in += len(group)
+        self.batches_run += 1
+        self.max_batch_seen = max(self.max_batch_seen, len(group))
+        self.batch_sizes.append(len(group))
+        try:
+            names = list(group[0].tensors)
+            arrays = [np.stack([s.tensors[n] for s in group]) for n in names]
+            x = arrays[0] if len(arrays) == 1 else arrays
+            y = self.predict_fn(x)
+            if isinstance(y, (list, tuple)):
+                for i, s in enumerate(group):
+                    s.result = [np.asarray(o[i]) for o in y]
+                    s.event.set()
+            else:
+                y = np.asarray(y)
+                for i, s in enumerate(group):
+                    s.result = y[i]
+                    s.event.set()
+        except Exception as e:
+            for s in group:
+                s.error = e
+                s.event.set()
+
+    # ------------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        sizes = list(self.batch_sizes)
+        return {
+            "records": self.records_in,
+            "batches": self.batches_run,
+            "mean_batch_size": (float(np.mean(sizes)) if sizes else 0.0),
+            "max_batch_size": self.max_batch_seen,
+        }
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        # fail queued-but-never-run slots immediately rather than leaving
+        # their waiters blocked until timeout
+        while True:
+            try:
+                slot = self._q.get_nowait()
+            except queue.Empty:
+                break
+            slot.error = RuntimeError("MicroBatcher closed before this "
+                                      "record was served")
+            slot.event.set()

@@ -6,10 +6,17 @@ Redis stream, await the result hash, respond; plus liveness + metrics routes.
 Here: stdlib ``ThreadingHTTPServer`` (one thread per in-flight request replaces
 the actor round-trip).
 
+Two serving modes:
+* queue-backed (default): requests ride the broker stream and are batched by
+  the ClusterServing engine's XREADGROUP window;
+* direct (``model=`` given): requests from concurrent connections coalesce in
+  an in-process :class:`MicroBatcher` into single MXU-sized predict calls —
+  the FrontEndApp.scala actor-batching capability without a broker hop.
+
 Routes:
     GET  /                 -> liveness ("welcome to analytics zoo web serving")
     POST /predict          -> {"instances":[{name: tensor-as-nested-list, ...}]}
-    GET  /metrics          -> timing stats JSON
+    GET  /metrics          -> timing stats JSON (+ batching stats in direct mode)
 """
 
 from __future__ import annotations
@@ -29,6 +36,13 @@ from .config import ServingConfig
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # keep-alive: one client thread ↔ one server thread for its whole session
+    # instead of a TCP connect + thread spawn per request
+    protocol_version = "HTTP/1.1"
+    # Nagle + the client's delayed ACK turns each small header/body write pair
+    # into a ~40ms stall; serving responses are small and latency-bound
+    disable_nagle_algorithm = True
+
     def log_message(self, *args):  # quiet
         pass
 
@@ -42,7 +56,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/metrics":
-            self._respond(200, timing_stats())
+            app: "FrontEndApp" = self.server.app  # type: ignore[attr-defined]
+            stats = dict(timing_stats())
+            if app._batcher is not None:
+                stats["batching"] = app._batcher.stats()
+            self._respond(200, stats)
         else:
             self._respond(200, {"message":
                                 "welcome to analytics zoo web serving"})
@@ -70,17 +88,36 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(500, {"error": str(e)})
 
 
+class _Server(ThreadingHTTPServer):
+    # default listen backlog (5) drops/resets connections under concurrent
+    # clients — the whole point of the micro-batching mode
+    request_queue_size = 128
+    daemon_threads = True
+
+
 class FrontEndApp:
     """REST gateway. ``serve()`` blocks; ``start()`` runs on a daemon thread."""
 
     def __init__(self, config: Optional[ServingConfig] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, model=None,
+                 max_batch: int = 32, max_delay_ms: float = 2.0):
         self.config = config or ServingConfig()
         self.timeout_s = timeout_s
-        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server = _Server((host, port), _Handler)
         self._server.app = self  # type: ignore[attr-defined]
-        self._input = InputQueue(self.config.queue_host, self.config.queue_port)
+        self._batcher = None
+        self._input = None
+        if model is not None:
+            # direct mode: micro-batch across concurrent request threads
+            from .batching import MicroBatcher
+
+            predict = model.predict if hasattr(model, "predict") else model
+            self._batcher = MicroBatcher(predict, max_batch=max_batch,
+                                         max_delay_ms=max_delay_ms)
+        else:
+            self._input = InputQueue(self.config.queue_host,
+                                     self.config.queue_port)
         # ThreadingHTTPServer spawns a fresh thread per request, so cache broker
         # connections in a pool rather than thread-locals (which would never hit)
         self._oq_pool: "queue.LifoQueue[OutputQueue]" = queue.LifoQueue()
@@ -104,12 +141,21 @@ class FrontEndApp:
             self._oq_pool.put(oq)
 
     def predict_instances(self, instances, timeout_s: float = 30.0):
-        uris = []
+        parsed = []
         for inst in instances:
             if not isinstance(inst, dict) or not inst:
                 raise ValueError("each instance must be a non-empty object")
-            tensors = {k: np.asarray(v) for k, v in inst.items()}
-            uris.append(self._input.enqueue(None, **tensors))
+            parsed.append({k: np.asarray(v) for k, v in inst.items()})
+        if self._batcher is not None:
+            # submit every instance first so one request's records share a batch
+            slots = [self._batcher.submit_async(t) for t in parsed]
+            out = []
+            for slot in slots:
+                val = self._batcher.wait(slot, timeout_s=timeout_s)
+                out.append(val.tolist() if isinstance(val, np.ndarray)
+                           else [np.asarray(v).tolist() for v in val])
+            return out
+        uris = [self._input.enqueue(None, **tensors) for tensors in parsed]
         out = []
         with self._output() as oq:
             for uri in uris:
@@ -127,4 +173,7 @@ class FrontEndApp:
 
     def stop(self):
         self._server.shutdown()
-        self._input.close()
+        if self._input is not None:
+            self._input.close()
+        if self._batcher is not None:
+            self._batcher.close()

@@ -1,0 +1,159 @@
+"""Serving throughput/latency benchmark — the serving-side analog of bench.py.
+
+Measures the HTTP frontend in direct micro-batching mode (FrontEndApp +
+MicroBatcher + InferenceModel bucketed-jit predict) under concurrent batch-1
+clients — the reference's Cluster-Serving operating point
+(docs ClusterServingGuide/ProgrammingGuide.md:259 batch-size guidance; no
+absolute numbers are published, so this artifact records ours).
+
+Prints ONE JSON line:
+  {"metric": "serving throughput", "value": rps, "unit": "req/s",
+   "p50_ms": ..., "p99_ms": ..., "mean_batch": ..., ...}
+and writes the same object to SERVING_BENCH.json.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+
+def _accelerator_alive(timeout_s: int = 90) -> bool:
+    """Probe the default (TPU-tunnel) backend in a subprocess — a wedged
+    tunnel blocks forever inside PJRT client init (same guard as bench.py)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); print(d[0].platform)"],
+            timeout=timeout_s, capture_output=True, text=True)
+        return r.returncode == 0 and "cpu" not in r.stdout.lower()
+    except subprocess.TimeoutExpired:
+        return False
+
+N_CLIENTS = 16
+REQUESTS_PER_CLIENT = 40
+FEATURES = 256
+HIDDEN = 1024
+CLASSES = 128
+
+
+def build_model():
+    import jax
+
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+
+    model = Sequential([
+        L.Dense(HIDDEN, activation="relu", input_shape=(FEATURES,)),
+        L.Dense(HIDDEN, activation="relu"),
+        L.Dense(CLASSES, activation="softmax"),
+    ])
+    model.compile(optimizer="adam", loss="categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, FEATURES)).astype(np.float32)
+    y = np.eye(CLASSES, dtype=np.float32)[rng.integers(0, CLASSES, 256)]
+    model.fit(x, y, batch_size=64, nb_epoch=1)
+    return InferenceModel(max_batch_size=N_CLIENTS * 2).load(model)
+
+
+def run_bench() -> dict:
+    from analytics_zoo_tpu.serving import FrontEndApp, ServingConfig
+
+    im = build_model()
+    app = FrontEndApp(ServingConfig(), port=0, model=im,
+                      max_batch=N_CLIENTS * 2, max_delay_ms=2.0).start()
+    rng = np.random.default_rng(1)
+    payloads = [json.dumps({"instances": [
+        {"input": rng.normal(size=FEATURES).astype(np.float32).tolist()}
+    ]}).encode() for _ in range(N_CLIENTS)]
+    url = f"http://127.0.0.1:{app.port}/predict"
+
+    import http.client
+
+    def one_request(conn, payload):
+        t0 = time.perf_counter()
+        conn.request("POST", "/predict", body=payload,
+                     headers={"Content-Type": "application/json"})
+        json.loads(conn.getresponse().read())
+        return (time.perf_counter() - t0) * 1000.0
+
+    # warm every bucketed executable the micro-batcher can hit — otherwise
+    # first-use XLA compiles land inside the measured window
+    rng_w = np.random.default_rng(2)
+    for b in (1, 2, 4, 8, 16, 32, N_CLIENTS * 2):
+        im.predict(rng_w.normal(size=(b, FEATURES)).astype(np.float32))
+    warm = http.client.HTTPConnection("127.0.0.1", app.port, timeout=60)
+    for p in payloads[:2]:
+        one_request(warm, p)
+    warm.close()
+
+    latencies: list = []
+    failures: list = []
+    lock = threading.Lock()
+
+    def client(idx):
+        # persistent connection per client (HTTP/1.1 keep-alive) — the
+        # realistic load-test shape; reconnect on error
+        conn = http.client.HTTPConnection("127.0.0.1", app.port, timeout=60)
+        for _ in range(REQUESTS_PER_CLIENT):
+            try:
+                ms = one_request(conn, payloads[idx])
+            except Exception as e:
+                with lock:
+                    failures.append(repr(e))
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", app.port,
+                                                  timeout=60)
+                continue
+            with lock:
+                latencies.append(ms)
+        conn.close()
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N_CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    app.stop()
+
+    lat = np.asarray(latencies)
+    stats = app._batcher.stats()
+    n = len(latencies)
+    return {
+        "metric": "serving throughput (HTTP, micro-batched)",
+        "value": round(n / wall, 1),
+        "unit": "req/s",
+        "requests": n,
+        "failed_requests": len(failures),
+        "clients": N_CLIENTS,
+        "wall_seconds": round(wall, 3),
+        "p50_ms": round(float(np.percentile(lat, 50)), 2),
+        "p95_ms": round(float(np.percentile(lat, 95)), 2),
+        "p99_ms": round(float(np.percentile(lat, 99)), 2),
+        "mean_batch": round(stats["mean_batch_size"], 2),
+        "max_batch": stats["max_batch_size"],
+        "predict_calls": stats["batches"],
+    }
+
+
+if __name__ == "__main__":
+    if not _accelerator_alive():
+        print("[serving_bench] accelerator unreachable; using cpu",
+              file=sys.stderr)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    result = run_bench()
+    with open("SERVING_BENCH.json", "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))

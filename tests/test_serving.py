@@ -196,6 +196,85 @@ def test_http_frontend(zoo_ctx, broker, fitted):
         job.stop()
 
 
+def test_http_direct_mode_microbatches_across_requests(zoo_ctx, fitted):
+    """Concurrent batch-1 HTTP requests must coalesce into shared predict
+    batches (FrontEndApp actor-batching parity) — fewer model invocations than
+    requests, same numerics as sequential predict."""
+    model, x = fitted
+    calls = {"n": 0, "sizes": []}
+    real_predict = model.predict
+
+    def counting_predict(batch):
+        calls["n"] += 1
+        calls["sizes"].append(np.asarray(batch).shape[0])
+        return real_predict(batch)
+
+    n_req = 24
+    app = FrontEndApp(ServingConfig(), port=0, model=counting_predict,
+                      max_batch=16, max_delay_ms=60.0).start()
+    try:
+        want = np.asarray(model.predict(x[:n_req]))
+        results = [None] * n_req
+        errors = []
+
+        def client(i):
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{app.port}/predict",
+                    data=json.dumps({"instances": [
+                        {"input": x[i].tolist()}]}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    results[i] = np.asarray(
+                        json.loads(r.read())["predictions"][0])
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_req)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        for i in range(n_req):
+            np.testing.assert_allclose(results[i], want[i], rtol=1e-4,
+                                       atol=1e-5)
+        # the batching claim itself: far fewer predict calls than requests
+        assert calls["n"] < n_req / 2, (calls, app._batcher.stats())
+        assert max(calls["sizes"]) >= 4
+        # /metrics surfaces batching stats in direct mode
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/metrics", timeout=10) as r:
+            stats = json.loads(r.read())
+        assert stats["batching"]["records"] == n_req
+        assert stats["batching"]["mean_batch_size"] > 1.0
+    finally:
+        app.stop()
+
+
+def test_microbatcher_heterogeneous_shapes_and_errors(zoo_ctx):
+    from analytics_zoo_tpu.serving.batching import MicroBatcher
+
+    def predict(b):
+        arr = np.asarray(b)
+        if arr.shape[-1] == 3:
+            raise RuntimeError("bad shape three")
+        return arr * 2
+
+    mb = MicroBatcher(predict, max_batch=8, max_delay_ms=30.0)
+    try:
+        s1 = mb.submit_async({"x": np.ones(2, np.float32)})
+        s2 = mb.submit_async({"x": np.full(4, 3.0, np.float32)})
+        s3 = mb.submit_async({"x": np.ones(3, np.float32)})  # will error
+        np.testing.assert_allclose(mb.wait(s1), [2, 2])
+        np.testing.assert_allclose(mb.wait(s2), [6, 6, 6, 6])
+        with pytest.raises(RuntimeError, match="three"):
+            mb.wait(s3)
+    finally:
+        mb.close()
+
+
 def test_config_yaml_reference_layout(tmp_path):
     p = tmp_path / "config.yaml"
     p.write_text("""
