@@ -42,6 +42,18 @@ from . import checkpoint as ckpt
 logger = logging.getLogger("analytics_zoo_tpu.estimator")
 
 
+def _overlay(base: dict, donated: dict) -> dict:
+    """Deep-merge donated weights over a fresh init (missing keys keep their
+    fresh values — the transfer-learning partial-donor path)."""
+    out = dict(base)
+    for k, v in donated.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _overlay(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
 def _as_featureset(data, batch_size=None) -> FeatureSet:
     if isinstance(data, FeatureSet):
         return data
@@ -74,6 +86,9 @@ class Estimator:
         # model-bundle loading (ZooModel.loadModel); weights were already read
         # from disk eagerly by KerasNet.load_weights
         self.initial_weights: Optional[tuple] = None
+        # set True when initial_weights holds only SOME layers' params
+        # (transfer learning) — missing slots then keep a fresh init
+        self.initial_weights_partial = False
 
     def set_gradient_clipping(self, clip_norm: Optional[float] = None,
                               clip_value: Optional[tuple] = None) -> "Estimator":
@@ -143,6 +158,14 @@ class Estimator:
         k_init, k_train = jax.random.split(rng)
         if self.initial_weights is not None:
             params, mstate = self.initial_weights
+            if self.initial_weights_partial and isinstance(params, dict):
+                # partial donation (transfer learning: some layers donated,
+                # new heads freshly initialized) — overlay on a fresh init.
+                # Opt-in flag: the common full-donation/resume path must not
+                # pay a throwaway fresh build.
+                fresh_p, fresh_s = self.model.build(k_init, in_shape)
+                params = _overlay(fresh_p, params)
+                mstate = _overlay(fresh_s, mstate or {})
         else:
             params, mstate = self.model.build(k_init, in_shape)
         opt_state = self.tx.init(params)
@@ -494,8 +517,13 @@ class Estimator:
             xb = host_batch[0] if len(host_batch) == 1 else list(host_batch)
             y = self._predict_step(self.train_state["params"],
                                    self.train_state["model_state"], xb)
-            outs.append(np.asarray(jax.device_get(y)))
-        return np.concatenate(outs, axis=0)
+            outs.append(jax.device_get(y))
+        if isinstance(outs[0], (tuple, list)):
+            # multi-output model (functional Model with several outputs):
+            # concatenate each output head across batches
+            return [np.concatenate([np.asarray(o[i]) for o in outs], axis=0)
+                    for i in range(len(outs[0]))]
+        return np.concatenate([np.asarray(o) for o in outs], axis=0)
 
     # ------------------------------------------------------------- summaries
     def set_tensorboard(self, log_dir: str, app_name: str):

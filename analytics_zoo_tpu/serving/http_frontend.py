@@ -173,6 +173,7 @@ class FrontEndApp:
 
     def stop(self):
         self._server.shutdown()
+        self._server.server_close()   # release the listening socket fd
         if self._input is not None:
             self._input.close()
         if self._batcher is not None:

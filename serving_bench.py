@@ -81,7 +81,11 @@ def run_bench() -> dict:
         t0 = time.perf_counter()
         conn.request("POST", "/predict", body=payload,
                      headers={"Content-Type": "application/json"})
-        json.loads(conn.getresponse().read())
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(f"HTTP {resp.status}: {body[:200]!r}")
+        json.loads(body)
         return (time.perf_counter() - t0) * 1000.0
 
     # warm every bucketed executable the micro-batcher can hit — otherwise
@@ -126,8 +130,13 @@ def run_bench() -> dict:
     wall = time.perf_counter() - t0
     app.stop()
 
-    lat = np.asarray(latencies)
     stats = app._batcher.stats()
+    if not latencies:
+        return {"metric": "serving throughput (HTTP, micro-batched)",
+                "value": 0.0, "unit": "req/s", "requests": 0,
+                "failed_requests": len(failures),
+                "first_failure": failures[0] if failures else None}
+    lat = np.asarray(latencies)
     n = len(latencies)
     return {
         "metric": "serving throughput (HTTP, micro-batched)",

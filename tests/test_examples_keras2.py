@@ -100,6 +100,14 @@ CHEAP_EXAMPLES = [
     "seq2seq_chatbot.py",
     "qa_ranker.py",
     "int8_inference.py",
+    "inception_imagenet.py",
+    "resnet_training.py",
+    "vae.py",
+    "image_similarity.py",
+    "fraud_detection.py",
+    "dogs_vs_cats_finetune.py",
+    "streaming_object_detection.py",
+    "streaming_text_classification.py",
 ]
 
 

@@ -134,3 +134,65 @@ def test_dp_sharding_matches_single_device(zoo_ctx):
     assert len(la) == len(lb) and len(la) > 0
     for a, b in zip(la, lb):
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
+
+
+def test_multi_output_model_fit_and_predict(zoo_ctx):
+    """Functional Model with several outputs: custom loss over the tuple in
+    fit, list-of-arrays from predict (the VAE pattern)."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.nn import layers as L
+    from analytics_zoo_tpu.nn.graph import Input
+    from analytics_zoo_tpu.nn.topology import Model
+
+    inp = Input((6,))
+    h = L.Dense(8, activation="relu")(inp)
+    out_a = L.Dense(3)(h)
+    out_b = L.Dense(2)(h)
+    m = Model(inp, [out_a, out_b])
+
+    def loss(y_true, y_pred):
+        a, b = y_pred
+        return jnp.mean((a - y_true[:, :3]) ** 2) + jnp.mean(b ** 2)
+
+    m.compile(optimizer="adam", loss=loss)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 6)).astype("float32")
+    y = rng.standard_normal((32, 3)).astype("float32")
+    m.fit(x, y, batch_size=16, nb_epoch=1)
+    preds = m.predict(x, batch_size=8)   # crosses several batches
+    assert isinstance(preds, list) and len(preds) == 2
+    assert preds[0].shape == (32, 3) and preds[1].shape == (32, 2)
+
+
+def test_partial_weight_donation(zoo_ctx):
+    """initial_weights_partial overlays donated layers on a fresh init —
+    the transfer-learning path (freeze -> new head)."""
+    import jax
+
+    from analytics_zoo_tpu.nn import layers as L
+    from analytics_zoo_tpu.nn.topology import Sequential
+
+    src = Sequential([L.Dense(8, activation="relu", input_shape=(4,),
+                              name="shared"),
+                      L.Dense(2, name="head")])
+    src.compile(optimizer="adam", loss="mse")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype("float32")
+    y = rng.standard_normal((16, 2)).astype("float32")
+    src.fit(x, y, batch_size=8, nb_epoch=1)
+    trained = src.estimator.train_state["params"]
+
+    dst = Sequential([src.layers[0], L.Dense(3, name="new_head")])
+    dst.compile(optimizer="adam", loss="mse")
+    dst.estimator.initial_weights = (
+        {dst.slot(src.layers[0]): trained[src.slot(src.layers[0])]}, {})
+    dst.estimator.initial_weights_partial = True
+    y3 = rng.standard_normal((16, 3)).astype("float32")
+    dst.fit(x, y3, batch_size=16, nb_epoch=0)  # init only
+    got = dst.estimator.train_state["params"]
+    np.testing.assert_allclose(
+        np.asarray(got[dst.slot(src.layers[0])]["kernel"]),
+        np.asarray(trained[src.slot(src.layers[0])]["kernel"]), atol=1e-6)
+    # the new head exists with a fresh init
+    assert got[dst.slot(dst.layers[1])]["kernel"].shape == (8, 3)
