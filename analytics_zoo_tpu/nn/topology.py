@@ -42,6 +42,19 @@ class KerasNet:
             self.load_weights(pending)
         return self
 
+    def set_initial_weights(self, params, state=None,
+                            partial: bool = False) -> "KerasNet":
+        """Donate weights for the next build (transfer learning surface).
+
+        ``partial=True`` overlays ``params`` on a fresh init — layers absent
+        from the donated dict keep their fresh initialization (the freeze →
+        new-head path; see examples/dogs_vs_cats_finetune.py).
+        """
+        self._require_compiled()
+        self.estimator.initial_weights = (params, state or {})
+        self.estimator.initial_weights_partial = bool(partial)
+        return self
+
     def load_weights(self, path: str):
         """Restore a weight bundle. Before ``compile``: deferred to compile time.
         After: loaded EAGERLY (I/O errors surface here, not at first predict) into

@@ -12,6 +12,7 @@ from _common import force_cpu_if_no_tpu, SMOKE
 
 force_cpu_if_no_tpu()
 
+import jax
 import numpy as np
 
 from analytics_zoo_tpu.nn import layers as L
@@ -58,7 +59,7 @@ def main():
     # phase 1: frozen features, train the head only
     feats = feature_extractor(size)
     frozen = Sequential(feats + [
-        L.Lambda(lambda t: __import__("jax").lax.stop_gradient(t)),
+        L.Lambda(jax.lax.stop_gradient),
         L.Dense(2, activation="softmax"),
     ])
     frozen.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
@@ -74,8 +75,7 @@ def main():
     trained = frozen.estimator.train_state["params"]
     donated = {full.slot(l): trained[frozen.slot(l)]
                for l in feats if frozen.slot(l) in trained}
-    full.estimator.initial_weights = (donated, {})
-    full.estimator.initial_weights_partial = True  # head2 keeps fresh init
+    full.set_initial_weights(donated, partial=True)  # head2 keeps fresh init
     full.fit(x[:cut], y[:cut], batch_size=16, nb_epoch=2 if SMOKE else 8)
     print("finetuned eval:", full.evaluate(x[cut:], y[cut:]))
 

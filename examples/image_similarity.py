@@ -60,9 +60,8 @@ def main():
     # prefix of layers
     trained = backbone.estimator.train_state["params"]
     keep = {embed.slot(l) for l in embed.layers}
-    embed.estimator.initial_weights = (
-        {k: v for k, v in trained.items() if k in keep}, {})
-    embed.estimator.initial_weights_partial = True
+    embed.set_initial_weights(
+        {k: v for k, v in trained.items() if k in keep}, partial=True)
 
     feats = np.asarray(embed.predict(imgs, batch_size=16))
     feats = feats.reshape(len(imgs), -1)

@@ -77,7 +77,7 @@ def main():
     print(f"HR@10: {float((rank <= 10).mean()):.4f}  "
           f"NDCG@10: {float(np.where(rank <= 10, 1 / np.log2(rank + 1), 0).mean()):.4f}")
 
-    recs = model.recommend_for_user(pairs[cut:], max_items=3)
+    recs = model.recommend_for_user(train_pairs[cut:], max_items=3)
     print("top recommendations:", recs[:3])
 
 

@@ -11,7 +11,9 @@ force_cpu_if_no_tpu()
 import numpy as np
 
 from analytics_zoo_tpu.inference import InferenceModel
-from analytics_zoo_tpu.models.image.objectdetection import ObjectDetector
+from analytics_zoo_tpu.models.image.objectdetection import (ObjectDetector,
+                                                            decode_predictions,
+                                                            nms)
 from analytics_zoo_tpu.serving import (ClusterServing, InputQueue, OutputQueue,
                                        ServingConfig, start_broker)
 
@@ -50,13 +52,10 @@ def main():
         uris = [iq.enqueue(None, image=f) for f in frame_stream(n_frames, size)]
         for t, uri in enumerate(uris):
             raw = oq.query(uri, timeout_s=60)
-            from analytics_zoo_tpu.models.image.objectdetection import (
-                decode_predictions, nms)
-
             bxs, probs = decode_predictions(np.asarray(raw), det.model.anchors)
             scores = probs[:, 1]
-            keep = nms(bxs[scores > det.score_threshold],
-                       scores[scores > det.score_threshold])
+            mask = scores > det.score_threshold
+            keep = nms(bxs[mask], scores[mask])
             print(f"frame {t}: {len(keep)} detections")
     finally:
         job.stop()

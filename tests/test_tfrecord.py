@@ -1,0 +1,124 @@
+"""TFRecord reader/writer + tf.Example codec + FeatureSet ingestion tests
+(TFDataset breadth, VERDICT Missing #7)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data.featureset import FeatureSet
+from analytics_zoo_tpu.data.tfrecord import (decode_example, encode_example,
+                                             read_records,
+                                             read_tfrecord_examples,
+                                             write_records)
+
+
+def test_record_framing_roundtrip(tmp_path):
+    p = str(tmp_path / "r.tfrecord")
+    payloads = [b"alpha", b"", b"x" * 1000]
+    assert write_records(p, payloads) == 3
+    got = list(read_records(p, verify_crc=True))
+    assert got == payloads
+
+
+def test_crc_detects_corruption(tmp_path):
+    p = str(tmp_path / "r.tfrecord")
+    write_records(p, [b"hello world"])
+    raw = bytearray(open(p, "rb").read())
+    raw[14] ^= 0xFF  # flip a data byte
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(ValueError, match="CRC"):
+        list(read_records(p, verify_crc=True))
+    # without verification the (corrupt) bytes still stream
+    assert len(list(read_records(p))) == 1
+
+
+def test_example_codec_roundtrip():
+    ex = {
+        "floats": np.asarray([1.5, -2.25, 3.0], np.float32),
+        "ints": np.asarray([7, -9, 1 << 40], np.int64),
+        "label": np.asarray([3], np.int64),
+        "text": [b"hello", "world"],
+    }
+    back = decode_example(encode_example(ex))
+    np.testing.assert_allclose(back["floats"], ex["floats"])
+    np.testing.assert_array_equal(back["ints"], ex["ints"])
+    np.testing.assert_array_equal(back["label"], [3])
+    assert list(back["text"]) == [b"hello", b"world"]
+
+
+def test_featureset_from_tfrecord(tmp_path):
+    rng = np.random.default_rng(0)
+    feats = rng.standard_normal((20, 4)).astype("float32")
+    labels = rng.integers(0, 3, 20)
+    p = str(tmp_path / "train.tfrecord")
+    write_records(p, (encode_example({"x": feats[i], "y": [int(labels[i])]})
+                      for i in range(20)))
+
+    fs = FeatureSet.from_tfrecord(p, feature_cols=["x"], label_cols=["y"])
+    assert len(fs) == 20
+    batch = next(fs.batches(8, shuffle=False))
+    xb, yb = batch
+    np.testing.assert_allclose(xb, feats[:8], atol=1e-6)
+    np.testing.assert_array_equal(yb, labels[:8])
+
+    # dict-tree mode + max_records + multi-file
+    p2 = str(tmp_path / "train2.tfrecord")
+    write_records(p2, (encode_example({"x": feats[i], "y": [int(labels[i])]})
+                       for i in range(5)))
+    table = read_tfrecord_examples([p, p2])
+    assert table["x"].shape == (25, 4)
+    fs2 = FeatureSet.from_tfrecord([p, p2], max_records=10)
+    assert len(fs2) == 10
+
+
+def test_ragged_features_refuse_clearly(tmp_path):
+    p = str(tmp_path / "ragged.tfrecord")
+    write_records(p, [encode_example({"t": np.asarray([1.0, 2.0], np.float32)}),
+                      encode_example({"t": np.asarray([1.0], np.float32)})])
+    with pytest.raises(ValueError, match="ragged"):
+        read_tfrecord_examples(p)
+
+
+def test_featureset_from_dataframe():
+    pd = pytest.importorskip("pandas")
+    rng = np.random.default_rng(1)
+    df = pd.DataFrame({
+        "a": rng.standard_normal(16).astype("float32"),
+        "b": rng.standard_normal(16).astype("float32"),
+        "emb": [rng.standard_normal(3).astype("float32") for _ in range(16)],
+        "label": rng.integers(0, 2, 16),
+    })
+    fs = FeatureSet.from_dataframe(df, feature_cols=["a", "b"],
+                                   label_cols=["label"])
+    xb, yb = next(fs.batches(16, shuffle=False))
+    assert xb.shape == (16, 2)
+    np.testing.assert_allclose(xb[:, 0], df["a"].to_numpy(), atol=1e-6)
+    np.testing.assert_array_equal(yb, df["label"].to_numpy())
+
+    # array-valued column
+    fs2 = FeatureSet.from_dataframe(df, feature_cols=["emb"])
+    (x2,) = next(fs2.batches(16, shuffle=False))
+    assert x2.shape == (16, 3)
+
+
+def test_tfrecord_trains_end_to_end(tmp_path):
+    """TFRecord → FeatureSet → fit: the ingestion path feeds training."""
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((64, 6)).astype("float32")
+    y = (x.sum(axis=1) > 0).astype("int64")
+    p = str(tmp_path / "ds.tfrecord")
+    write_records(p, (encode_example({"feat": x[i], "label": [int(y[i])]})
+                      for i in range(64)))
+    fs = FeatureSet.from_tfrecord(p, feature_cols=["feat"],
+                                  label_cols=["label"])
+    from analytics_zoo_tpu.nn.optimizers import Adam
+
+    m = Sequential([L.Dense(16, activation="relu", input_shape=(6,)),
+                    L.Dense(2, activation="softmax")])
+    m.compile(optimizer=Adam(lr=1e-2),
+              loss="sparse_categorical_crossentropy", metrics=["accuracy"])
+    m.fit(fs, batch_size=16, nb_epoch=25)
+    acc = m.evaluate(x, y.astype("int32"))["sparse_categorical_accuracy"]
+    assert acc > 0.9

@@ -185,9 +185,9 @@ def test_partial_weight_donation(zoo_ctx):
 
     dst = Sequential([src.layers[0], L.Dense(3, name="new_head")])
     dst.compile(optimizer="adam", loss="mse")
-    dst.estimator.initial_weights = (
-        {dst.slot(src.layers[0]): trained[src.slot(src.layers[0])]}, {})
-    dst.estimator.initial_weights_partial = True
+    dst.set_initial_weights(
+        {dst.slot(src.layers[0]): trained[src.slot(src.layers[0])]},
+        partial=True)
     y3 = rng.standard_normal((16, 3)).astype("float32")
     dst.fit(x, y3, batch_size=16, nb_epoch=0)  # init only
     got = dst.estimator.train_state["params"]
