@@ -147,13 +147,20 @@ class FeatureSet:
         from .tfrecord import read_tfrecord_examples
 
         table = read_tfrecord_examples(paths, max_records=max_records)
+
+        def label(c):
+            arr = table[c]
+            # single-value label features squeeze to (N,) for sparse losses;
+            # features keep their (N, F) axis (same contract as from_dataframe)
+            return arr[:, 0] if (arr.ndim == 2 and arr.shape[1] == 1) else arr
+
         if feature_cols is None:
             return cls(table, **kw)
         feats = tuple(table[c] for c in feature_cols)
         x = feats[0] if len(feats) == 1 else feats
         if not label_cols:
             return cls((x,), **kw)
-        labels = tuple(table[c] for c in label_cols)
+        labels = tuple(label(c) for c in label_cols)
         y = labels[0] if len(labels) == 1 else labels
         return cls((x, y), **kw)
 

@@ -170,14 +170,25 @@ def read_tfrecord_examples(paths: Union[str, Sequence[str]],
             break
     if not rows:
         raise ValueError(f"no records in {paths}")
+    names = set()
+    for r in rows:
+        names.update(r)                   # schema = union over ALL records
     out: Dict[str, np.ndarray] = {}
-    for name in rows[0]:
-        vals = [r[name] for r in rows]
+    for name in sorted(names):
+        vals = []
+        for i, r in enumerate(rows):
+            if name not in r:
+                raise ValueError(
+                    f"feature {name!r} missing from record {i} — optional "
+                    "features need a default; iterate read_records/"
+                    "decode_example to handle them record-wise")
+            vals.append(r[name])
         lens = {len(v) for v in vals}
         if len(lens) != 1:
             raise ValueError(
                 f"feature {name!r} is ragged (lengths {sorted(lens)[:5]}...) "
                 "— pad upstream or iterate read_records/decode_example")
-        arr = np.stack(vals)
-        out[name] = (arr[:, 0] if arr.shape[1] == 1 else arr)
+        # width-1 features keep their axis: (N, 1); FeatureSet.from_tfrecord
+        # squeezes LABEL columns only (same contract as from_dataframe)
+        out[name] = np.stack(vals)
     return out

@@ -190,7 +190,12 @@ class Estimator:
             def loss_of(p):
                 y_hat, new_mstate = model.apply(p, state["model_state"], x,
                                                 training=True, rng=rng)
-                return loss_fn(y, y_hat), new_mstate
+                total = loss_fn(y, y_hat)
+                # 0.0 unless layers carry w/b regularizers
+                reg_fn = getattr(model, "regularization", None)
+                if reg_fn is not None:
+                    total = total + reg_fn(p)
+                return total, new_mstate
 
             (loss, new_mstate), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(state["params"])

@@ -105,6 +105,14 @@ class GraphModule(Layer):
     def slot(self, layer: Layer) -> str:
         return self._slots[id(layer)]
 
+    def regularization(self, params):
+        total = 0.0
+        for layer in self.layers:
+            p = params.get(self.slot(layer))
+            if p is not None:
+                total = total + layer.regularization(p)
+        return total
+
     @property
     def input_shape(self):
         shapes = [n.shape for n in self.input_nodes]
@@ -227,6 +235,14 @@ class SequentialModule(Layer):
             if s2 != {} or key in new_state:
                 new_state[key] = s2
         return x, new_state
+
+    def regularization(self, params):
+        total = 0.0
+        for i, layer in enumerate(self.layers):
+            p = params.get(self._slot_key(i, layer))
+            if p is not None:
+                total = total + layer.regularization(p)
+        return total
 
     def compute_output_shape(self, input_shape):
         shape = input_shape

@@ -32,7 +32,8 @@ class Convolution2D(Layer):
 
     def __init__(self, nb_filter: int, nb_row: int, nb_col: int, activation=None,
                  border_mode: str = "valid", subsample=(1, 1), init="glorot_uniform",
-                 use_bias: bool = True, name=None, input_shape=None):
+                 bias_init="zeros", use_bias: bool = True, name=None,
+                 input_shape=None):
         super().__init__(name=name, input_shape=input_shape)
         self.filters = int(nb_filter)
         self.kernel_size = (int(nb_row), int(nb_col))
@@ -40,14 +41,16 @@ class Convolution2D(Layer):
         self.padding = border_mode.upper()
         self.activation = get_activation(activation)
         self.init = get_initializer(init)
+        self.bias_init = get_initializer(bias_init)
         self.use_bias = use_bias
 
     def build(self, rng, input_shape):
         in_ch = input_shape[-1]
         kh, kw = self.kernel_size
-        params = {"kernel": self.init(rng, (kh, kw, in_ch, self.filters), param_dtype())}
+        k_w, k_b = jax.random.split(rng)
+        params = {"kernel": self.init(k_w, (kh, kw, in_ch, self.filters), param_dtype())}
         if self.use_bias:
-            params["bias"] = jnp.zeros((self.filters,), param_dtype())
+            params["bias"] = self.bias_init(k_b, (self.filters,), param_dtype())
         return params, {}
 
     def apply(self, params, state, x, *, training=False, rng=None):
@@ -76,8 +79,8 @@ class Convolution1D(Layer):
 
     def __init__(self, nb_filter: int, filter_length: int, activation=None,
                  border_mode: str = "valid", subsample_length: int = 1,
-                 init="glorot_uniform", use_bias: bool = True, name=None,
-                 input_shape=None):
+                 init="glorot_uniform", bias_init="zeros",
+                 use_bias: bool = True, name=None, input_shape=None):
         super().__init__(name=name, input_shape=input_shape)
         self.filters = int(nb_filter)
         self.kernel_size = int(filter_length)
@@ -85,14 +88,16 @@ class Convolution1D(Layer):
         self.padding = border_mode.upper()
         self.activation = get_activation(activation)
         self.init = get_initializer(init)
+        self.bias_init = get_initializer(bias_init)
         self.use_bias = use_bias
 
     def build(self, rng, input_shape):
         in_ch = input_shape[-1]
-        params = {"kernel": self.init(rng, (self.kernel_size, in_ch, self.filters),
+        k_w, k_b = jax.random.split(rng)
+        params = {"kernel": self.init(k_w, (self.kernel_size, in_ch, self.filters),
                                       param_dtype())}
         if self.use_bias:
-            params["bias"] = jnp.zeros((self.filters,), param_dtype())
+            params["bias"] = self.bias_init(k_b, (self.filters,), param_dtype())
         return params, {}
 
     def apply(self, params, state, x, *, training=False, rng=None):

@@ -39,22 +39,27 @@ class Dense(Layer):
     """
 
     def __init__(self, output_dim: int, activation=None, use_bias: bool = True,
-                 init="glorot_uniform", w_regularizer=None, b_regularizer=None,
-                 name: Optional[str] = None, input_shape: Optional[Shape] = None):
+                 init="glorot_uniform", bias_init="zeros", w_regularizer=None,
+                 b_regularizer=None, name: Optional[str] = None,
+                 input_shape: Optional[Shape] = None):
         super().__init__(name=name, input_shape=input_shape)
         self.output_dim = int(output_dim)
         self.activation = get_activation(activation)
         self.use_bias = use_bias
+        from ..regularizers import get_regularizer
+
         self.init = get_initializer(init)
-        self.w_regularizer = w_regularizer
-        self.b_regularizer = b_regularizer
+        self.bias_init = get_initializer(bias_init)
+        self.w_regularizer = get_regularizer(w_regularizer)
+        self.b_regularizer = get_regularizer(b_regularizer)
 
     def build(self, rng, input_shape):
         in_dim = input_shape[-1]
-        k_w, _ = jax.random.split(rng)
+        k_w, k_b = jax.random.split(rng)
         params = {"kernel": self.init(k_w, (in_dim, self.output_dim), param_dtype())}
         if self.use_bias:
-            params["bias"] = jnp.zeros((self.output_dim,), param_dtype())
+            params["bias"] = self.bias_init(k_b, (self.output_dim,),
+                                            param_dtype())
         return params, {}
 
     def apply(self, params, state, x, *, training=False, rng=None):

@@ -22,7 +22,7 @@ from ..module import Layer, as_compute, get_initializer, param_dtype
 class _RNNBase(Layer):
     def __init__(self, output_dim: int, activation="tanh", return_sequences=False,
                  go_backwards=False, init="glorot_uniform", inner_init="glorot_uniform",
-                 name=None, input_shape=None):
+                 bias_init="zeros", name=None, input_shape=None):
         super().__init__(name=name, input_shape=input_shape)
         self.output_dim = int(output_dim)
         self.activation = get_activation(activation)
@@ -30,18 +30,19 @@ class _RNNBase(Layer):
         self.go_backwards = go_backwards
         self.init = get_initializer(init)
         self.inner_init = get_initializer(inner_init)
+        self.bias_init = get_initializer(bias_init)
 
     n_gates = 1
 
     def build(self, rng, input_shape):
         in_dim = input_shape[-1]
         h = self.output_dim
-        k1, k2 = jax.random.split(rng)
+        k1, k2, k3 = jax.random.split(rng, 3)
         g = self.n_gates
         params = {
             "kernel": self.init(k1, (in_dim, g * h), param_dtype()),
             "recurrent_kernel": self.inner_init(k2, (h, g * h), param_dtype()),
-            "bias": jnp.zeros((g * h,), param_dtype()),
+            "bias": self.bias_init(k3, (g * h,), param_dtype()),
         }
         return params, {}
 
@@ -88,16 +89,30 @@ class SimpleRNN(_RNNBase):
 
 
 class LSTM(_RNNBase):
-    """LSTM with fused-gate GEMM; gate order [i, f, c, o] (LSTM.scala parity)."""
+    """LSTM with fused-gate GEMM; gate order [i, f, c, o] (LSTM.scala parity).
+
+    ``unit_forget_bias`` (keras-2 semantics, default off to match the keras-1
+    reference): initialize the forget-gate bias to 1 so the cell remembers by
+    default at the start of training."""
 
     n_gates = 4
 
     def __init__(self, output_dim, activation="tanh", inner_activation="hard_sigmoid",
                  return_sequences=False, go_backwards=False, init="glorot_uniform",
-                 inner_init="glorot_uniform", name=None, input_shape=None):
+                 inner_init="glorot_uniform", bias_init="zeros",
+                 unit_forget_bias: bool = False, name=None, input_shape=None):
         super().__init__(output_dim, activation, return_sequences, go_backwards,
-                         init, inner_init, name=name, input_shape=input_shape)
+                         init, inner_init, bias_init, name=name,
+                         input_shape=input_shape)
         self.inner_activation = get_activation(inner_activation)
+        self.unit_forget_bias = bool(unit_forget_bias)
+
+    def build(self, rng, input_shape):
+        params, state = super().build(rng, input_shape)
+        if self.unit_forget_bias:
+            h = self.output_dim
+            params["bias"] = params["bias"].at[h:2 * h].set(1.0)
+        return params, state
 
     def initial_carry(self, batch, dtype):
         z = jnp.zeros((batch, self.output_dim), dtype)
@@ -123,9 +138,11 @@ class GRU(_RNNBase):
 
     def __init__(self, output_dim, activation="tanh", inner_activation="hard_sigmoid",
                  return_sequences=False, go_backwards=False, init="glorot_uniform",
-                 inner_init="glorot_uniform", name=None, input_shape=None):
+                 inner_init="glorot_uniform", bias_init="zeros", name=None,
+                 input_shape=None):
         super().__init__(output_dim, activation, return_sequences, go_backwards,
-                         init, inner_init, name=name, input_shape=input_shape)
+                         init, inner_init, bias_init, name=name,
+                         input_shape=input_shape)
         self.inner_activation = get_activation(inner_activation)
 
     def step(self, p, h_prev, x_t):

@@ -161,6 +161,19 @@ class Layer:
     def compute_output_shape(self, input_shape: Shape) -> Shape:
         return input_shape
 
+    def regularization(self, params: PyTree):
+        """Regularization loss contribution for this layer's ``params``
+        (summed into the training loss by the Estimator). Layers with
+        ``w_regularizer``/``b_regularizer`` override the default 0."""
+        total = 0.0
+        w_reg = getattr(self, "w_regularizer", None)
+        b_reg = getattr(self, "b_regularizer", None)
+        if w_reg is not None and isinstance(params, dict) and "kernel" in params:
+            total = total + w_reg(params["kernel"])
+        if b_reg is not None and isinstance(params, dict) and "bias" in params:
+            total = total + b_reg(params["bias"])
+        return total
+
     # --- functional-graph sugar ---------------------------------------------
     def __call__(self, node_or_nodes):
         """Connect this layer into a functional graph (Keras ``layer.inputs(node)``

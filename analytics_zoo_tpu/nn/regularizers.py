@@ -1,0 +1,53 @@
+"""Weight regularizers (reference BigDL ``L1Regularizer``/``L2Regularizer``/
+``L1L2Regularizer`` used throughout ``keras/layers/`` as
+``wRegularizer``/``bRegularizer``).
+
+A regularizer is any ``fn(param_array) -> scalar``; layer ``regularization``
+hooks sum these into the training loss inside the jitted step (see
+``engine/estimator.py``), so they are differentiable parts of the one compiled
+program — no separate weight-decay pass.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+
+
+class L1:
+    def __init__(self, l1: float = 0.01):
+        self.l1 = float(l1)
+
+    def __call__(self, p):
+        return self.l1 * jnp.sum(jnp.abs(p))
+
+
+class L2:
+    def __init__(self, l2: float = 0.01):
+        self.l2 = float(l2)
+
+    def __call__(self, p):
+        return self.l2 * jnp.sum(p * p)
+
+
+class L1L2:
+    def __init__(self, l1: float = 0.01, l2: float = 0.01):
+        self.l1, self.l2 = float(l1), float(l2)
+
+    def __call__(self, p):
+        return self.l1 * jnp.sum(jnp.abs(p)) + self.l2 * jnp.sum(p * p)
+
+
+def get_regularizer(reg: Union[None, str, Callable]) -> Optional[Callable]:
+    if reg is None or callable(reg):
+        return reg
+    key = reg.lower()
+    if key == "l1":
+        return L1()
+    if key == "l2":
+        return L2()
+    if key in ("l1l2", "l1_l2"):
+        return L1L2()
+    raise ValueError(f"unknown regularizer {reg!r}; use 'l1'|'l2'|'l1l2' or a "
+                     "callable")
