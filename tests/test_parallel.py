@@ -82,14 +82,17 @@ def test_param_sharding_rules():
     assert rule((FakeKey("block0"), FakeKey("qkv_bias")), bias) == P()
 
 
-def test_transformer_lm_trains_on_multi_axis_mesh(zoo_ctx):
+def test_transformer_lm_trains_on_multi_axis_mesh(zoo_ctx, monkeypatch):
     """The full dryrun path: dp/fsdp/tp/sp sharded train step executes and the
-    loss decreases over steps."""
+    loss decreases over steps. GRAFT_DRYRUN_CHILD keeps it in-process (the
+    driver-facing parent path re-execs a subprocess and is covered by the
+    driver itself)."""
     spec = importlib.util.spec_from_file_location(
         "graft_entry", os.path.join(os.path.dirname(__file__), "..",
                                     "__graft_entry__.py"))
     ge = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(ge)
+    monkeypatch.setenv("GRAFT_DRYRUN_CHILD", "1")
     ge.dryrun_multichip(8)
 
 
