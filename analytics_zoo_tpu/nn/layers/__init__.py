@@ -28,7 +28,8 @@ from .advanced_activations import (ELU, LeakyReLU, PReLU, RReLU, Softmax, SReLU,
                                    SpatialDropout3D, ThresholdedReLU)
 from .attention import (BERT, MultiHeadAttention, PositionalEmbedding,
                         TransformerLayer)
-from .embedding import Embedding, SparseEmbedding, WordEmbedding
+from .embedding import (Embedding, FusedPairEmbedding, SparseEmbedding,
+                        WordEmbedding)
 from .merge import Merge, merge
 from .normalization import BatchNormalization, LayerNormalization
 from .recurrent import (GRU, LSTM, Bidirectional, ConvLSTM2D, ConvLSTM3D,
@@ -50,7 +51,7 @@ __all__ = [
     "BatchNormalization", "Bidirectional", "BinaryThreshold", "CAdd", "CMul",
     "Conv1D", "Conv2D", "Conv3D", "ConvLSTM2D", "ConvLSTM3D", "Convolution1D",
     "Convolution2D", "Convolution3D", "Cropping1D", "Cropping2D", "Cropping3D",
-    "Deconvolution2D", "Dense", "DepthwiseConv2D", "Dropout", "ELU", "Embedding",
+    "Deconvolution2D", "Dense", "DepthwiseConv2D", "Dropout", "ELU", "Embedding", "FusedPairEmbedding",
     "Exp", "Expand", "ExpandDim", "Flatten", "GRU", "GaussianDropout",
     "GaussianNoise", "GaussianSampler", "GetShape", "GlobalAveragePooling1D",
     "GlobalAveragePooling2D", "GlobalAveragePooling3D", "GlobalMaxPooling1D",

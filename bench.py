@@ -88,6 +88,61 @@ def _hr_at_10(est, eval_sets) -> float:
     return float((rank <= 10).mean())
 
 
+def run_ncf_implicit(platform: str | None = None, train_epochs: int = 8,
+                     n_negatives: int = 4) -> dict:
+    """NCF-paper implicit-feedback recipe: binary interactions, ``n_negatives``
+    random negatives per positive sampled ON DEVICE inside the jitted step
+    (fresh every step), BCE, leave-one-out HR@10 over 1+99 candidates. This is
+    the falsifiable accuracy recipe — random ranking gives 0.10, the paper's
+    NeuMF lands 0.6-0.7 on real ML-1M."""
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    from analytics_zoo_tpu.common import (MeshConfig, PrecisionConfig,
+                                          RuntimeConfig, TrainConfig,
+                                          init_zoo_context, reset_zoo_context)
+    from analytics_zoo_tpu.data import FeatureSet
+    from analytics_zoo_tpu.engine import Estimator
+    from analytics_zoo_tpu.models.recommendation import (ImplicitNCF,
+                                                         implicit_bce_loss)
+    from analytics_zoo_tpu.nn.optimizers import Adam
+
+    reset_zoo_context()
+    ctx = init_zoo_context(RuntimeConfig(
+        mesh=MeshConfig(dp=0),
+        precision=PrecisionConfig(compute_dtype="bfloat16")))
+
+    train_pairs, _labels, eval_sets = _movielens_leave_one_out()
+    fs = FeatureSet.from_numpy(train_pairs,
+                               np.zeros(len(train_pairs), "float32"))
+    n_steps = len(fs) // BATCH
+
+    model = ImplicitNCF(user_count=6040, item_count=3706,
+                        n_negatives=n_negatives)
+    est = Estimator(model, optimizer=Adam(lr=2.5e-3), loss=implicit_bce_loss,
+                    mesh=ctx.mesh,
+                    config=TrainConfig(log_every_n_steps=10**9,
+                                       cache_on_device=True,
+                                       scan_block_steps=n_steps))
+    est.fit(fs, batch_size=BATCH, epochs=train_epochs)
+
+    flat = eval_sets.reshape(-1, 2).astype("int32")
+    probs = est.predict(flat, batch_size=BATCH)
+    score = np.asarray(probs).reshape(eval_sets.shape[0], eval_sets.shape[1])
+    rank = (score[:, 1:] > score[:, 0:1]).sum(axis=1) + 1
+    return {
+        "hr@10": round(float((rank <= 10).mean()), 4),
+        "ndcg@10": round(float(np.where(rank <= 10,
+                                        1.0 / np.log2(rank + 1), 0.0).mean()), 4),
+        "n_negatives": n_negatives,
+        "epochs": train_epochs,
+        "final_loss": float(est.trainer_state.last_loss),
+        "platform": str(jax.devices()[0].platform),
+    }
+
+
 def run_ncf(platform: str | None = None, train_epochs: int = TRAIN_EPOCHS) -> dict:
     import jax
 
@@ -235,16 +290,43 @@ def _accelerator_alive(timeout_s: int = 90) -> bool:
         return False
 
 
-def _cpu_reference(timeout_s: int = 900) -> dict | None:
-    """Run the identical NCF recipe on the host CPU in a subprocess."""
+def _wait_for_accelerator() -> bool:
+    """Retry the accelerator probe over a window before giving up: the tunnel
+    wedges transiently (round 2 lost its TPU datapoint to a single 90s probe),
+    so keep probing every BENCH_TPU_PROBE_INTERVAL_S seconds for up to
+    BENCH_TPU_PROBE_WINDOW_S seconds (default 20 min; set 0 to probe once)."""
+    window = float(os.environ.get("BENCH_TPU_PROBE_WINDOW_S", 1200))
+    interval = float(os.environ.get("BENCH_TPU_PROBE_INTERVAL_S", 120))
+    deadline = time.monotonic() + window
+    attempt = 0
+    while True:
+        attempt += 1
+        if _accelerator_alive():
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        print(f"[bench] accelerator probe {attempt} failed; retrying for "
+              f"another {remaining:.0f}s", file=sys.stderr)
+        time.sleep(min(interval, max(remaining, 0)))
+
+
+def _cpu_reference_start(flag: str = "--cpu-reference") -> subprocess.Popen:
+    """Launch the identical NCF recipe on the host CPU in a background
+    subprocess (overlaps with the TPU runs — joined via _cpu_reference_join)."""
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), flag],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _cpu_reference_join(proc: subprocess.Popen,
+                        timeout_s: int = 1200) -> dict | None:
     try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--cpu-reference"],
-            timeout=timeout_s, capture_output=True, text=True)
-        if r.returncode == 0:
-            return json.loads(r.stdout.strip().splitlines()[-1])
+        out, _err = proc.communicate(timeout=timeout_s)
+        if proc.returncode == 0:
+            return json.loads(out.strip().splitlines()[-1])
     except (subprocess.TimeoutExpired, json.JSONDecodeError, IndexError):
-        pass
+        proc.kill()
     return None
 
 
@@ -252,14 +334,23 @@ if __name__ == "__main__":
     if "--cpu-reference" in sys.argv:
         print(json.dumps(run_ncf(platform="cpu")))
         sys.exit(0)
+    if "--cpu-reference-implicit" in sys.argv:
+        print(json.dumps(run_ncf_implicit(platform="cpu")))
+        sys.exit(0)
 
-    on_accel = _accelerator_alive()
+    on_accel = _wait_for_accelerator()
     if not on_accel:
-        print("[bench] accelerator backend unreachable; falling back to cpu",
-              file=sys.stderr)
+        print("[bench] accelerator backend unreachable after probe window; "
+              "falling back to cpu — vs_baseline will be null (a CPU run "
+              "measured against itself carries no signal)", file=sys.stderr)
+    # launch the CPU references up front so they overlap with the TPU runs
+    ref_procs = ((_cpu_reference_start("--cpu-reference"),
+                  _cpu_reference_start("--cpu-reference-implicit"))
+                 if on_accel else (None, None))
+
     main = run_ncf(platform=None if on_accel else "cpu")
 
-    cpu = _cpu_reference() if on_accel else main
+    cpu = _cpu_reference_join(ref_procs[0]) if on_accel else main
     if cpu is not None:
         baseline_sps = cpu["samples_per_sec"]
         hr_cpu = cpu.get("hr@10")
@@ -268,6 +359,18 @@ if __name__ == "__main__":
         baseline_sps = CPU_FALLBACK_SAMPLES_PER_SEC
         hr_cpu = None
         baseline_src = "recorded_fallback"
+
+    try:  # implicit-feedback accuracy recipe (falsifiable HR@10)
+        implicit = run_ncf_implicit(platform=None if on_accel else "cpu")
+        implicit_cpu = (_cpu_reference_join(ref_procs[1])
+                        if on_accel else implicit)
+        implicit["hr@10_cpu_reference"] = (implicit_cpu or {}).get("hr@10")
+        if implicit["hr@10_cpu_reference"] is not None:
+            implicit["hr@10_gap"] = round(
+                implicit["hr@10"] - implicit["hr@10_cpu_reference"], 4)
+    except Exception as e:  # additive entry; never break the main line
+        print(f"[bench] implicit recipe failed: {e}", file=sys.stderr)
+        implicit = None
 
     try:
         tlm = run_transformer_mfu() if on_accel else None
@@ -279,7 +382,9 @@ if __name__ == "__main__":
         "metric": "NCF MovieLens-1M training throughput",
         "value": main["samples_per_sec_per_chip"],
         "unit": "samples/sec/chip",
-        "vs_baseline": round(main["samples_per_sec_per_chip"] / baseline_sps, 3),
+        "vs_baseline": (round(main["samples_per_sec_per_chip"] / baseline_sps, 3)
+                        if on_accel else None),
+        "tpu_available": on_accel,
         "hr@10": main["hr@10"],
         "hr@10_cpu_reference": hr_cpu,
         "hr@10_gap": (round(main["hr@10"] - hr_cpu, 4)
@@ -292,6 +397,7 @@ if __name__ == "__main__":
         "measured_seconds": main["measured_seconds"],
         "final_loss": main["final_loss"],
         "platform": main["platform"],
+        "implicit": implicit,
         "transformer_lm": tlm,
     }
     print(json.dumps(result))

@@ -101,3 +101,47 @@ def test_save_load_roundtrip(small_ncf, tmp_path):
     loaded.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
     probs_after = loaded.predict(pairs[:50])
     np.testing.assert_allclose(probs_before, probs_after, rtol=1e-5, atol=1e-6)
+
+
+def test_implicit_ncf_beats_random_ranking(zoo_ctx):
+    """NCF-paper implicit protocol: on-device negative sampling + BCE lifts
+    HR@10 well above the 0.10 random floor of the 1+99 candidate layout."""
+    from analytics_zoo_tpu.common import TrainConfig
+    from analytics_zoo_tpu.engine import Estimator
+    from analytics_zoo_tpu.models.recommendation import (ImplicitNCF,
+                                                         implicit_bce_loss)
+
+    n_users, n_items = 300, 200
+    pairs, _ = synthetic_movielens(30_000, n_users=n_users, n_items=n_items)
+    ev = leave_one_out_eval_sets(pairs, n_items, n_negatives=99, max_users=200)
+    model = ImplicitNCF(user_count=n_users, item_count=n_items, n_negatives=4,
+                        user_embed=8, item_embed=8, hidden_layers=(16, 8),
+                        mf_embed=8)
+    est = Estimator(model, optimizer=Adam(lr=5e-3), loss=implicit_bce_loss,
+                    mesh=zoo_ctx.mesh,
+                    config=TrainConfig(log_every_n_steps=10**9))
+    est.fit((pairs, np.zeros(len(pairs), "float32")), batch_size=2048, epochs=8)
+
+    flat = ev.reshape(-1, 2).astype("int32")
+    score = np.asarray(est.predict(flat, batch_size=4096)).reshape(
+        ev.shape[0], ev.shape[1])
+    rank = (score[:, 1:] > score[:, 0:1]).sum(axis=1) + 1
+    hr10 = float((rank <= 10).mean())
+    assert hr10 > 0.25, f"implicit HR@10 {hr10} not materially above random 0.10"
+
+
+def test_implicit_ncf_training_block_shape(zoo_ctx):
+    from analytics_zoo_tpu.models.recommendation import ImplicitNCF
+
+    model = ImplicitNCF(user_count=20, item_count=30, n_negatives=3,
+                        user_embed=4, item_embed=4, hidden_layers=(8,),
+                        mf_embed=4)
+    params, state = model.build(jax.random.PRNGKey(0))
+    pos = np.array([[1, 2], [3, 4]], dtype="int32")
+    block, _ = model.apply(params, state, pos, training=True,
+                           rng=jax.random.PRNGKey(1))
+    assert np.asarray(block).shape == (2, 4)  # [pos | 3 negatives]
+    assert ((np.asarray(block) >= 0) & (np.asarray(block) <= 1)).all()
+    # inference path: plain (B, 1) probabilities
+    probs, _ = model.apply(params, state, pos)
+    assert np.asarray(probs).shape == (2, 1)
