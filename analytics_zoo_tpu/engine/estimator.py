@@ -54,6 +54,20 @@ def _overlay(base: dict, donated: dict) -> dict:
     return out
 
 
+def _walk_layers(module):
+    """Yield every layer reachable through nested containers (graph/sequential
+    sub-modules expose ``.layers``)."""
+    seen = set()
+    stack = [module]
+    while stack:
+        m = stack.pop()
+        if id(m) in seen:
+            continue
+        seen.add(id(m))
+        yield m
+        stack.extend(getattr(m, "layers", ()) or ())
+
+
 def _as_featureset(data, batch_size=None) -> FeatureSet:
     if isinstance(data, FeatureSet):
         return data
@@ -529,6 +543,61 @@ class Estimator:
             return [np.concatenate([np.asarray(o[i]) for o in outs], axis=0)
                     for i in range(len(outs[0]))]
         return np.concatenate([np.asarray(o) for o in outs], axis=0)
+
+    # --------------------------------------------------- batchnorm recalibration
+    def recalibrate_batchnorm(self, x, batch_size: int = 32, passes: int = 2,
+                              momentum: float = 0.5):
+        """Re-estimate BatchNorm moving statistics under the FINAL weights.
+
+        During short trainings the 0.99-momentum EMA lags the fast-moving
+        weights, so eval-mode (moving-stat) forward passes diverge from
+        train-mode (batch-stat) ones. This runs forward-only passes over ``x``
+        with a low-momentum override — the functional equivalent of
+        ``torch.optim.swa_utils.update_bn`` — and keeps only the state.
+        Dropout-family layers are silenced so the statistics match the
+        serving-time distribution.
+        """
+        from ..nn.layers.normalization import BatchNormalization
+
+        if self.train_state is None:
+            return self
+        bns = [l for l in _walk_layers(self.model)
+               if isinstance(l, BatchNormalization)]
+        if not bns:
+            return self
+        noisy = [l for l in _walk_layers(self.model)
+                 if hasattr(l, "rate") and getattr(l, "rate", 0)]
+        saved = [(l, l.momentum) for l in bns] + [(l, l.rate) for l in noisy]
+        for l in bns:
+            l.momentum = float(momentum)
+        for l in noisy:
+            l.rate = 0.0
+        try:
+            model = self.model
+            # fresh trace every call: momentum/rate are captured at trace time
+            fwd = jax.jit(lambda p, s, xb: model.apply(
+                p, s, xb, training=True, rng=jax.random.PRNGKey(0))[1])
+            data = (x,) if not isinstance(x, (tuple, list)) else tuple(x)
+            fs = x if isinstance(x, FeatureSet) else FeatureSet(data)
+            # keep only the model's inputs: a labeled FeatureSet (or a fit-style
+            # (x, y) tuple) carries targets as trailing components that must not
+            # reach model.apply
+            n_in = len(getattr(self.model, "input_nodes", ()) or ()) or 1
+            mstate = self.train_state["model_state"]
+            for _ in range(max(1, passes)):
+                for hb in fs.batches(batch_size, shuffle=False,
+                                     drop_remainder=False):
+                    hb = hb[:n_in]
+                    xb = hb[0] if len(hb) == 1 else list(hb)
+                    mstate = fwd(self.train_state["params"], mstate, xb)
+            self.train_state["model_state"] = mstate
+        finally:
+            for l, v in saved:
+                if isinstance(l, BatchNormalization):
+                    l.momentum = v
+                else:
+                    l.rate = v
+        return self
 
     # ------------------------------------------------------------- summaries
     def set_tensorboard(self, log_dir: str, app_name: str):

@@ -257,7 +257,9 @@ class SSDModel(Model):
         while size > 2 and level < 6:
             x = L.Convolution2D(filters, 3, 3, subsample=(2, 2),
                                 border_mode="same", use_bias=False)(x)
-            x = L.BatchNormalization()(x)
+            # 0.9 momentum: detector fits are short (few hundred steps), the
+            # keras-default 0.99 EMA never catches the final weights
+            x = L.BatchNormalization(momentum=0.9)(x)
             x = L.Activation("relu")(x)
             size = -(-size // 2)
             level += 1
@@ -485,9 +487,17 @@ class ObjectDetector:
                                       axis=1))
         return np.stack(out)
 
-    def fit(self, images, gt_boxes_list, gt_labels_list, **kw):
+    def fit(self, images, gt_boxes_list, gt_labels_list,
+            recalibrate_bn: bool = True, **kw):
         targets = self.encode_targets(gt_boxes_list, gt_labels_list)
-        self.model.fit(np.asarray(images, dtype="float32"), targets, **kw)
+        images = np.asarray(images, dtype="float32")
+        self.model.fit(images, targets, **kw)
+        if recalibrate_bn:
+            # short detector fits leave the 0.99-EMA BatchNorm stats lagging
+            # the final weights → eval-mode confidences collapse; re-estimate
+            # under the trained weights (Estimator.recalibrate_batchnorm)
+            self.model.estimator.recalibrate_batchnorm(
+                images, batch_size=int(kw.get("batch_size", 16)))
         return self
 
     def predict(self, images, batch_size: int = 16):

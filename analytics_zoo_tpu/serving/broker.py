@@ -14,6 +14,17 @@ length-prefixed-JSON TCP protocol:
 
 It runs in-process (``start_broker()`` returns a served port) or standalone
 (``python -m analytics_zoo_tpu.serving.broker --port 6380``).
+
+Durability (the reference's Redis-persistence + consumer-group recovery story —
+FlinkRedisSource.scala:44-59 resumes its group cursor after a job restart, and
+``scripts/cluster-serving/cluster-serving-restart`` bounces the service): pass
+``aof_path`` and every mutation is appended as a JSON line and fsync'd before
+the client sees the ack. On startup the log is replayed, so acknowledged
+requests and results survive a broker kill. Delivered-but-unacknowledged
+entries (tracked in a per-group pending list, Redis PEL semantics — consumers
+``XACK`` after writing results) are re-delivered ahead of new traffic after a
+crash restart. ``python -m analytics_zoo_tpu.serving.cli restart`` is the
+cluster-serving-restart equivalent.
 """
 
 from __future__ import annotations
@@ -21,6 +32,7 @@ from __future__ import annotations
 import argparse
 import collections
 import json
+import os
 import socket
 import socketserver
 import struct
@@ -63,7 +75,7 @@ class _Store:
     long-running deployment holds bounded memory.
     """
 
-    def __init__(self, maxlen: int = 65536):
+    def __init__(self, maxlen: int = 65536, aof_path: Optional[str] = None):
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.maxlen = maxlen
@@ -72,20 +84,123 @@ class _Store:
         self.trimmed: Dict[str, int] = collections.defaultdict(int)
         self.hashes: Dict[str, Any] = {}
         self._seq = 0
+        # PEL: delivered-but-unacked entries per (stream, group); ``redeliver``
+        # holds entries recovered from the log at startup — served before the
+        # cursor so a crash never drops an accepted request
+        self.pending: Dict[Tuple[str, str], Dict[str, Any]] = \
+            collections.defaultdict(dict)
+        self.redeliver: Dict[Tuple[str, str], List[Tuple[str, Any]]] = \
+            collections.defaultdict(list)
+        self._aof = None
+        self._aof_path = aof_path
+        self._ops_since_rewrite = 0
+        if aof_path:
+            if os.path.exists(aof_path):
+                self._replay(aof_path)
+            # compact at startup: replaying history re-runs every trim ever
+            # applied; the snapshot keeps restart time bounded by LIVE state
+            self._rewrite_locked()
+
+    # -- append-only log ------------------------------------------------------
+    REWRITE_EVERY_OPS = 200_000
+
+    def _log(self, *rec: Any) -> None:
+        """Append one mutation; fsync before the caller acks the client."""
+        if self._aof is not None:
+            self._aof.write(json.dumps(list(rec)) + "\n")
+            self._aof.flush()
+            os.fsync(self._aof.fileno())
+            self._ops_since_rewrite += 1
+            if self._ops_since_rewrite >= self.REWRITE_EVERY_OPS:
+                self._rewrite_locked()
+
+    def _rewrite_locked(self) -> None:
+        """Snapshot live state into a fresh log and atomically swap it in
+        (Redis BGREWRITEAOF analog, done inline — live state is bounded by
+        ``maxlen`` so the rewrite is cheap). Caller holds the lock, or is the
+        constructor."""
+        if self._aof_path is None:
+            return
+        tmp = self._aof_path + ".rewrite"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for stream, entries in self.streams.items():
+                for entry_id, payload in entries:
+                    f.write(json.dumps(["A", stream, entry_id, payload]) + "\n")
+            for (stream, group), cur in self.cursors.items():
+                f.write(json.dumps(["G", stream, group, 0]) + "\n")
+                f.write(json.dumps(["R", stream, group, cur, []]) + "\n")
+            for (stream, group), ents in self.pending.items():
+                if ents:
+                    f.write(json.dumps(["R", stream, group,
+                                        self.cursors[(stream, group)],
+                                        list(ents)]) + "\n")
+            for key, mapping in self.hashes.items():
+                f.write(json.dumps(["H", key, mapping]) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        if self._aof is not None:
+            self._aof.close()
+        os.replace(tmp, self._aof_path)
+        self._aof = open(self._aof_path, "a", encoding="utf-8")
+        self._ops_since_rewrite = 0
+
+    def _replay(self, path: str) -> None:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn final write from the crash: ignore
+                op = rec[0]
+                if op == "A":
+                    _, stream, entry_id, payload = rec
+                    self._append(stream, entry_id, payload)
+                    self._seq = max(self._seq, int(entry_id.split("-")[0]))
+                elif op == "G":
+                    self.cursors.setdefault((rec[1], rec[2]), rec[3])
+                elif op == "R":
+                    _, stream, group, new_cursor, ids = rec
+                    key = (stream, group)
+                    self.cursors[key] = new_cursor
+                    by_id = dict(self.streams[stream])
+                    for i in ids:
+                        if i in by_id:
+                            self.pending[key][i] = by_id[i]
+                elif op == "K":
+                    _, stream, group, ids = rec
+                    for i in ids:
+                        self.pending[(stream, group)].pop(i, None)
+                elif op == "H":
+                    self.hashes[rec[1]] = rec[2]
+                elif op == "D":
+                    self.hashes.pop(rec[1], None)
+        # anything still pending was in flight when the broker died: schedule
+        # redelivery ahead of new traffic (Redis XAUTOCLAIM-on-restart analog)
+        for key, ents in self.pending.items():
+            if ents:
+                self.redeliver[key] = sorted(
+                    ents.items(), key=lambda kv: int(kv[0].split("-")[0]))
+
+    def _append(self, stream: str, entry_id: str, payload: Any) -> None:
+        entries = self.streams[stream]
+        entries.append((entry_id, payload))
+        overflow = len(entries) - self.maxlen
+        if overflow > 0:
+            del entries[:overflow]
+            self.trimmed[stream] += overflow
+            for key in self.cursors:
+                if key[0] == stream:
+                    self.cursors[key] = max(0, self.cursors[key] - overflow)
 
     def xadd(self, stream: str, payload: Any) -> str:
         with self.cond:
             self._seq += 1
             entry_id = f"{self._seq}-0"
-            entries = self.streams[stream]
-            entries.append((entry_id, payload))
-            overflow = len(entries) - self.maxlen
-            if overflow > 0:
-                del entries[:overflow]
-                self.trimmed[stream] += overflow
-                for key in self.cursors:
-                    if key[0] == stream:
-                        self.cursors[key] = max(0, self.cursors[key] - overflow)
+            self._append(stream, entry_id, payload)
+            self._log("A", stream, entry_id, payload)
             self.cond.notify_all()
             return entry_id
 
@@ -98,28 +213,58 @@ class _Store:
             if key not in self.cursors:
                 self.cursors[key] = (len(self.streams[stream])
                                      if start == "$" else 0)
+                self._log("G", stream, group, self.cursors[key])
 
     def xreadgroup(self, stream: str, group: str, count: int,
                    block_ms: int) -> List[Tuple[str, Any]]:
         deadline = None if block_ms <= 0 else block_ms / 1e3
         with self.cond:
             key = (stream, group)
+            out: List[Tuple[str, Any]] = []
+            # crash-recovered in-flight entries first (stay pending until XACK)
+            redo = self.redeliver.get(key)
+            if redo:
+                out.extend(redo[:count])
+                del redo[:len(out)]
 
-            def pending():
+            def fresh():
                 return len(self.streams[stream]) - self.cursors[key]
 
-            if pending() == 0 and deadline:
+            if not out and fresh() == 0 and deadline:
                 self.cond.wait(timeout=deadline)
-            take = min(count, pending())
-            if take <= 0:
-                return []
-            start = self.cursors[key]
-            self.cursors[key] = start + take
-            return self.streams[stream][start:start + take]
+            take = min(count - len(out), fresh())
+            if take > 0:
+                start = self.cursors[key]
+                self.cursors[key] = start + take
+                out.extend(self.streams[stream][start:start + take])
+            if out:
+                for i, payload in out:
+                    self.pending[key][i] = payload
+                self._log("R", stream, group, self.cursors[key],
+                          [i for i, _ in out])
+            return out
+
+    def xack(self, stream: str, group: str, ids: List[str]) -> int:
+        with self.cond:
+            key = (stream, group)
+            n = 0
+            dropped = set(ids)
+            for i in ids:
+                if self.pending[key].pop(i, None) is not None:
+                    n += 1
+            # an entry acked while queued for crash redelivery (its result was
+            # written before the crash) must not be served again
+            redo = self.redeliver.get(key)
+            if redo:
+                self.redeliver[key] = [e for e in redo if e[0] not in dropped]
+            if n:
+                self._log("K", stream, group, list(ids))
+            return n
 
     def hset(self, key: str, mapping: Any) -> None:
         with self.cond:
             self.hashes[key] = mapping
+            self._log("H", key, mapping)
             self.cond.notify_all()
 
     def hget(self, key: str, block_ms: int = 0) -> Any:
@@ -132,6 +277,7 @@ class _Store:
     def hdel(self, key: str) -> None:
         with self.cond:
             self.hashes.pop(key, None)
+            self._log("D", key)
 
     def slen(self, stream: str) -> int:
         with self.cond:
@@ -153,6 +299,8 @@ class _Handler(socketserver.BaseRequestHandler):
                     resp = "OK"
                 elif cmd == "XREADGROUP":
                     resp = store.xreadgroup(req[1], req[2], req[3], req[4])
+                elif cmd == "XACK":
+                    resp = store.xack(req[1], req[2], req[3])
                 elif cmd == "HSET":
                     store.hset(req[1], req[2])
                     resp = "OK"
@@ -181,18 +329,20 @@ class QueueBroker(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 aof_path: Optional[str] = None):
         super().__init__((host, port), _Handler)
-        self.store = _Store()
+        self.store = _Store(aof_path=aof_path)
 
     @property
     def port(self) -> int:
         return self.server_address[1]
 
 
-def start_broker(host: str = "127.0.0.1", port: int = 0) -> QueueBroker:
+def start_broker(host: str = "127.0.0.1", port: int = 0,
+                 aof_path: Optional[str] = None) -> QueueBroker:
     """Start a broker on a daemon thread; returns it (``.port`` is bound)."""
-    broker = QueueBroker(host, port)
+    broker = QueueBroker(host, port, aof_path=aof_path)
     threading.Thread(target=broker.serve_forever, daemon=True,
                      name="zoo-queue-broker").start()
     return broker
@@ -202,8 +352,10 @@ def main():  # pragma: no cover - exercised as a subprocess
     ap = argparse.ArgumentParser(description="analytics_zoo_tpu queue broker")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=6380)
+    ap.add_argument("--aof", default=None,
+                    help="append-only persistence file (replayed on start)")
     args = ap.parse_args()
-    broker = QueueBroker(args.host, args.port)
+    broker = QueueBroker(args.host, args.port, aof_path=args.aof)
     print(f"queue broker listening on {args.host}:{broker.port}", flush=True)
     broker.serve_forever()
 

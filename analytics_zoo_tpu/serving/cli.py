@@ -1,0 +1,111 @@
+"""cluster-serving lifecycle CLI.
+
+Parity: ``scripts/cluster-serving/cluster-serving-start|stop|restart`` in the
+reference manage the Redis + Flink serving service. Here the managed process is
+the queue broker (with optional append-only persistence, see broker.py); a
+restart with the same ``--aof`` file recovers every acknowledged request and
+re-delivers in-flight ones.
+
+    python -m analytics_zoo_tpu.serving.cli start   --port 6380 --aof /var/zoo/serving.aof
+    python -m analytics_zoo_tpu.serving.cli stop    --port 6380
+    python -m analytics_zoo_tpu.serving.cli restart --port 6380 --aof /var/zoo/serving.aof
+    python -m analytics_zoo_tpu.serving.cli status  --port 6380
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import subprocess
+import sys
+import time
+
+from .broker import recv_msg, send_msg
+
+
+def _call(host: str, port: int, *req, timeout: float = 5.0):
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        send_msg(s, list(req))
+        return recv_msg(s)
+
+
+def _alive(host: str, port: int) -> bool:
+    try:
+        return _call(host, port, "PING", timeout=2.0) == "PONG"
+    except (OSError, ConnectionError, ValueError):
+        return False
+
+
+def do_start(args) -> int:
+    if _alive(args.host, args.port):
+        print(f"broker already running on {args.host}:{args.port}")
+        return 0
+    cmd = [sys.executable, "-m", "analytics_zoo_tpu.serving.broker",
+           "--host", args.host, "--port", str(args.port)]
+    if args.aof:
+        cmd += ["--aof", args.aof]
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL,
+                            start_new_session=True)
+    deadline = time.time() + args.wait
+    while time.time() < deadline:
+        if _alive(args.host, args.port):
+            print(f"broker started on {args.host}:{args.port} (pid {proc.pid})"
+                  + (f", persisting to {args.aof}" if args.aof else ""))
+            return 0
+        if proc.poll() is not None:
+            print(f"broker exited rc={proc.returncode}", file=sys.stderr)
+            return 1
+        time.sleep(0.1)
+    print("broker did not come up in time", file=sys.stderr)
+    return 1
+
+
+def do_stop(args) -> int:
+    if not _alive(args.host, args.port):
+        print(f"no broker on {args.host}:{args.port}")
+        return 0
+    try:
+        _call(args.host, args.port, "SHUTDOWN")
+    except (OSError, ConnectionError):
+        pass
+    deadline = time.time() + args.wait
+    while time.time() < deadline:
+        if not _alive(args.host, args.port):
+            print("broker stopped")
+            return 0
+        time.sleep(0.1)
+    print("broker still answering after SHUTDOWN", file=sys.stderr)
+    return 1
+
+
+def do_restart(args) -> int:
+    rc = do_stop(args)
+    if rc != 0:
+        return rc
+    return do_start(args)
+
+
+def do_status(args) -> int:
+    up = _alive(args.host, args.port)
+    print(f"broker on {args.host}:{args.port}: {'UP' if up else 'DOWN'}")
+    return 0 if up else 3
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="cluster-serving lifecycle (start/stop/restart/status)")
+    ap.add_argument("action", choices=["start", "stop", "restart", "status"])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=6380)
+    ap.add_argument("--aof", default=None,
+                    help="append-only persistence file (start/restart)")
+    ap.add_argument("--wait", type=float, default=10.0,
+                    help="seconds to wait for start/stop to take effect")
+    args = ap.parse_args(argv)
+    return {"start": do_start, "stop": do_stop,
+            "restart": do_restart, "status": do_status}[args.action](args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

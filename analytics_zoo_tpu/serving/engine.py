@@ -98,12 +98,12 @@ class ClusterServing:
             batch, bad = [], []
             for _id, payload in entries:
                 try:
-                    batch.append((payload["uri"], decode_payload(payload["data"])))
+                    batch.append((_id, payload["uri"],
+                                  decode_payload(payload["data"])))
                 except Exception as e:  # malformed record: report, keep running
                     logger.exception("malformed record %s", _id)
                     uri = payload.get("uri") if isinstance(payload, dict) else None
-                    if uri:
-                        bad.append((uri, {"error": f"malformed payload: {e}"}))
+                    bad.append((_id, uri, {"error": f"malformed payload: {e}"}))
             if bad:
                 self._sink_q.put(bad)
             if batch:
@@ -113,13 +113,13 @@ class ClusterServing:
         if conn is not None:
             conn.close()
 
-    def _collate(self, batch: List[Tuple[str, Dict[str, np.ndarray]]]):
+    def _collate(self, batch: List[Tuple[str, str, Dict[str, np.ndarray]]]):
         """Stack per-record tensors into batched arrays (FlinkInference batches
         records before predict). Records must share input names/shapes."""
-        names = list(batch[0][1].keys())
+        names = list(batch[0][2].keys())
         arrays = []
         for name in names:
-            arrays.append(np.stack([rec[name] for _, rec in batch], axis=0))
+            arrays.append(np.stack([rec[name] for _, _, rec in batch], axis=0))
         return arrays[0] if len(arrays) == 1 else arrays
 
     def _infer_loop(self):
@@ -128,15 +128,18 @@ class ClusterServing:
                 batch = self._infer_q.get(timeout=0.1)
             except queue.Empty:
                 continue
-            uris = [u for u, _ in batch]
+            ids = [i for i, _, _ in batch]
+            uris = [u for _, u, _ in batch]
             try:
                 x = self._collate(batch)
                 y = self.model.predict(x)
                 outs = self._postprocess(y)
-                self._sink_q.put([(u, {"value": o}) for u, o in zip(uris, outs)])
+                self._sink_q.put([(i, u, {"value": o})
+                                  for i, u, o in zip(ids, uris, outs)])
             except Exception as e:  # one bad record must not kill the job
                 logger.exception("inference batch failed")
-                self._sink_q.put([(u, {"error": str(e)}) for u in uris])
+                self._sink_q.put([(i, u, {"error": str(e)})
+                                  for i, u in zip(ids, uris)])
             finally:
                 with self._inflight_lock:
                     self._inflight -= 1
@@ -169,18 +172,34 @@ class ClusterServing:
                 if self._stop.is_set():
                     break
                 continue
-            for uri, value in results:
+            done_ids = []
+            for entry_id, uri, value in results:
                 while True:
                     try:
-                        conn.call("HSET", RESULT_PREFIX + uri,
-                                  encode_payload(value))
+                        if uri is not None:
+                            conn.call("HSET", RESULT_PREFIX + uri,
+                                      encode_payload(value))
                         self.served += 1
+                        done_ids.append(entry_id)
                         break
                     except (OSError, ConnectionError):
                         conn.close()
                         conn = self._connect()
                         if conn is None:  # stopping and broker gone: give up
                             return
+            # results are durably written: release the broker's pending
+            # entries (Redis XACK after the sink commits — at-least-once).
+            # Retried across reconnects like HSET: a dropped ack would leave
+            # the entries pending forever and redeliver them on every restart
+            while done_ids:
+                try:
+                    conn.call("XACK", INPUT_STREAM, self.group, done_ids)
+                    done_ids = []
+                except (OSError, ConnectionError):
+                    conn.close()
+                    conn = self._connect()
+                    if conn is None:
+                        return
         if conn is not None:
             conn.close()
 

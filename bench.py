@@ -371,6 +371,11 @@ if __name__ == "__main__":
     except Exception as e:  # additive entry; never break the main line
         print(f"[bench] implicit recipe failed: {e}", file=sys.stderr)
         implicit = None
+        if ref_procs[1] is not None and ref_procs[1].poll() is None:
+            # don't leave the reference subprocess competing for host CPU
+            # with the transformer-MFU run below
+            ref_procs[1].kill()
+            ref_procs[1].wait()
 
     try:
         tlm = run_transformer_mfu() if on_accel else None

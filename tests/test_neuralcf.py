@@ -114,13 +114,18 @@ def test_implicit_ncf_beats_random_ranking(zoo_ctx):
     n_users, n_items = 300, 200
     pairs, _ = synthetic_movielens(30_000, n_users=n_users, n_items=n_items)
     ev = leave_one_out_eval_sets(pairs, n_items, n_negatives=99, max_users=200)
+    # leave-one-out means LEAVE OUT: drop every held-out (user, positive) pair
+    # from training so HR@10 measures ranking generalization, not memorization
+    held = {(int(u), int(i)) for u, i in ev[:, 0]}
+    mask = np.array([(int(u), int(i)) not in held for u, i in pairs])
+    train = pairs[mask]
     model = ImplicitNCF(user_count=n_users, item_count=n_items, n_negatives=4,
                         user_embed=8, item_embed=8, hidden_layers=(16, 8),
                         mf_embed=8)
     est = Estimator(model, optimizer=Adam(lr=5e-3), loss=implicit_bce_loss,
                     mesh=zoo_ctx.mesh,
                     config=TrainConfig(log_every_n_steps=10**9))
-    est.fit((pairs, np.zeros(len(pairs), "float32")), batch_size=2048, epochs=8)
+    est.fit((train, np.zeros(len(train), "float32")), batch_size=2048, epochs=8)
 
     flat = ev.reshape(-1, 2).astype("int32")
     score = np.asarray(est.predict(flat, batch_size=4096)).reshape(

@@ -1,0 +1,170 @@
+"""Broker durability + recovery (VERDICT r2 item 9).
+
+Parity targets: the reference persists serving state in Redis and recovers the
+Flink consumer-group cursor after restarts (FlinkRedisSource.scala:44-59);
+``scripts/cluster-serving/cluster-serving-restart`` bounces the service.
+Here: append-only-file persistence, SIGKILL the broker process mid-stream,
+restart with the same log, and verify no acknowledged request is lost and
+delivered-but-unacked entries are re-delivered.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving import (ClusterServing, InputQueue, OutputQueue,
+                                       ServingConfig)
+from analytics_zoo_tpu.serving.client import INPUT_STREAM, RESULT_PREFIX, _Conn
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_broker(port: int, aof: str) -> subprocess.Popen:
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "analytics_zoo_tpu.serving.broker",
+         "--host", "127.0.0.1", "--port", str(port), "--aof", aof],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            c = _Conn("127.0.0.1", port, timeout=2.0)
+            assert c.call("PING") == "PONG"
+            c.close()
+            return proc
+        except (OSError, ConnectionError):
+            if proc.poll() is not None:
+                raise RuntimeError(f"broker died: {proc.stdout.read()}")
+            time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError("broker did not come up")
+
+
+def test_aof_recovery_acked_survive_and_inflight_redelivered(tmp_path):
+    """Protocol-level crash drill: SIGKILL the broker between delivery and ack,
+    restart on the same log — acked results survive, in-flight re-deliver, and
+    nothing enqueued is lost."""
+    aof = str(tmp_path / "serving.aof")
+    port = _free_port()
+    proc = _spawn_broker(port, aof)
+    try:
+        c = _Conn("127.0.0.1", port)
+        c.call("XGROUPCREATE", INPUT_STREAM, "g", "0")
+        ids = [c.call("XADD", INPUT_STREAM, {"uri": f"r{i}", "v": i})
+               for i in range(10)]
+        assert len(set(ids)) == 10
+        # deliver 4, write + ack results for 2 of them
+        got = c.call("XREADGROUP", INPUT_STREAM, "g", 4, 1000)
+        assert [p["uri"] for _, p in got] == ["r0", "r1", "r2", "r3"]
+        for _id, p in got[:2]:
+            c.call("HSET", RESULT_PREFIX + p["uri"], {"ok": p["v"]})
+        c.call("XACK", INPUT_STREAM, "g", [got[0][0], got[1][0]])
+        c.close()
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+    proc = _spawn_broker(port, aof)   # restart on the same log
+    try:
+        c = _Conn("127.0.0.1", port)
+        # acked results survived the kill
+        assert c.call("HGET", RESULT_PREFIX + "r0", 0) == {"ok": 0}
+        assert c.call("HGET", RESULT_PREFIX + "r1", 0) == {"ok": 1}
+        # delivered-but-unacked (r2, r3) come back FIRST, then the rest;
+        # every non-acked record is seen exactly once
+        got = c.call("XREADGROUP", INPUT_STREAM, "g", 100, 1000)
+        uris = [p["uri"] for _, p in got]
+        assert uris == [f"r{i}" for i in range(2, 10)], uris
+        # nothing further pending
+        assert c.call("XREADGROUP", INPUT_STREAM, "g", 100, 10) == []
+        c.close()
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+
+def test_cli_start_status_restart_stop(tmp_path):
+    from analytics_zoo_tpu.serving import cli
+
+    aof = str(tmp_path / "cli.aof")
+    port = _free_port()
+    argv = ["--host", "127.0.0.1", "--port", str(port), "--aof", aof]
+    assert cli.main(["status"] + argv) == 3        # down
+    assert cli.main(["start"] + argv) == 0
+    try:
+        assert cli.main(["status"] + argv) == 0    # up
+        c = _Conn("127.0.0.1", port)
+        c.call("HSET", "k", {"v": 42})
+        c.close()
+        assert cli.main(["restart"] + argv) == 0   # graceful bounce
+        c = _Conn("127.0.0.1", port)
+        assert c.call("HGET", "k", 0) == {"v": 42}  # state crossed the restart
+        c.close()
+    finally:
+        assert cli.main(["stop"] + argv) == 0
+    assert cli.main(["status"] + argv) == 3
+
+
+@pytest.mark.slow
+def test_engine_kill_broker_midstream_no_acked_request_lost(zoo_ctx, tmp_path):
+    """End-to-end: a live ClusterServing engine, broker SIGKILLed while
+    requests are in flight, broker restarted on the same port+log. The engine
+    reconnects, recovered requests are served; every enqueued request ends
+    with a result (VERDICT item 9 'done' bar)."""
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+
+    model = Sequential([L.Dense(16, activation="relu", input_shape=(8,)),
+                        L.Dense(4, activation="softmax")])
+    model.compile(optimizer="adam", loss="categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    model.fit(x, y, batch_size=16, nb_epoch=1)
+
+    aof = str(tmp_path / "e2e.aof")
+    port = _free_port()
+    proc = _spawn_broker(port, aof)
+    cfg = ServingConfig(batch_size=4, concurrent_num=1, queue_port=port,
+                        batch_timeout_ms=50)
+    serving = ClusterServing(model, config=cfg).start()
+    try:
+        iq = InputQueue(port=port)
+        uris = [f"req-{i}" for i in range(12)]
+        for i, uri in enumerate(uris[:6]):
+            iq.enqueue(uri, t=x[i])
+        time.sleep(0.3)                       # some are mid-pipeline
+        proc.send_signal(signal.SIGKILL)      # broker dies with work queued
+        proc.wait()
+        iq.close()
+        proc = _spawn_broker(port, aof)       # same port + log: engine reconnects
+        iq = InputQueue(port=port)
+        for i, uri in enumerate(uris[6:], start=6):
+            iq.enqueue(uri, t=x[i])
+        oq = OutputQueue(port=port)
+        deadline = time.time() + 60
+        results = {}
+        while len(results) < len(uris) and time.time() < deadline:
+            for uri in uris:
+                if uri not in results:
+                    try:
+                        results[uri] = oq.query(uri, timeout_s=0.5)
+                    except TimeoutError:
+                        continue
+        missing = sorted(set(uris) - set(results))
+        assert not missing, f"requests lost across broker crash: {missing}"
+        iq.close()
+        oq.close()
+    finally:
+        serving.stop()
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()

@@ -196,3 +196,44 @@ def test_partial_weight_donation(zoo_ctx):
         np.asarray(trained[src.slot(src.layers[0])]["kernel"]), atol=1e-6)
     # the new head exists with a fresh init
     assert got[dst.slot(dst.layers[1])]["kernel"].shape == (8, 3)
+
+
+def test_recalibrate_batchnorm_closes_train_eval_gap(zoo_ctx):
+    """Short trainings leave the 0.99-EMA BatchNorm stats behind the final
+    weights; Estimator.recalibrate_batchnorm (update_bn analog) re-estimates
+    them so eval-mode forward matches train-mode statistics."""
+    import jax
+
+    from analytics_zoo_tpu.nn import Input, Model
+    from analytics_zoo_tpu.nn import layers as L
+
+    inp = Input((12,))
+    h = L.Dense(32, activation="relu")(inp)
+    h = L.BatchNormalization()(h)
+    h = L.Dropout(0.3)(h)
+    out = L.Dense(2)(h)
+    net = Model(inp, out)
+    net.compile(optimizer="adam", loss="mse")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((256, 12)).astype("float32") * 3.0
+    y = rng.standard_normal((256, 2)).astype("float32")
+    # labeled FeatureSet-style tuple input must not leak targets into apply
+    net.fit(x, y, batch_size=64, nb_epoch=40)
+    est = net.estimator
+
+    def gap():
+        params = jax.device_get(est.train_state["params"])
+        mstate = jax.device_get(est.train_state["model_state"])
+        ev, _ = net.apply(params, mstate, x[:32], training=False)
+        tr, _ = net.apply(params, mstate, x[:32], training=True,
+                          rng=jax.random.PRNGKey(0))
+        return float(np.abs(np.asarray(ev) - np.asarray(tr)).max())
+
+    before = gap()
+    est.recalibrate_batchnorm((x, y), batch_size=64)   # (x, y) tuple accepted
+    after = gap()
+    assert after <= before + 1e-6
+    # dropout rate and BN momentum restored after the pass
+    drop = [l for l in net.layers if isinstance(l, L.Dropout)][0]
+    bn = [l for l in net.layers if isinstance(l, L.BatchNormalization)][0]
+    assert drop.rate == 0.3 and bn.momentum == 0.99
