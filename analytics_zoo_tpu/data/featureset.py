@@ -25,7 +25,7 @@ from __future__ import annotations
 import math
 import os
 import tempfile
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -69,11 +69,13 @@ class FeatureSet:
 
     def __init__(self, data: ArrayTree, memory_type: str = MemoryType.DRAM,
                  cache_dir: Optional[str] = None, process_index: int = 0,
-                 process_count: int = 1, seed: int = 0):
+                 process_count: int = 1, seed: int = 0,
+                 host_shard: bool = False):
         self.memory_type = memory_type
         self.process_index = process_index
         self.process_count = process_count
         self.seed = seed
+        self.host_shard = host_shard
         leaves = _tree_leaves(data)
         if not leaves:
             raise ValueError("empty FeatureSet")
@@ -108,6 +110,26 @@ class FeatureSet:
         return cls(shards.collect_tree(), **kw)
 
     @classmethod
+    def from_host_shard(cls, data: ArrayTree, process_index: Optional[int] = None,
+                        process_count: Optional[int] = None,
+                        **kw) -> "FeatureSet":
+        """Multi-host sharded ingest: ``data`` is THIS host's slice only (e.g.
+        from ``XShards.host_split`` over per-host files) — no host ever
+        materializes the global dataset. ``batches`` then yields the local
+        ``batch/process_count`` rows per global step; shards should be
+        balanced (±1 batch) so hosts stay in lockstep. Defaults ranks from
+        ``jax.distributed`` (process_index/process_count)."""
+        if process_index is None or process_count is None:
+            import jax
+
+            process_index = jax.process_index() if process_index is None \
+                else process_index
+            process_count = jax.process_count() if process_count is None \
+                else process_count
+        return cls(data, process_index=process_index,
+                   process_count=process_count, host_shard=True, **kw)
+
+    @classmethod
     def from_tf_dataset(cls, dataset, max_elements: Optional[int] = None,
                         **kw) -> "FeatureSet":
         """Materialize a ``tf.data.Dataset`` into a FeatureSet (TFDataset
@@ -135,6 +157,43 @@ class FeatureSet:
         else:
             tree = np.stack(rows)
         return cls(tree, **kw)
+
+    @classmethod
+    def from_generator(cls, generator, max_elements: Optional[int] = None,
+                       **kw) -> "FeatureSet":
+        """Materialize a python generator/iterable of per-example elements
+        (the TFDataset py-func variants — TFFeatureDataset/TFTextDataset,
+        tf_dataset.py:661-1131 — where user python code produces examples).
+        Elements may be arrays, (x, y) tuples, or dicts of arrays."""
+        import itertools
+
+        it = iter(generator() if callable(generator) else generator)
+        if max_elements is not None:
+            it = itertools.islice(it, max_elements)
+        rows = list(it)
+        if not rows:
+            raise ValueError("generator yielded no elements")
+        first = rows[0]
+        if isinstance(first, dict):
+            tree = {k: np.stack([np.asarray(r[k]) for r in rows])
+                    for k in first}
+        elif isinstance(first, (tuple, list)):
+            tree = tuple(np.stack([np.asarray(r[i]) for r in rows])
+                         for i in range(len(first)))
+        else:
+            tree = np.stack([np.asarray(r) for r in rows])
+        return cls(tree, **kw)
+
+    @classmethod
+    def from_bytes(cls, records: Sequence[bytes], decoder: Callable,
+                   **kw) -> "BytesFeatureSet":
+        """Raw byte-record stream with decode-at-batch-time (``TFBytesDataset``
+        parity, tf_dataset.py:661 — the reference feeds undecoded records to a
+        TF decode graph per batch). ``decoder(record: bytes)`` returns the
+        per-example array tree; only the records of the current batch are ever
+        decoded, so memory stays at raw-record size (e.g. JPEG bytes, not
+        pixel tensors)."""
+        return BytesFeatureSet(records, decoder, **kw)
 
     @classmethod
     def from_tfrecord(cls, paths, feature_cols: Optional[Sequence[str]] = None,
@@ -216,6 +275,12 @@ class FeatureSet:
         return rng.permutation(self._n_total)
 
     def num_batches(self, batch_size: int, drop_remainder: bool = True) -> int:
+        if self.host_shard:
+            # _n_total is LOCAL rows here; balanced shards keep hosts in lockstep
+            local_bs = batch_size // self.process_count
+            if drop_remainder:
+                return self._n_total // local_bs
+            return math.ceil(self._n_total / local_bs)
         if drop_remainder:
             return self._n_total // batch_size
         return math.ceil(self._n_total / batch_size)
@@ -230,6 +295,19 @@ class FeatureSet:
         if batch_size % self.process_count:
             raise ValueError(
                 f"global batch {batch_size} not divisible by {self.process_count} hosts")
+        if self.host_shard:
+            # data is already THIS host's shard (FeatureSet.from_host_shard):
+            # every host walks its local permutation in lockstep, yielding
+            # batch_size/process_count rows per global step
+            local_bs = batch_size // self.process_count
+            idx = self.shuffle_indices(epoch) if shuffle \
+                else np.arange(self._n_total)
+            for b in range(self.num_batches(batch_size, drop_remainder)):
+                sel = idx[b * local_bs:(b + 1) * local_bs]
+                if len(sel) == 0:
+                    continue
+                yield _tree_map(lambda a: self._gather(a, sel), self.data)
+            return
         idx = self.shuffle_indices(epoch) if shuffle else np.arange(self._n_total)
         nb = self.num_batches(batch_size, drop_remainder)
         for b in range(nb):
@@ -305,3 +383,32 @@ def device_prefetch(batch_iter: Iterator[ArrayTree], sharding=None, depth: int =
             yield buf.pop(0)
     while buf:
         yield buf.pop(0)
+
+
+class BytesFeatureSet(FeatureSet):
+    """Raw byte records + a per-record decoder, decoded at batch time only
+    (``TFBytesDataset`` capability — tf_dataset.py:661). The stored tier is an
+    object ndarray of ``bytes``; every FeatureSet facility (deterministic
+    shuffle, multi-host strided sharding, epoch slicing of the RAW records)
+    applies unchanged, and ``batches`` decodes just the gathered records."""
+
+    def __init__(self, records: Sequence[bytes], decoder: Callable, **kw):
+        arr = np.empty(len(records), dtype=object)
+        arr[:] = list(records)
+        kw.pop("memory_type", None)   # raw-object tier is DRAM by definition
+        super().__init__((arr,), **kw)
+        self.decoder = decoder
+
+    def batches(self, batch_size: int, *, epoch: int = 0, shuffle: bool = True,
+                drop_remainder: bool = True) -> Iterator[ArrayTree]:
+        for (raw,) in super().batches(batch_size, epoch=epoch, shuffle=shuffle,
+                                      drop_remainder=drop_remainder):
+            rows = [self.decoder(r) for r in raw]
+            first = rows[0]
+            if isinstance(first, dict):
+                yield {k: np.stack([r[k] for r in rows]) for k in first}
+            elif isinstance(first, (tuple, list)):
+                yield tuple(np.stack([r[i] for r in rows])
+                            for i in range(len(first)))
+            else:
+                yield (np.stack(rows),)

@@ -25,10 +25,17 @@ def _split_df(df, num_partitions: int) -> List[Any]:
 
 class XShards:
     """A list of partitions, each an arbitrary python object (dict of ndarrays,
-    pandas DataFrame, ...)."""
+    pandas DataFrame, ...).
 
-    def __init__(self, partitions: Sequence[Any]):
+    Transforms can be **lazy** (``transform_shard(fn, lazy=True)`` records the
+    fn; the chain runs on first materialization — SparkXShards' deferred DAG
+    semantics) and **parallel** (``parallel_apply`` fans partitions out over an
+    ``orca.TaskPool`` of worker processes — the Spark-executor role)."""
+
+    def __init__(self, partitions: Sequence[Any],
+                 pending: Sequence[Callable] = ()):
         self._parts: List[Any] = list(partitions)
+        self._pending: List[Callable] = list(pending)
 
     # ------------------------------------------------------------ constructors
     @classmethod
@@ -71,12 +78,51 @@ class XShards:
         return cls(_split_df(df, num_partitions))
 
     # ------------------------------------------------------------------ ops
-    def transform_shard(self, fn: Callable, *args) -> "XShards":
-        """Apply ``fn`` to every partition (shard.py ``transform_shard`` parity)."""
-        return XShards([fn(p, *args) for p in self._parts])
+    def transform_shard(self, fn: Callable, *args,
+                        lazy: bool = False) -> "XShards":
+        """Apply ``fn`` to every partition (shard.py ``transform_shard``
+        parity). ``lazy=True`` defers execution until materialization
+        (collect/len/conversion) so chained transforms traverse each
+        partition once."""
+        if lazy:
+            return XShards(self._parts,
+                           pending=self._pending + [lambda p: fn(p, *args)])
+        return XShards([fn(self._materialize_one(p), *args)
+                        for p in self._parts])
+
+    def parallel_apply(self, fn: Callable, *args, num_workers: int = 4,
+                       pool=None) -> "XShards":
+        """Apply ``fn`` to partitions in parallel worker PROCESSES (the role
+        Spark executors play for SparkXShards). Any pending lazy chain runs
+        inside the workers too. Pass ``pool`` to reuse a live
+        ``orca.TaskPool``; otherwise a temporary one is spawned."""
+        from ..orca.task_pool import TaskPool
+
+        chain = list(self._pending)
+
+        def run(part):
+            for g in chain:
+                part = g(part)
+            return fn(part, *args)
+
+        if pool is not None:
+            return XShards(pool.map(run, self._parts))
+        with TaskPool(min(num_workers, max(1, len(self._parts)))) as p:
+            return XShards(p.map(run, self._parts))
+
+    def _materialize_one(self, part):
+        for g in self._pending:
+            part = g(part)
+        return part
+
+    def cache(self) -> "XShards":
+        """Run any pending lazy chain now, in place (persist() analog)."""
+        self._parts = [self._materialize_one(p) for p in self._parts]
+        self._pending = []
+        return self
 
     def collect(self) -> List[Any]:
-        return list(self._parts)
+        return [self._materialize_one(p) for p in self._parts]
 
     def num_partitions(self) -> int:
         return len(self._parts)
@@ -86,24 +132,34 @@ class XShards:
         return XShards.partition(flat, num_partitions)
 
     def __len__(self) -> int:
-        first = self._parts[0]
+        parts = self.collect()
+        first = parts[0]
         if isinstance(first, dict):
             k = next(iter(first))
-            return sum(len(p[k]) for p in self._parts)
-        return sum(len(p) for p in self._parts)
+            return sum(len(p[k]) for p in parts)
+        return sum(len(p) for p in parts)
 
     # -------------------------------------------------------------- conversion
     def collect_tree(self):
         """Concatenate partitions into one array tree (feeds FeatureSet)."""
-        first = self._parts[0]
+        parts = self.collect()
+        first = parts[0]
         if isinstance(first, dict):
-            return {k: np.concatenate([np.asarray(p[k]) for p in self._parts])
+            return {k: np.concatenate([np.asarray(p[k]) for p in parts])
                     for k in first}
         if hasattr(first, "values") and hasattr(first, "columns"):  # DataFrame
             import pandas as pd
 
-            return pd.concat(self._parts, ignore_index=True)
-        return np.concatenate([np.asarray(p) for p in self._parts])
+            return pd.concat(parts, ignore_index=True)
+        return np.concatenate([np.asarray(p) for p in parts])
+
+    def host_split(self, process_index: int, process_count: int) -> "XShards":
+        """This host's partitions of a multi-host job (partition i belongs to
+        host ``i % process_count`` — Spark partition placement analog). Feed
+        the result to ``FeatureSet.from_host_shard`` so each host ingests only
+        its own slice instead of materializing the global dataset."""
+        return XShards(self._parts[process_index::process_count],
+                       pending=self._pending)
 
     def to_featureset(self, feature_cols: Optional[Sequence[str]] = None,
                       label_cols: Optional[Sequence[str]] = None, **kw):

@@ -324,7 +324,10 @@ class Estimator:
         cfg = self.config
         if (cfg.cache_on_device
                 and get_zoo_context().process_count == 1
-                and train_set.memory_type == "DRAM"):
+                and train_set.memory_type == "DRAM"
+                # byte-record tiers decode at batch time: raw object arrays
+                # can't live in HBM
+                and getattr(train_set, "decoder", None) is None):
             return self._run_epoch_cached(train_set, batch_size,
                                           checkpoint_trigger)
         ts = self.trainer_state
@@ -565,13 +568,23 @@ class Estimator:
                if isinstance(l, BatchNormalization)]
         if not bns:
             return self
-        noisy = [l for l in _walk_layers(self.model)
-                 if hasattr(l, "rate") and getattr(l, "rate", 0)]
-        saved = [(l, l.momentum) for l in bns] + [(l, l.rate) for l in noisy]
+        from ..nn.layers.advanced_activations import _SpatialDropout
+        from ..nn.layers.core import Dropout, GaussianDropout, GaussianNoise
+
+        # exact class match, NOT hasattr(l, "rate"): atrous convs store their
+        # dilation in .rate and zeroing it would break the traced forward
+        noisy = []
+        for l in _walk_layers(self.model):
+            if isinstance(l, (Dropout, GaussianDropout, _SpatialDropout)):
+                noisy.append((l, "rate"))
+            elif isinstance(l, GaussianNoise):
+                noisy.append((l, "sigma"))
+        saved = ([(l, "momentum", l.momentum) for l in bns]
+                 + [(l, attr, getattr(l, attr)) for l, attr in noisy])
         for l in bns:
             l.momentum = float(momentum)
-        for l in noisy:
-            l.rate = 0.0
+        for l, attr in noisy:
+            setattr(l, attr, 0.0)
         try:
             model = self.model
             # fresh trace every call: momentum/rate are captured at trace time
@@ -592,11 +605,8 @@ class Estimator:
                     mstate = fwd(self.train_state["params"], mstate, xb)
             self.train_state["model_state"] = mstate
         finally:
-            for l, v in saved:
-                if isinstance(l, BatchNormalization):
-                    l.momentum = v
-                else:
-                    l.rate = v
+            for l, attr, v in saved:
+                setattr(l, attr, v)
         return self
 
     # ------------------------------------------------------------- summaries

@@ -3,5 +3,7 @@
 
 from ..data.xshards import XShards
 from .learn.estimator import Estimator
+from .task_pool import ActorHandle, Future, TaskPool, pool_rank, pool_world
 
-__all__ = ["Estimator", "XShards"]
+__all__ = ["ActorHandle", "Estimator", "Future", "TaskPool", "XShards",
+           "pool_rank", "pool_world"]

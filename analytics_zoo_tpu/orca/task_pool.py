@@ -1,0 +1,267 @@
+"""Task-parallel runner — the RayOnSpark *non-training* half.
+
+The reference can run arbitrary distributed Python inside its cluster: Ray
+tasks and actors bootstrapped by RayOnSpark (raycontext.py:190), used for the
+async parameter server (pyzoo/zoo/examples/ray/parameter_server/
+async_parameter_server.py) and RL rollouts (examples/ray/rl_pong/rl_pong.py).
+
+TPU-native redesign: training-style SPMD jobs go through ``ClusterLauncher``
+(common/cluster.py); *task-parallel* workloads (rollout workers, parameter
+servers, hyperparameter eval, data prep) use this pool — N spawned worker
+processes executing cloudpickled callables, plus Ray-style **actors**: a class
+instantiated inside one dedicated worker where it keeps state; method calls
+are serialized per actor and return futures.
+
+    pool = TaskPool(4)
+    futs = [pool.submit(lambda x=i: x * x) for i in range(8)]
+    [f.result() for f in futs]
+
+    ps = pool.actor(ParameterServer, init_weights)      # lives in worker 0
+    w = ps.call("get_weights").result()
+    ps.call("apply_gradients", grads)
+
+Host spanning: each host of a ``ClusterLauncher`` job can run its own pool;
+``pool_rank()`` / ``pool_world()`` expose the launcher's ``ZOO_TPU_PROCESS_ID``
+/ ``ZOO_TPU_NUM_PROCESSES`` env so one script can shard work across hosts the
+way Ray placement groups spread actors.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import multiprocessing as mp
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import cloudpickle
+
+
+def pool_rank() -> int:
+    """This host's rank in a ClusterLauncher job (0 standalone)."""
+    return int(os.environ.get("ZOO_TPU_PROCESS_ID", "0"))
+
+
+def pool_world() -> int:
+    """Number of hosts in the ClusterLauncher job (1 standalone)."""
+    return int(os.environ.get("ZOO_TPU_NUM_PROCESSES", "1"))
+
+
+def _worker_main(inbox, outbox, init_blob):
+    """Worker loop: run tasks / host actors. Always forces the CPU backend —
+    task workers must never grab the TPU from the driver."""
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    if init_blob is not None:
+        cloudpickle.loads(init_blob)()
+    actors: Dict[int, Any] = {}
+    while True:
+        msg = inbox.get()
+        if msg is None:
+            return
+        kind, tid = msg[0], msg[1]
+        try:
+            if kind == "task":
+                fn, args, kw = cloudpickle.loads(msg[2])
+                result = fn(*args, **kw)
+            elif kind == "actor_new":
+                cls, args, kw = cloudpickle.loads(msg[2])
+                actors[msg[3]] = cls(*args, **kw)
+                result = True
+            elif kind == "actor_call":
+                method, args, kw = cloudpickle.loads(msg[3])
+                result = getattr(actors[msg[2]], method)(*args, **kw)
+            elif kind == "actor_del":
+                actors.pop(msg[2], None)
+                result = True
+            else:
+                raise ValueError(f"unknown message {kind!r}")
+            outbox.put((tid, True, cloudpickle.dumps(result)))
+        except BaseException as e:  # report, keep serving
+            outbox.put((tid, False, cloudpickle.dumps(
+                RuntimeError(f"{type(e).__name__}: {e}"))))
+
+
+class Future:
+    """Result handle; ``result(timeout)`` blocks and re-raises task errors."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._ok = None
+        self._val = None
+
+    def _set(self, ok: bool, val: Any):
+        self._ok, self._val = ok, val
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("task did not complete in time")
+        if not self._ok:
+            raise self._val
+        return self._val
+
+
+class ActorHandle:
+    """Proxy to a class instance living inside one worker process. Calls on
+    the same actor execute in submission order (its worker inbox is FIFO)."""
+
+    def __init__(self, pool: "TaskPool", actor_id: int, worker: int):
+        self._pool = pool
+        self.actor_id = actor_id
+        self.worker = worker
+
+    def call(self, method: str, *args, **kw) -> Future:
+        return self._pool._send(
+            self.worker, "actor_call", self.actor_id,
+            cloudpickle.dumps((method, args, kw)))
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda *a, **kw: self.call(name, *a, **kw)
+
+    def terminate(self):
+        self._pool._send(self.worker, "actor_del", self.actor_id)
+
+
+class TaskPool:
+    """N spawned worker processes executing tasks and hosting actors.
+
+    ``worker_init``: optional zero-arg callable run once in each worker (env
+    setup, warmup). Workers are spawn-context processes — no inherited JAX
+    state, CPU backend forced.
+    """
+
+    def __init__(self, num_workers: int = 4,
+                 worker_init: Optional[Callable[[], None]] = None):
+        import sys
+
+        ctx = mp.get_context("spawn")
+        self.num_workers = int(num_workers)
+        self._inboxes = [ctx.Queue() for _ in range(self.num_workers)]
+        self._outbox = ctx.Queue()
+        init_blob = cloudpickle.dumps(worker_init) if worker_init else None
+        self._procs = [
+            ctx.Process(target=_worker_main, daemon=True,
+                        args=(self._inboxes[i], self._outbox, init_blob))
+            for i in range(self.num_workers)]
+        # spawn re-runs __main__ from its __file__ in every child; when the
+        # driver is stdin/REPL ('<stdin>') that file doesn't exist and every
+        # worker dies at startup (hanging all futures). Drop the bogus
+        # attribute around start() — cloudpickle serializes __main__
+        # callables by value, so workers never need the real script anyway.
+        main_mod = sys.modules.get("__main__")
+        main_file = getattr(main_mod, "__file__", None)
+        strip = main_file is not None and not os.path.exists(main_file)
+        if strip:
+            del main_mod.__file__
+        try:
+            for p in self._procs:
+                p.start()
+        finally:
+            if strip:
+                main_mod.__file__ = main_file
+        self._futures: Dict[int, Future] = {}
+        self._flock = threading.Lock()
+        self._tid = itertools.count()
+        self._aid = itertools.count()
+        self._rr = itertools.count()
+        self._closed = False
+        self._broken: Optional[str] = None
+        self._collector = threading.Thread(target=self._collect, daemon=True)
+        self._collector.start()
+        self._watchdog = threading.Thread(target=self._watch, daemon=True)
+        self._watchdog.start()
+
+    # ------------------------------------------------------------ internals
+    def _collect(self):
+        while True:
+            msg = self._outbox.get()
+            if msg is None:
+                return
+            tid, ok, blob = msg
+            with self._flock:
+                fut = self._futures.pop(tid, None)
+            if fut is not None:
+                fut._set(ok, cloudpickle.loads(blob))
+
+    def _watch(self):
+        """Fail every outstanding future if a worker dies unexpectedly (OOM
+        kill, segfault) — otherwise map()/result() would block forever on a
+        message that can never arrive."""
+        import time
+
+        while not self._closed:
+            for p in self._procs:
+                if not p.is_alive() and not self._closed:
+                    self._broken = (f"task pool worker pid={p.pid} died "
+                                    f"(exitcode {p.exitcode})")
+                    with self._flock:
+                        futs = list(self._futures.values())
+                        self._futures.clear()
+                    for f in futs:
+                        f._set(False, RuntimeError(self._broken))
+                    return
+            time.sleep(0.2)
+
+    def _send(self, worker: int, kind: str, *payload) -> Future:
+        if self._closed:
+            raise RuntimeError("pool is shut down")
+        if self._broken:
+            raise RuntimeError(self._broken)
+        tid = next(self._tid)
+        fut = Future()
+        with self._flock:
+            self._futures[tid] = fut
+        self._inboxes[worker].put((kind, tid, *payload))
+        return fut
+
+    # -------------------------------------------------------------- tasks
+    def submit(self, fn: Callable, *args, **kw) -> Future:
+        """Run ``fn(*args, **kw)`` on the least-recently-used worker."""
+        worker = next(self._rr) % self.num_workers
+        return self._send(worker, "task", cloudpickle.dumps((fn, args, kw)))
+
+    def map(self, fn: Callable, items: Sequence[Any]) -> List[Any]:
+        """Parallel map; blocks for all results (ordered)."""
+        futs = [self.submit(fn, it) for it in items]
+        return [f.result() for f in futs]
+
+    # -------------------------------------------------------------- actors
+    def actor(self, cls: type, *args, worker: Optional[int] = None,
+              **kw) -> ActorHandle:
+        """Instantiate ``cls`` inside one worker; returns a handle whose
+        method calls are futures (Ray ``@ray.remote`` class parity)."""
+        aid = next(self._aid)
+        worker = (next(self._rr) % self.num_workers) if worker is None \
+            else worker % self.num_workers
+        self._send(worker, "actor_new", cloudpickle.dumps((cls, args, kw)),
+                   aid).result(timeout=120)
+        return ActorHandle(self, aid, worker)
+
+    # ------------------------------------------------------------- control
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for q in self._inboxes:
+            q.put(None)
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self._outbox.put(None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

@@ -50,7 +50,12 @@ def main():
     fs = FeatureSet.from_numpy(x, y)
     model.fit(fs, batch_size=16 if SMOKE else 256,
               nb_epoch=1 if SMOKE else 10)
-    print("eval:", model.evaluate(x[:32], y[:32], batch_size=16))
+    if SMOKE:
+        # the eval step is a second full XLA compile of the backbone — the CI
+        # smoke only needs to prove the train path runs
+        print("smoke loss:", model.estimator.trainer_state.last_loss)
+    else:
+        print("eval:", model.evaluate(x[:32], y[:32], batch_size=16))
 
 
 if __name__ == "__main__":

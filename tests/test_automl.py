@@ -95,6 +95,7 @@ def test_feature_transformer_predict_mode():
 
 
 # ------------------------------------------------------------------ models
+@pytest.mark.slow
 def test_vanilla_lstm_fit_predict(tmp_path):
     rng = np.random.default_rng(0)
     x = rng.standard_normal((64, 4, 3)).astype("float32")

@@ -18,7 +18,15 @@ from analytics_zoo_tpu.models.image.objectdetection import match_anchors
 SMALL = (32, 32, 3)
 
 
-@pytest.mark.parametrize("name", sorted(BACKBONES))
+# compile cost of the deep backbones dominates the suite on a 1-core box;
+# the smoke tier keeps the two cheapest as compile-coverage canaries
+_CHEAP_BACKBONES = {"alexnet", "squeezenet"}
+
+
+@pytest.mark.parametrize(
+    "name", [n if n in _CHEAP_BACKBONES else
+             pytest.param(n, marks=pytest.mark.slow)
+             for n in sorted(BACKBONES)])
 def test_backbone_builds_and_runs(name):
     model = build_backbone(name, input_shape=SMALL, num_classes=7)
     model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
@@ -28,6 +36,7 @@ def test_backbone_builds_and_runs(name):
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_image_classifier_fit_predict_save(tmp_path):
     rng = np.random.default_rng(0)
     x = rng.uniform(0, 255, (24,) + SMALL).astype("float32")
@@ -83,6 +92,7 @@ def test_nms_suppresses_overlaps():
     assert keep == [0, 2]
 
 
+@pytest.mark.slow
 def test_ssd_detector_learns_toy_box():
     """One bright square on black background; detector should localize it."""
     rng = np.random.default_rng(0)

@@ -82,6 +82,7 @@ def test_param_sharding_rules():
     assert rule((FakeKey("block0"), FakeKey("qkv_bias")), bias) == P()
 
 
+@pytest.mark.slow
 def test_transformer_lm_trains_on_multi_axis_mesh(zoo_ctx, monkeypatch):
     """The full dryrun path: dp/fsdp/tp/sp sharded train step executes and the
     loss decreases over steps. GRAFT_DRYRUN_CHILD keeps it in-process (the

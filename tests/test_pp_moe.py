@@ -57,6 +57,7 @@ def test_pipeline_matches_sequential(n_micro):
                                rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_differentiable():
     mesh = make_mesh(pp=4)
     d, hidden = 4, 8
@@ -180,6 +181,7 @@ def test_moe_capacity_drops_overflow_tokens():
     assert nonzero_rows <= 2
 
 
+@pytest.mark.slow
 def test_moe_differentiable():
     layer = MoE(hidden_size=8, n_experts=4, top_k=2)
     params, _ = layer.build(jax.random.PRNGKey(3), (None, 8))

@@ -17,6 +17,7 @@ def make_df(n=80):
     return pd.DataFrame({"datetime": dt, "value": value})
 
 
+@pytest.mark.slow
 def test_autots_trainer_end_to_end(tmp_path):
     df = make_df(60)
     trainer = AutoTSTrainer(horizon=1)
