@@ -276,6 +276,10 @@ class FeatureSet:
 
     def num_batches(self, batch_size: int, drop_remainder: bool = True) -> int:
         if self.host_shard:
+            if batch_size % self.process_count:
+                raise ValueError(
+                    f"global batch {batch_size} not divisible by "
+                    f"{self.process_count} hosts")
             # _n_total is LOCAL rows here; balanced shards keep hosts in lockstep
             local_bs = batch_size // self.process_count
             if drop_remainder:
@@ -334,10 +338,12 @@ class FeatureSet:
             return np.ascontiguousarray(a[sel[order]][inv])
         from ..native import gather_rows, native_available
 
-        # native path only for contiguous arrays — gather_rows would otherwise
-        # copy the WHOLE source to make it contiguous, once per batch
+        # native path only for contiguous non-object arrays: gather_rows would
+        # otherwise copy the WHOLE source once per batch — and for object
+        # dtypes it would memcpy PyObject pointers without increfs
+        # (use-after-free once the batch is collected)
         if (native_available() and a.nbytes >= (1 << 20)
-                and a.flags["C_CONTIGUOUS"]):
+                and a.flags["C_CONTIGUOUS"] and not a.dtype.hasobject):
             return gather_rows(a, sel)
         return np.ascontiguousarray(a[sel])
 
@@ -412,3 +418,25 @@ class BytesFeatureSet(FeatureSet):
                             for i in range(len(first)))
             else:
                 yield (np.stack(rows),)
+
+    def slices(self, num_slices: Optional[int] = None) -> List["FeatureSet"]:
+        """Sub-epoch slices of the RAW records — each slice keeps the decoder
+        (a plain-FeatureSet slice would yield undecoded object arrays)."""
+        k = num_slices or self.num_slices
+        per = math.ceil(self._n_total / k)
+        out = []
+        for i in range(k):
+            sl = slice(i * per, min((i + 1) * per, self._n_total))
+            out.append(BytesFeatureSet(
+                list(self.data[0][sl]), self.decoder,
+                process_index=self.process_index,
+                process_count=self.process_count,
+                seed=self.seed + 17 * (i + 1)))
+        return out
+
+    def transform(self, fn) -> "FeatureSet":
+        """Transform the raw record array; the decoder rides along."""
+        (arr,) = fn(self.data)
+        return BytesFeatureSet(list(arr), self.decoder,
+                               process_index=self.process_index,
+                               process_count=self.process_count, seed=self.seed)

@@ -122,7 +122,10 @@ class XShards:
         return self
 
     def collect(self) -> List[Any]:
-        return [self._materialize_one(p) for p in self._parts]
+        # materialize IN PLACE: a len()/collect() pair must not run the lazy
+        # chain over every partition twice
+        self.cache()
+        return list(self._parts)
 
     def num_partitions(self) -> int:
         return len(self._parts)

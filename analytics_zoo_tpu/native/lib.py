@@ -212,6 +212,14 @@ def gather_rows(src: np.ndarray, indices: np.ndarray,
     """``out[i] = src[indices[i]]`` over axis 0 — threaded memcpy when the
     native lib is available, ``src[indices]`` otherwise."""
     src = np.ascontiguousarray(src)
+    if src.dtype.hasobject:
+        # the C++ path memcpy's PyObject POINTERS without increfs — freeing
+        # the gathered array would then decref objects it never owned
+        res = src[np.asarray(indices, dtype=np.int64)]
+        if out is not None:
+            out[...] = res
+            return out
+        return res
     idx = np.ascontiguousarray(indices, dtype=np.int64)
     n_rows = len(src)
     # numpy semantics for negative indices; hard bounds check BEFORE the native

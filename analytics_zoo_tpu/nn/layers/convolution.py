@@ -54,11 +54,17 @@ class Convolution2D(Layer):
         return params, {}
 
     def apply(self, params, state, x, *, training=False, rng=None):
+        from ...ops.int8 import int8_conv2d, is_quantized
+
         x = as_compute(x)
-        kernel = jnp.asarray(params["kernel"], x.dtype)
-        y = jax.lax.conv_general_dilated(
-            x, kernel, window_strides=self.strides, padding=self.padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if is_quantized(params["kernel"]):
+            y = int8_conv2d(x, params["kernel"], strides=self.strides,
+                            padding=self.padding).astype(x.dtype)
+        else:
+            kernel = jnp.asarray(params["kernel"], x.dtype)
+            y = jax.lax.conv_general_dilated(
+                x, kernel, window_strides=self.strides, padding=self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.use_bias:
             y = y + jnp.asarray(params["bias"], x.dtype)
         return self.activation(y), state

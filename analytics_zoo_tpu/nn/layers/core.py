@@ -63,9 +63,16 @@ class Dense(Layer):
         return params, {}
 
     def apply(self, params, state, x, *, training=False, rng=None):
+        from ...ops.int8 import int8_matmul, is_quantized
+
         x = as_compute(x)
-        kernel = jnp.asarray(params["kernel"], x.dtype)
-        y = x @ kernel
+        if is_quantized(params["kernel"]):
+            # InferenceModel.quantize_int8 packed this kernel: int8 MXU matmul
+            # with dynamic activation quantization (ops/int8.py)
+            y = int8_matmul(x, params["kernel"]).astype(x.dtype)
+        else:
+            kernel = jnp.asarray(params["kernel"], x.dtype)
+            y = x @ kernel
         if self.use_bias:
             y = y + jnp.asarray(params["bias"], x.dtype)
         return self.activation(y), state

@@ -3,7 +3,8 @@
 
 from ..data.xshards import XShards
 from .learn.estimator import Estimator
+from .rl import CatchEnv, PPOTrainer
 from .task_pool import ActorHandle, Future, TaskPool, pool_rank, pool_world
 
-__all__ = ["ActorHandle", "Estimator", "Future", "TaskPool", "XShards",
-           "pool_rank", "pool_world"]
+__all__ = ["ActorHandle", "CatchEnv", "Estimator", "Future", "PPOTrainer",
+           "TaskPool", "XShards", "pool_rank", "pool_world"]

@@ -1,0 +1,234 @@
+"""RL trainers on the task pool — the RayOnSpark + RLlib workload.
+
+The reference hosts RLlib trainers (PPO/DQN on CartPole) on the Ray cluster it
+bootstraps inside Spark (`pyzoo/zoo/examples/ray/rllib/multiagent_two_trainers
+.py`); the zoo's own role is the cluster runtime, the trainer API comes from
+RLlib. Here both halves are native: rollout workers are ``TaskPool`` tasks
+and :class:`PPOTrainer` exposes the RLlib-style ``trainer.train() -> result``
+loop with a clipped-surrogate PPO update (JAX on the driver, numpy policy in
+the workers).
+
+    trainer = PPOTrainer(env_fn=CatchEnv, config={"num_workers": 4})
+    for _ in range(20):
+        result = trainer.train()
+        print(result["episode_reward_mean"])
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+DEFAULT_CONFIG: Dict[str, Any] = {
+    "num_workers": 2,            # rollout worker processes
+    "episodes_per_worker": 16,   # per train() round
+    "gamma": 0.99,
+    "lr": 3e-3,
+    "clip_param": 0.2,           # PPO clipped-surrogate epsilon
+    "num_sgd_iter": 4,
+    "hidden": 64,
+    "entropy_coeff": 0.01,
+    "seed": 0,
+}
+
+
+class CatchEnv:
+    """Minimal gym-like env: a ball falls down an H×W grid; the bottom paddle
+    moves left/stay/right; +1 for a catch, -1 for a miss. Episodes are H-1
+    steps — small enough for CI, structured like the classic control tasks the
+    reference's RLlib example trains on."""
+
+    H, W = 8, 8
+    n_actions = 3
+
+    def __init__(self, seed: int = 0):
+        self.rng = np.random.default_rng(seed)
+
+    @property
+    def obs_dim(self) -> int:
+        return self.H * self.W
+
+    def reset(self) -> np.ndarray:
+        self.ball = [0, int(self.rng.integers(0, self.W))]
+        self.paddle = self.W // 2
+        return self._obs()
+
+    def _obs(self) -> np.ndarray:
+        board = np.zeros((self.H, self.W), dtype="float32")
+        board[self.ball[0], self.ball[1]] = 1.0
+        board[self.H - 1, self.paddle] = -1.0
+        return board.ravel()
+
+    def step(self, action: int):
+        self.paddle = int(np.clip(self.paddle + (action - 1), 0, self.W - 1))
+        self.ball[0] += 1
+        done = self.ball[0] == self.H - 1
+        reward = (1.0 if self.ball[1] == self.paddle else -1.0) if done else 0.0
+        return self._obs(), reward, done, {}
+
+
+def _mlp_init(obs_dim: int, hidden: int, n_actions: int, seed: int):
+    rng = np.random.default_rng(seed)
+    s1 = np.sqrt(2.0 / obs_dim)
+    s2 = np.sqrt(2.0 / hidden)
+    return {
+        "w1": (rng.standard_normal((obs_dim, hidden)) * s1).astype("float32"),
+        "b1": np.zeros(hidden, "float32"),
+        "w2": (rng.standard_normal((hidden, n_actions)) * s2).astype("float32"),
+        "b2": np.zeros(n_actions, "float32"),
+        "vw": (rng.standard_normal((hidden, 1)) * s2).astype("float32"),
+        "vb": np.zeros(1, "float32"),
+    }
+
+
+def _np_forward(w, obs):
+    """Numpy policy+value forward for the rollout workers (no jit per round)."""
+    h = np.tanh(obs @ w["w1"] + w["b1"])
+    logits = h @ w["w2"] + w["b2"]
+    z = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = z / z.sum(axis=-1, keepdims=True)
+    value = (h @ w["vw"] + w["vb"])[..., 0]
+    return probs, value
+
+
+def collect_rollouts(weights, env_fn, n_episodes: int, gamma: float,
+                     seed: int):
+    """Task body: play episodes, return (obs, act, logp, returns, adv, rew)."""
+    obs_l: List[np.ndarray] = []
+    act_l: List[int] = []
+    logp_l: List[float] = []
+    ret_l: List[float] = []
+    adv_l: List[float] = []
+    ep_rewards: List[float] = []
+    for k in range(n_episodes):
+        env = env_fn(seed * 100_003 + k)
+        rng = np.random.default_rng(seed * 7919 + k)
+        obs = env.reset()
+        ep_obs, ep_act, ep_logp, ep_val, ep_rew = [], [], [], [], []
+        while True:
+            probs, value = _np_forward(weights, obs[None, :])
+            a = int(rng.choice(len(probs[0]), p=probs[0]))
+            ep_obs.append(obs)
+            ep_act.append(a)
+            ep_logp.append(float(np.log(probs[0, a] + 1e-9)))
+            ep_val.append(float(value[0]))
+            obs, r, done, _ = env.step(a)
+            ep_rew.append(float(r))
+            if done:
+                break
+        # discounted returns + advantages vs the value baseline
+        ret, g = [], 0.0
+        for r in reversed(ep_rew):
+            g = r + gamma * g
+            ret.append(g)
+        ret.reverse()
+        obs_l.extend(ep_obs)
+        act_l.extend(ep_act)
+        logp_l.extend(ep_logp)
+        ret_l.extend(ret)
+        adv_l.extend(np.asarray(ret) - np.asarray(ep_val))
+        ep_rewards.append(sum(ep_rew))
+    return (np.asarray(obs_l, "float32"), np.asarray(act_l, "int32"),
+            np.asarray(logp_l, "float32"), np.asarray(ret_l, "float32"),
+            np.asarray(adv_l, "float32"), float(np.mean(ep_rewards)))
+
+
+class PPOTrainer:
+    """RLlib-style trainer: ``train()`` runs one round of parallel rollouts +
+    clipped-surrogate PPO epochs and returns a result dict."""
+
+    def __init__(self, env_fn: Callable[[int], Any] = CatchEnv,
+                 config: Optional[Dict[str, Any]] = None, pool=None):
+        import jax
+
+        self.config = {**DEFAULT_CONFIG, **(config or {})}
+        self.env_fn = env_fn
+        probe = env_fn(0)
+        self.weights = _mlp_init(probe.obs_dim, self.config["hidden"],
+                                 probe.n_actions, self.config["seed"])
+        self._pool = pool
+        self._owns_pool = pool is None
+        self.iteration = 0
+        self._grad_fn = jax.jit(jax.grad(self._ppo_loss))
+        import optax
+
+        self._opt = optax.adam(self.config["lr"])
+        self._opt_state = self._opt.init(
+            {k: np.asarray(v) for k, v in self.weights.items()})
+
+    # -- loss (driver-side JAX) ----------------------------------------------
+    def _ppo_loss(self, w, obs, act, logp_old, ret, adv):
+        import jax
+        import jax.numpy as jnp
+
+        h = jnp.tanh(obs @ w["w1"] + w["b1"])
+        logits = h @ w["w2"] + w["b2"]
+        logp_all = jax.nn.log_softmax(logits, axis=-1)
+        logp = jnp.take_along_axis(logp_all, act[:, None], axis=1)[:, 0]
+        ratio = jnp.exp(logp - logp_old)
+        eps = self.config["clip_param"]
+        surr = jnp.minimum(ratio * adv,
+                           jnp.clip(ratio, 1 - eps, 1 + eps) * adv)
+        value = (h @ w["vw"] + w["vb"])[:, 0]
+        v_loss = jnp.mean((value - ret) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        return (-jnp.mean(surr) + 0.5 * v_loss
+                - self.config["entropy_coeff"] * entropy)
+
+    # -- public API ----------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        from .task_pool import TaskPool
+
+        cfg = self.config
+        if self._pool is None:
+            self._pool = TaskPool(cfg["num_workers"])
+        futs = [self._pool.submit(collect_rollouts, self.weights, self.env_fn,
+                                  cfg["episodes_per_worker"], cfg["gamma"],
+                                  cfg["seed"] * 1000 + self.iteration * 17
+                                  + wid)
+                for wid in range(cfg["num_workers"])]
+        parts = [f.result(timeout=600) for f in futs]
+        obs = np.concatenate([p[0] for p in parts])
+        act = np.concatenate([p[1] for p in parts])
+        logp = np.concatenate([p[2] for p in parts])
+        ret = np.concatenate([p[3] for p in parts])
+        adv = np.concatenate([p[4] for p in parts])
+        adv = (adv - adv.mean()) / (adv.std() + 1e-6)
+        reward_mean = float(np.mean([p[5] for p in parts]))
+
+        import jax
+        import optax
+
+        w = {k: np.asarray(v) for k, v in self.weights.items()}
+        for _ in range(cfg["num_sgd_iter"]):
+            grads = self._grad_fn(w, obs, act, logp, ret, adv)
+            updates, self._opt_state = self._opt.update(grads, self._opt_state, w)
+            w = optax.apply_updates(w, updates)
+        self.weights = {k: np.asarray(v) for k, v in jax.device_get(w).items()}
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": reward_mean,
+            "episodes_this_iter": cfg["num_workers"] * cfg["episodes_per_worker"],
+            "timesteps_this_iter": int(len(obs)),
+        }
+
+    def get_weights(self):
+        return dict(self.weights)
+
+    def set_weights(self, weights):
+        """Weight sync between trainers (the multiagent_two_trainers
+        periodic-sync pattern)."""
+        self.weights = {k: np.asarray(v) for k, v in weights.items()}
+
+    def stop(self):
+        if self._pool is not None and self._owns_pool:
+            self._pool.shutdown()
+        self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -184,7 +184,10 @@ class TaskPool:
     # ------------------------------------------------------------ internals
     def _collect(self):
         while True:
-            msg = self._outbox.get()
+            try:
+                msg = self._outbox.get()
+            except (OSError, EOFError, ValueError, TypeError):
+                return  # queue torn down during interpreter/pool shutdown
             if msg is None:
                 return
             tid, ok, blob = msg
@@ -221,6 +224,14 @@ class TaskPool:
         fut = Future()
         with self._flock:
             self._futures[tid] = fut
+        # the watchdog may have drained _futures between the _broken check
+        # above and the registration — re-check so this future can't be the
+        # one that hangs forever
+        if self._broken:
+            with self._flock:
+                self._futures.pop(tid, None)
+            fut._set(False, RuntimeError(self._broken))
+            return fut
         self._inboxes[worker].put((kind, tid, *payload))
         return fut
 

@@ -37,6 +37,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 _HDR = struct.Struct(">I")
@@ -75,10 +76,15 @@ class _Store:
     long-running deployment holds bounded memory.
     """
 
-    def __init__(self, maxlen: int = 65536, aof_path: Optional[str] = None):
+    def __init__(self, maxlen: int = 65536, aof_path: Optional[str] = None,
+                 reclaim_idle_ms: int = 60_000):
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.maxlen = maxlen
+        # delivered entries idle (unacked) past this are re-delivered to the
+        # next reader — XAUTOCLAIM semantics, so a consumer that died with
+        # in-flight work doesn't strand it until a broker restart
+        self.reclaim_idle_ms = reclaim_idle_ms
         self.streams: Dict[str, List[Tuple[str, Any]]] = collections.defaultdict(list)
         self.cursors: Dict[Tuple[str, str], int] = collections.defaultdict(int)
         self.trimmed: Dict[str, int] = collections.defaultdict(int)
@@ -168,7 +174,10 @@ class _Store:
                     by_id = dict(self.streams[stream])
                     for i in ids:
                         if i in by_id:
-                            self.pending[key][i] = by_id[i]
+                            # fresh timestamp: the redeliver list below makes
+                            # the first post-restart delivery; a stale ts would
+                            # ALSO trip the idle-reclaim scan = double delivery
+                            self.pending[key][i] = (by_id[i], time.monotonic())
                 elif op == "K":
                     _, stream, group, ids = rec
                     for i in ids:
@@ -181,8 +190,9 @@ class _Store:
         # redelivery ahead of new traffic (Redis XAUTOCLAIM-on-restart analog)
         for key, ents in self.pending.items():
             if ents:
-                self.redeliver[key] = sorted(
-                    ents.items(), key=lambda kv: int(kv[0].split("-")[0]))
+                self.redeliver[key] = [
+                    (i, payload) for i, (payload, _ts) in sorted(
+                        ents.items(), key=lambda kv: int(kv[0].split("-")[0]))]
 
     def _append(self, stream: str, entry_id: str, payload: Any) -> None:
         entries = self.streams[stream]
@@ -220,12 +230,21 @@ class _Store:
         deadline = None if block_ms <= 0 else block_ms / 1e3
         with self.cond:
             key = (stream, group)
+            now = time.monotonic()
             out: List[Tuple[str, Any]] = []
             # crash-recovered in-flight entries first (stay pending until XACK)
             redo = self.redeliver.get(key)
             if redo:
                 out.extend(redo[:count])
                 del redo[:len(out)]
+            # then idle unacked entries from a dead/stalled consumer
+            # (XAUTOCLAIM semantics)
+            if len(out) < count and self.reclaim_idle_ms:
+                for i, (payload, ts) in self.pending[key].items():
+                    if len(out) >= count:
+                        break
+                    if (now - ts) * 1e3 >= self.reclaim_idle_ms:
+                        out.append((i, payload))
 
             def fresh():
                 return len(self.streams[stream]) - self.cursors[key]
@@ -239,7 +258,7 @@ class _Store:
                 out.extend(self.streams[stream][start:start + take])
             if out:
                 for i, payload in out:
-                    self.pending[key][i] = payload
+                    self.pending[key][i] = (payload, now)
                 self._log("R", stream, group, self.cursors[key],
                           [i for i, _ in out])
             return out
@@ -330,9 +349,10 @@ class QueueBroker(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 aof_path: Optional[str] = None):
+                 aof_path: Optional[str] = None,
+                 reclaim_idle_ms: int = 60_000):
         super().__init__((host, port), _Handler)
-        self.store = _Store(aof_path=aof_path)
+        self.store = _Store(aof_path=aof_path, reclaim_idle_ms=reclaim_idle_ms)
 
     @property
     def port(self) -> int:
@@ -354,8 +374,11 @@ def main():  # pragma: no cover - exercised as a subprocess
     ap.add_argument("--port", type=int, default=6380)
     ap.add_argument("--aof", default=None,
                     help="append-only persistence file (replayed on start)")
+    ap.add_argument("--reclaim-idle-ms", type=int, default=60_000,
+                    help="redeliver entries unacked for this long (XAUTOCLAIM)")
     args = ap.parse_args()
-    broker = QueueBroker(args.host, args.port, aof_path=args.aof)
+    broker = QueueBroker(args.host, args.port, aof_path=args.aof,
+                         reclaim_idle_ms=args.reclaim_idle_ms)
     print(f"queue broker listening on {args.host}:{broker.port}", flush=True)
     broker.serve_forever()
 

@@ -128,3 +128,58 @@ def test_timing_and_summary(zoo_ctx, np_rng, tmp_path):
 def test_predict_without_load_raises(zoo_ctx):
     with pytest.raises(RuntimeError, match="no model loaded"):
         InferenceModel().predict(np.zeros((1, 4), np.float32))
+
+
+def test_int8_native_compute_packs_kernels(zoo_ctx, np_rng):
+    """Native modules quantize to REAL int8 compute: the Dense kernels live as
+    int8 in the params tree (not dequantized copies) and the layer forward
+    takes the MXU int8 path (ops/int8.int8_matmul)."""
+    model, x = _fitted_model(np_rng, in_dim=32)
+    im = InferenceModel().load(model)
+    im.quantize_int8(min_elements=64)
+    kernels = [v["kernel"] for v in im._params.values()
+               if isinstance(v, dict) and isinstance(v.get("kernel"), dict)]
+    assert kernels, "no kernels packed"
+    for k in kernels:
+        assert np.asarray(k["q"]).dtype == np.int8
+    out = im.predict(x[:16])
+    assert np.isfinite(out).all()
+
+
+def test_int8_conv2d_native_close_to_float(zoo_ctx, np_rng):
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+
+    model = Sequential([
+        L.Convolution2D(16, 3, 3, border_mode="same", activation="relu",
+                        input_shape=(8, 8, 3)),
+        L.Flatten(),
+        L.Dense(4, activation="softmax"),
+    ])
+    model.compile(optimizer="adam", loss="categorical_crossentropy")
+    x = np_rng.normal(size=(32, 8, 8, 3)).astype("float32")
+    y = np.eye(4, dtype="float32")[np_rng.integers(0, 4, 32)]
+    model.fit(x, y, batch_size=16, nb_epoch=2)
+    want = model.predict(x)
+    im = InferenceModel().load(model)
+    im.quantize_int8(min_elements=128)
+    got = im.predict(x)
+    # <0.1% classification disagreement is the reference's int8 bar
+    # (wp-bigdl.md:192); on this toy net demand identical argmax and close probs
+    assert (got.argmax(-1) == want.argmax(-1)).mean() >= 0.99
+    assert np.max(np.abs(got - want)) < 0.05
+
+
+def test_int8_imported_graph_falls_back_to_weight_only(zoo_ctx, np_rng):
+    w = np_rng.normal(size=(64, 8)).astype("float32") * 0.3
+
+    def fn(p, s, x):
+        import jax.numpy as jnp
+
+        return jnp.asarray(x) @ p["w"]
+
+    im = InferenceModel().load_fn(fn, params={"w": w})
+    im.quantize_int8(min_elements=64)
+    assert im.is_quantized
+    x = np_rng.normal(size=(4, 64)).astype("float32")
+    np.testing.assert_allclose(im.predict(x), x @ w, atol=0.05)

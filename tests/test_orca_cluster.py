@@ -153,13 +153,14 @@ def test_xshards_lazy_chain_and_cache():
     lazy = shards.transform_shard(bump, lazy=True).transform_shard(
         lambda p: p * 2, lazy=True)
     assert calls["n"] == 0                       # nothing ran yet
+    assert len(lazy) == 32                       # len() materializes in place...
+    assert calls["n"] == 4                       # ...once per partition
     out = lazy.collect_tree()
     np.testing.assert_allclose(out, (np.arange(32) + 1) * 2)
-    assert calls["n"] == 4                       # once per partition
+    assert calls["n"] == 4                       # cached: len+collect = ONE run
     lazy.cache()
-    assert calls["n"] == 8                       # chain ran once more, in place
     np.testing.assert_allclose(lazy.collect_tree(), out)
-    assert calls["n"] == 8                       # cached: no further reruns
+    assert calls["n"] == 4                       # no further reruns ever
 
 
 def test_xshards_parallel_apply_matches_serial():

@@ -1,0 +1,60 @@
+"""RL trainers on the task pool (the RayOnSpark + RLlib workload —
+pyzoo/zoo/examples/ray/rllib/multiagent_two_trainers.py hosts RLlib PPO/DQN
+trainers on the bootstrapped cluster; orca/rl.py provides the trainer natively).
+"""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.orca import CatchEnv, PPOTrainer
+
+
+def test_env_contract():
+    env = CatchEnv(seed=3)
+    obs = env.reset()
+    assert obs.shape == (env.obs_dim,)
+    total, steps = 0.0, 0
+    done = False
+    while not done:
+        obs, r, done, info = env.step(1)
+        total += r
+        steps += 1
+    assert steps == env.H - 1 and total in (-1.0, 1.0)
+
+
+def test_ppo_train_round_and_result_dict():
+    with PPOTrainer(CatchEnv, config={"num_workers": 2,
+                                      "episodes_per_worker": 4}) as tr:
+        r1 = tr.train()
+        r2 = tr.train()
+    assert r1["training_iteration"] == 1 and r2["training_iteration"] == 2
+    assert r1["episodes_this_iter"] == 8
+    assert r1["timesteps_this_iter"] == 8 * (CatchEnv.H - 1)
+    assert -1.0 <= r1["episode_reward_mean"] <= 1.0
+
+
+def test_weight_sync_between_trainers():
+    """The multiagent_two_trainers periodic weight-sync pattern."""
+    a = PPOTrainer(CatchEnv, config={"num_workers": 1,
+                                     "episodes_per_worker": 2, "seed": 0})
+    b = PPOTrainer(CatchEnv, config={"num_workers": 1,
+                                     "episodes_per_worker": 2, "seed": 9})
+    try:
+        a.train()
+        assert any(np.abs(a.get_weights()[k] - b.get_weights()[k]).max() > 0
+                   for k in a.get_weights())
+        b.set_weights(a.get_weights())
+        for k, v in a.get_weights().items():
+            np.testing.assert_array_equal(v, b.get_weights()[k])
+    finally:
+        a.stop()
+        b.stop()
+
+
+@pytest.mark.slow
+def test_ppo_learns_catch():
+    with PPOTrainer(CatchEnv, config={"num_workers": 2,
+                                      "episodes_per_worker": 24}) as tr:
+        hist = [tr.train()["episode_reward_mean"] for _ in range(40)]
+    first, last = np.mean(hist[:5]), np.mean(hist[-5:])
+    assert last > first + 0.4, f"PPO did not learn: {first:.3f} -> {last:.3f}"
