@@ -1,0 +1,47 @@
+"""RLlib-style PPO on the task pool — reference ``examples/ray/rllib``
+(multiagent_two_trainers.py hosts RLlib PPO/DQN trainers on the RayOnSpark
+cluster and periodically syncs weights between them). Here two native
+``PPOTrainer``s train on the Catch env with the same periodic weight-sync
+pattern, rollouts fanned out over TaskPool worker processes.
+"""
+
+import os
+
+import numpy as np
+
+SMOKE = os.environ.get("ZOO_EXAMPLE_SMOKE") == "1"
+
+
+def main():
+    from analytics_zoo_tpu.orca import CatchEnv, PPOTrainer
+
+    iters = 4 if SMOKE else 60
+    sync_every = 2 if SMOKE else 10
+    cfg = {"num_workers": 2, "episodes_per_worker": 4 if SMOKE else 24}
+
+    a = PPOTrainer(CatchEnv, config={**cfg, "seed": 0})
+    b = PPOTrainer(CatchEnv, config={**cfg, "seed": 1})
+    try:
+        for it in range(iters):
+            ra = a.train()
+            rb = b.train()
+            if (it + 1) % sync_every == 0:
+                # periodic sync: push the stronger policy to the other trainer
+                # (multiagent_two_trainers' DQN<->PPO weight hand-off pattern)
+                if ra["episode_reward_mean"] >= rb["episode_reward_mean"]:
+                    b.set_weights(a.get_weights())
+                else:
+                    a.set_weights(b.get_weights())
+                print(f"iter {it + 1}: A {ra['episode_reward_mean']:.3f} "
+                      f"B {rb['episode_reward_mean']:.3f} (synced)")
+        final = max(ra["episode_reward_mean"], rb["episode_reward_mean"])
+        print(f"final best reward: {final:.3f}")
+        if not SMOKE:
+            assert final > 0.3, "neither trainer learned Catch"
+    finally:
+        a.stop()
+        b.stop()
+
+
+if __name__ == "__main__":
+    main()

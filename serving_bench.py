@@ -220,8 +220,26 @@ def run_int8_bench() -> dict:
     }
 
 
+def _wait_for_accelerator() -> bool:
+    """Same retry window as bench.py: the tunnel wedges transiently."""
+    import os
+
+    window = float(os.environ.get("BENCH_TPU_PROBE_WINDOW_S", 1200))
+    interval = float(os.environ.get("BENCH_TPU_PROBE_INTERVAL_S", 120))
+    deadline = time.monotonic() + window
+    while True:
+        if _accelerator_alive():
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        print(f"[serving_bench] accelerator probe failed; retrying for "
+              f"another {remaining:.0f}s", file=sys.stderr)
+        time.sleep(min(interval, max(remaining, 0)))
+
+
 if __name__ == "__main__":
-    on_accel = _accelerator_alive()
+    on_accel = _wait_for_accelerator()
     if not on_accel:
         print("[serving_bench] accelerator unreachable; using cpu",
               file=sys.stderr)

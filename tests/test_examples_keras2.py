@@ -102,6 +102,8 @@ CHEAP_EXAMPLES = [
     "dogs_vs_cats_finetune.py",
     "streaming_text_classification.py",
     "rl_parameter_server.py",
+    "rllib_style_ppo.py",
+    "model_inference_app.py",
 ]
 # each of these costs >10s on the 1-core CI box (backbone compiles, multi-step
 # pipelines); the full tier runs them, the smoke tier skips
