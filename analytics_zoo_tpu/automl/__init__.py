@@ -19,6 +19,7 @@ from .metrics import Evaluator
 from .feature import TimeSequenceFeatureTransformer
 from .models import VanillaLSTM, TSSeq2Seq, MTNet, TimeSequenceModel
 from .search import SearchEngine, Trial, TrialResult
+from .tpe import tpe_suggest
 from .recipe import (Recipe, SmokeRecipe, LSTMRandomGridRecipe, MTNetSmokeRecipe,
                      MTNetRandomGridRecipe, Seq2SeqRandomRecipe, RandomRecipe)
 from .pipeline import TimeSequencePipeline, load_ts_pipeline
@@ -28,7 +29,7 @@ __all__ = [
     "Choice", "Uniform", "LogUniform", "RandInt", "QUniform", "GridSearch",
     "sample_config", "Evaluator", "TimeSequenceFeatureTransformer",
     "VanillaLSTM", "TSSeq2Seq", "MTNet", "TimeSequenceModel",
-    "SearchEngine", "Trial", "TrialResult",
+    "SearchEngine", "Trial", "TrialResult", "tpe_suggest",
     "Recipe", "SmokeRecipe", "LSTMRandomGridRecipe", "MTNetSmokeRecipe",
     "MTNetRandomGridRecipe", "Seq2SeqRandomRecipe", "RandomRecipe",
     "TimeSequencePipeline", "load_ts_pipeline", "TimeSequencePredictor",

@@ -58,7 +58,8 @@ class TimeSequencePredictor:
 
     def fit(self, input_df, validation_df=None, metric: str = "mse",
             recipe: Optional[Recipe] = None,
-            max_workers: int = 1, seed: int = 0) -> TimeSequencePipeline:
+            max_workers: int = 1, seed: int = 0,
+            search_alg: str = "random") -> TimeSequencePipeline:
         """Search + refit. (The reference's ``mc`` flag is not a fit-time mode
         here — MC-dropout uncertainty is always available via
         ``pipeline.predict_with_uncertainty``.)"""
@@ -91,7 +92,8 @@ class TimeSequencePredictor:
         engine = SearchEngine(trainable, metric=metric,
                               num_samples=runtime.get("num_samples", 1),
                               training_iteration=runtime.get("training_iteration", 1),
-                              max_workers=max_workers, seed=seed)
+                              max_workers=max_workers, seed=seed,
+                              search_alg=search_alg)
         best = engine.run(space)
 
         # refit the best config on the full data to produce the pipeline

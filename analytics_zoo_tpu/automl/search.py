@@ -74,7 +74,8 @@ class SearchEngine:
 
     def __init__(self, trainable, metric: str = "mse", num_samples: int = 1,
                  training_iteration: int = 1, max_workers: int = 1,
-                 grace_rounds: int = 1, seed: int = 0):
+                 grace_rounds: int = 1, seed: int = 0,
+                 search_alg: str = "random", n_initial: int = 4):
         self.trainable = trainable
         self.metric = metric
         self.num_samples = int(num_samples)
@@ -82,6 +83,10 @@ class SearchEngine:
         self.max_workers = max(1, int(max_workers))
         self.grace_rounds = int(grace_rounds)
         self.seed = int(seed)
+        if search_alg not in ("random", "tpe"):
+            raise ValueError(f"unknown search_alg {search_alg!r}")
+        self.search_alg = search_alg
+        self.n_initial = int(n_initial)
         self.results: List[TrialResult] = []
 
     # ------------------------------------------------------------------ configs
@@ -101,7 +106,11 @@ class SearchEngine:
             fixed: Optional[Dict[str, Any]] = None) -> TrialResult:
         """Round-robin over trials with a barrier per reporting round: after each
         round, trials whose reward falls below the round median are pruned
-        (median-stopping — the reference's Ray Tune scheduler capability)."""
+        (median-stopping — the reference's Ray Tune scheduler capability).
+        ``search_alg='tpe'`` instead runs trials sequentially, each config
+        suggested from the history (HyperOptSearch capability)."""
+        if self.search_alg == "tpe":
+            return self._run_tpe(space, fixed)
         configs = self._draw_configs(space, fixed)
         n = len(configs)
         failed: List[TrialResult] = []
@@ -175,4 +184,52 @@ class SearchEngine:
         best = max(ok, key=lambda r: r.reward)
         log.info("search done: %d trials, best %s=%.6g (trial %d)",
                  n, self.metric, best.metric, best.trial_id)
+        return best
+
+    # --------------------------------------------------------------------- tpe
+    def _run_tpe(self, space: Dict[str, Any],
+                 fixed: Optional[Dict[str, Any]]) -> TrialResult:
+        """Sequential model-based search: the first ``n_initial`` configs are
+        random, every later one maximizes the TPE good/bad density ratio over
+        completed-trial rewards. Grid dims are expanded as usual; the trial
+        budget is ``num_samples`` per grid point."""
+        from .tpe import tpe_suggest
+
+        rng = np.random.default_rng(self.seed)
+        self.results = []
+        tid = 0
+        for grid_part in grid_product(space):
+            merged_fixed = dict(fixed or {})
+            merged_fixed.update(grid_part)
+            history: List[tuple] = []
+            for i in range(self.num_samples):
+                if i < self.n_initial or len(history) < 2:
+                    config = sample_config(space, rng, fixed=merged_fixed)
+                else:
+                    config = tpe_suggest(space, history, rng,
+                                         fixed=merged_fixed)
+                try:
+                    round_fn = self.trainable(
+                        copy.deepcopy(config),
+                        trial_seed=self.seed * 10007 + tid)
+                    trial = Trial(tid, config, round_fn, self.metric)
+                    for _ in range(self.training_iteration):
+                        value = trial.run_round()
+                    reward = Evaluator.reward(self.metric, value)
+                    history.append((config, reward))
+                    self.results.append(TrialResult(
+                        config=config, metric=value, reward=reward,
+                        history=trial.history, trial_id=tid))
+                except Exception as e:
+                    log.warning("tpe trial %d failed: %s", tid, e)
+                    self.results.append(TrialResult(
+                        config=config, metric=float("inf"),
+                        reward=float("-inf"), trial_id=tid, error=str(e)))
+                tid += 1
+        ok = [r for r in self.results if r.error is None]
+        if not ok:
+            raise RuntimeError(f"all {tid} tpe trials failed")
+        best = max(ok, key=lambda r: r.reward)
+        log.info("tpe search done: %d trials, best %s=%.6g (trial %d)",
+                 tid, self.metric, best.metric, best.trial_id)
         return best

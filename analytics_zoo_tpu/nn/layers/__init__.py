@@ -18,10 +18,11 @@ from .conv_extended import (AtrousConvolution1D, AtrousConvolution2D,
                             SeparableConvolution2D, ShareConvolution2D,
                             UpSampling1D, UpSampling3D, WithinChannelLRN2D,
                             ZeroPadding1D, ZeroPadding3D)
-from .elementwise import (AddConstant, BinaryThreshold, CAdd, CMul, Exp, Expand,
+from .elementwise import (AddConstant, BinaryThreshold, CAdd, CMul, ERF, Exp, Expand,
                           GaussianSampler, GetShape, HardShrink, HardTanh,
                           Identity, KerasLayerWrapper, Log, Max, Mul,
                           MulConstant, Negative, Power, Scale, SelectTable,
+                          MM,
                           SoftShrink, SplitTensor, Sqrt, Square, Threshold)
 from .advanced_activations import (ELU, LeakyReLU, PReLU, RReLU, Softmax, SReLU,
                                    SpatialDropout1D, SpatialDropout2D,
@@ -52,13 +53,13 @@ __all__ = [
     "Conv1D", "Conv2D", "Conv3D", "ConvLSTM2D", "ConvLSTM3D", "Convolution1D",
     "Convolution2D", "Convolution3D", "Cropping1D", "Cropping2D", "Cropping3D",
     "Deconvolution2D", "Dense", "DepthwiseConv2D", "Dropout", "ELU", "Embedding", "FusedPairEmbedding",
-    "Exp", "Expand", "ExpandDim", "Flatten", "GRU", "GaussianDropout",
+    "ERF", "Exp", "Expand", "ExpandDim", "Flatten", "GRU", "GaussianDropout",
     "GaussianNoise", "GaussianSampler", "GetShape", "GlobalAveragePooling1D",
     "GlobalAveragePooling2D", "GlobalAveragePooling3D", "GlobalMaxPooling1D",
     "GlobalMaxPooling2D", "GlobalMaxPooling3D", "HardShrink", "HardTanh",
     "Highway", "Identity", "InputLayer", "KerasLayerWrapper", "LRN2D", "LSTM",
     "Lambda", "LayerNormalization", "LeakyReLU", "LocallyConnected1D",
-    "LocallyConnected2D", "Log", "Masking", "Max", "MaxPooling1D",
+    "LocallyConnected2D", "Log", "Masking", "MM", "Max", "MaxPooling1D",
     "MaxPooling2D", "MaxPooling3D", "MaxoutDense", "Merge", "MoE", "Mul",
     "MulConstant", "Narrow", "Negative", "PReLU", "Permute", "Power", "RReLU",
     "RepeatVector", "Reshape", "ResizeBilinear", "SReLU", "Scale", "Select",

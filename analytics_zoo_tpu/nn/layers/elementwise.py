@@ -356,3 +356,38 @@ class KerasLayerWrapper(Layer):
         if self.module is not None:
             return self.module.compute_output_shape(input_shape)
         return input_shape
+
+
+class ERF(Layer):
+    """Gauss error function activation (InternalERF.scala — used by the BERT
+    gelu decomposition in the reference)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jax.scipy.special.erf(x), state
+
+
+class MM(Layer):
+    """Batched matrix multiply of a two-tensor input [a, b]
+    (InternalMM.scala — the merge-mode "dot"/"mm" building block behind KNRM's
+    translation matrix). ``trans_a``/``trans_b`` transpose the last two dims."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False,
+                 name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.trans_a, self.trans_b = bool(trans_a), bool(trans_b)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        a, b = x
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b), state
+
+    def compute_output_shape(self, input_shape):
+        sa, sb = [list(s) for s in input_shape]
+        if self.trans_a:
+            sa[-1], sa[-2] = sa[-2], sa[-1]
+        if self.trans_b:
+            sb[-1], sb[-2] = sb[-2], sb[-1]
+        return tuple(sa[:-1] + [sb[-1]])

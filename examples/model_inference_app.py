@@ -50,13 +50,15 @@ def serve_and_query(name, model, instances):
     im = InferenceModel(supported_concurrent_num=4, max_batch_size=64)
     im.load(model)
     app = FrontEndApp(ServingConfig(), port=0, model=im, max_batch=32).start()
-    url = f"http://127.0.0.1:{app.port}/predict"
-    body = json.dumps({"instances": instances}).encode()
-    req = urllib.request.Request(url, data=body,
-                                 headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=60) as resp:
-        out = json.loads(resp.read())
-    app.stop()
+    try:
+        url = f"http://127.0.0.1:{app.port}/predict"
+        body = json.dumps({"instances": instances}).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = json.loads(resp.read())
+    finally:
+        app.stop()
     preds = out["predictions"]
     print(f"{name}: served {len(preds)} predictions, "
           f"first top-class {int(np.argmax(preds[0]))}")

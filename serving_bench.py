@@ -23,18 +23,9 @@ import urllib.request
 
 import numpy as np
 
+from bench import _accelerator_alive, _wait_for_accelerator  # shared probe logic
 
-def _accelerator_alive(timeout_s: int = 90) -> bool:
-    """Probe the default (TPU-tunnel) backend in a subprocess — a wedged
-    tunnel blocks forever inside PJRT client init (same guard as bench.py)."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d=jax.devices(); print(d[0].platform)"],
-            timeout=timeout_s, capture_output=True, text=True)
-        return r.returncode == 0 and "cpu" not in r.stdout.lower()
-    except subprocess.TimeoutExpired:
-        return False
+
 
 N_CLIENTS = 16
 REQUESTS_PER_CLIENT = 40
@@ -218,24 +209,6 @@ def run_int8_bench() -> dict:
         "argmax_agreement": agree,
         "max_prob_diff": round(float(np.max(np.abs(out_f - out_q))), 5),
     }
-
-
-def _wait_for_accelerator() -> bool:
-    """Same retry window as bench.py: the tunnel wedges transiently."""
-    import os
-
-    window = float(os.environ.get("BENCH_TPU_PROBE_WINDOW_S", 1200))
-    interval = float(os.environ.get("BENCH_TPU_PROBE_INTERVAL_S", 120))
-    deadline = time.monotonic() + window
-    while True:
-        if _accelerator_alive():
-            return True
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            return False
-        print(f"[serving_bench] accelerator probe failed; retrying for "
-              f"another {remaining:.0f}s", file=sys.stderr)
-        time.sleep(min(interval, max(remaining, 0)))
 
 
 if __name__ == "__main__":

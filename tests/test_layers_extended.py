@@ -544,3 +544,27 @@ def test_keras2_gru_bias_and_channels_first_input_shape():
     m.compile(optimizer="sgd", loss="mse")
     x = np.random.default_rng(0).standard_normal((2, 3, 8, 8)).astype("float32")
     assert np.asarray(m.predict(x)).shape == (2, 4, 8, 8)
+
+
+def test_erf_and_mm_layers():
+    import math
+
+    from analytics_zoo_tpu.nn import layers as L
+
+    x = np.linspace(-2, 2, 9).astype("float32")
+    y, _ = L.ERF().apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y),
+                               [math.erf(v) for v in x], atol=1e-5)
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((2, 3, 4)).astype("float32")
+    b = rng.standard_normal((2, 4, 5)).astype("float32")
+    y, _ = L.MM().apply({}, {}, [a, b])
+    np.testing.assert_allclose(np.asarray(y), a @ b, atol=1e-5)
+    # transposed variant (the KNRM translation-matrix shape: q @ d^T)
+    d = rng.standard_normal((2, 5, 4)).astype("float32")
+    y, _ = L.MM(trans_b=True).apply({}, {}, [a, d])
+    np.testing.assert_allclose(np.asarray(y), a @ np.swapaxes(d, -1, -2),
+                               atol=1e-5)
+    assert L.MM(trans_b=True).compute_output_shape(
+        [(3, 4), (5, 4)]) == (3, 5)
