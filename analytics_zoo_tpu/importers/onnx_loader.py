@@ -162,6 +162,62 @@ class _Executor:
     def op_LogSoftmax(self, n, ins):
         return jax.nn.log_softmax(ins[0], axis=int(n.attr("axis", -1)))
 
+    # ------------------------------------------------------------- comparison
+    def op_Greater(self, n, ins):
+        return ins[0] > ins[1]
+
+    def op_Cast(self, n, ins):
+        # onnx TensorProto enum -> numpy dtype (the subset real exports use)
+        to = {1: jnp.float32, 2: jnp.uint8, 3: jnp.int8, 5: jnp.int16,
+              6: jnp.int32, 7: jnp.int64, 9: jnp.bool_, 10: jnp.float16,
+              11: jnp.float64, 16: jnp.bfloat16}[int(n.attr("to"))]
+        return ins[0].astype(to)
+
+    def op_LRN(self, n, ins):
+        # AlexNet-style local response normalization over channels (axis 1,
+        # NCHW — onnx LRN is defined channels-first)
+        x = ins[0]
+        size = int(n.attr("size"))
+        alpha = float(n.attr("alpha", 1e-4))
+        beta = float(n.attr("beta", 0.75))
+        bias = float(n.attr("bias", 1.0))
+        # onnx window: [c - floor((size-1)/2), c + ceil((size-1)/2)]
+        # (differs from size//2 for EVEN sizes)
+        half = (size - 1) // 2
+        sq = x * x
+        pad = [(0, 0)] * x.ndim
+        pad[1] = (half, size - 1 - half)
+        padded = jnp.pad(sq, pad)
+        acc = sum(padded[:, i:i + x.shape[1]] for i in range(size))
+        return x / jnp.power(bias + (alpha / size) * acc, beta)
+
+    def op_Slice(self, n, ins):
+        # opset >= 10: starts/ends/[axes]/[steps] arrive as inputs; opset 1
+        # used attributes — support both (Slice.scala mapper parity)
+        x = ins[0]
+        if len(ins) > 1 and ins[1] is not None:
+            starts = [int(v) for v in np.asarray(ins[1])]
+            ends = [int(v) for v in np.asarray(ins[2])]
+            axes = ([int(v) for v in np.asarray(ins[3])]
+                    if len(ins) > 3 and ins[3] is not None
+                    else list(range(len(starts))))
+            steps = ([int(v) for v in np.asarray(ins[4])]
+                     if len(ins) > 4 and ins[4] is not None
+                     else [1] * len(starts))
+        else:
+            starts = [int(v) for v in n.attr("starts")]
+            ends = [int(v) for v in n.attr("ends")]
+            axes = ([int(v) for v in n.attr("axes")]
+                    if n.attr("axes", None) is not None
+                    else list(range(len(starts))))
+            steps = [1] * len(starts)
+        idx = [slice(None)] * x.ndim
+        for s, e, a, st in zip(starts, ends, axes, steps):
+            dim = x.shape[a]
+            e = min(e, dim) if e >= 0 else e   # onnx clamps INT_MAX ends
+            idx[a] = slice(s, e, st)
+        return x[tuple(idx)]
+
     # ---------------------------------------------------------------- linear
     def op_Gemm(self, n, ins):
         a, b = ins[0], ins[1]

@@ -15,7 +15,6 @@ and writes the same object to SERVING_BENCH.json.
 from __future__ import annotations
 
 import json
-import subprocess
 import sys
 import threading
 import time

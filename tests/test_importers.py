@@ -361,3 +361,77 @@ def test_torch_full_module_requires_opt_in(tmp_path):
         load_torch_state_dict(p)
     sd = load_torch_state_dict(p, allow_pickle=True)
     assert "weight" in sd
+
+
+def test_onnx_cast_greater_slice_lrn():
+    """The last four reference-mapper ops (Cast/Greater/Slice/LRN — mapper/
+    cast.py, greater.py, slice.py, lrn.py parity)."""
+    import torch
+
+    # Cast + Greater
+    g = Graph(name="cg")
+    g.initializers = {"thr": np.asarray([1.0], dtype="float32")}
+    g.inputs = [ValueInfo("x", (None, 4))]
+    g.outputs = [ValueInfo("y", ())]
+    g.nodes = [Node("Greater", ["x", "thr"], ["gt"]),
+               Node("Cast", ["gt"], ["y"],
+                    attrs={"to": Attribute(name="to", i=1)})]  # -> float32
+    model = load_onnx(encode_model(g))
+    model.compile(optimizer="adam", loss="mse")
+    x = np.asarray([[0.5, 1.5, 1.0, 2.0]], dtype="float32")
+    np.testing.assert_allclose(model.predict(x), [[0.0, 1.0, 0.0, 1.0]])
+
+    # Slice: opset>=10 inputs form with axes + steps
+    g = Graph(name="sl")
+    g.initializers = {"st": np.asarray([1], dtype="int64"),
+                      "en": np.asarray([2**31 - 1], dtype="int64"),
+                      "ax": np.asarray([1], dtype="int64"),
+                      "sp": np.asarray([2], dtype="int64")}
+    g.inputs = [ValueInfo("x", (None, 6))]
+    g.outputs = [ValueInfo("y", ())]
+    g.nodes = [Node("Slice", ["x", "st", "en", "ax", "sp"], ["y"])]
+    model = load_onnx(encode_model(g))
+    model.compile(optimizer="adam", loss="mse")
+    x = np.arange(12, dtype="float32").reshape(2, 6)
+    np.testing.assert_allclose(model.predict(x), x[:, 1::2])
+
+    # LRN differential vs torch (NCHW)
+    g = Graph(name="lrn")
+    g.inputs = [ValueInfo("x", (None, 6, 5, 5))]
+    g.outputs = [ValueInfo("y", ())]
+    g.nodes = [Node("LRN", ["x"], ["y"], attrs={
+        "size": Attribute(name="size", i=3),
+        "alpha": Attribute(name="alpha", f=2e-4),
+        "beta": Attribute(name="beta", f=0.7),
+        "bias": Attribute(name="bias", f=1.5)})]
+    model = load_onnx(encode_model(g))
+    model.compile(optimizer="adam", loss="mse")
+    x = np.random.default_rng(0).standard_normal((2, 6, 5, 5)).astype("float32")
+    want = torch.nn.LocalResponseNorm(3, alpha=2e-4, beta=0.7, k=1.5)(
+        torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(model.predict(x), want, atol=1e-5)
+
+
+def test_onnx_lrn_even_size_window():
+    """ONNX LRN window for even sizes is [c - (size-1)//2, c + size//2]
+    (differs from the naive size//2 offset)."""
+    g = Graph(name="lrn2")
+    g.inputs = [ValueInfo("x", (None, 4, 2, 2))]
+    g.outputs = [ValueInfo("y", ())]
+    size, alpha, beta, bias = 2, 1e-2, 0.75, 1.0
+    g.nodes = [Node("LRN", ["x"], ["y"], attrs={
+        "size": Attribute(name="size", i=size),
+        "alpha": Attribute(name="alpha", f=alpha),
+        "beta": Attribute(name="beta", f=beta),
+        "bias": Attribute(name="bias", f=bias)})]
+    model = load_onnx(encode_model(g))
+    model.compile(optimizer="adam", loss="mse")
+    x = np.random.default_rng(1).standard_normal((1, 4, 2, 2)).astype("float32")
+    sq = x * x
+    want = np.empty_like(x)
+    C = x.shape[1]
+    for c in range(C):
+        lo, hi = max(0, c - (size - 1) // 2), min(C - 1, c + size // 2)
+        acc = sq[:, lo:hi + 1].sum(axis=1)
+        want[:, c] = x[:, c] / (bias + (alpha / size) * acc) ** beta
+    np.testing.assert_allclose(model.predict(x), want, atol=1e-5)
