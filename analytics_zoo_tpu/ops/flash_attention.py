@@ -46,9 +46,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def body():
-        q = q_ref[0].astype(jnp.float32)            # (block_q, D)
-        k = k_ref[0].astype(jnp.float32)            # (block_k, D)
-        v = v_ref[0].astype(jnp.float32)
+        # operands STAY in their storage dtype: a bf16×bf16→f32 dot runs the
+        # MXU at full rate, an f32 upcast would halve it; all softmax
+        # statistics accumulate in f32 via preferred_element_type
+        q = q_ref[0]                                # (block_q, D)
+        k = k_ref[0]                                # (block_k, D)
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -64,7 +67,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new)                      # (block_q, block_k)
         l_new = l_prev * corr + p.sum(axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -151,10 +155,11 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qi, kb, *,
     identical math in the dq and dk/dv kernels so the two passes can never
     desynchronize.
     """
-    q = q_ref[0].astype(jnp.float32)                # (block_q, D)
-    k = k_ref[0].astype(jnp.float32)                # (block_k, D)
-    v = v_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)                # (block_q, D)
+    # storage dtype in, f32 accumulate out (bf16 MXU full-rate — see forward)
+    q = q_ref[0]                                    # (block_q, D)
+    k = k_ref[0]                                    # (block_k, D)
+    v = v_ref[0]
+    g = g_ref[0]                                    # (block_q, D)
     lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]    # (block_q,)
     delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -188,7 +193,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
             q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qi, kb,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k)
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     if causal:
         @pl.when(kb * block_k <= qi * block_q + block_q - 1)
@@ -220,10 +226,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k)
         # dV += Pᵀ · dO
         dv_scr[:] += jax.lax.dot_general(
-            p, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         # dK += dSᵀ · Q
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     if causal:
         # skip Q tiles strictly before this K tile (their P block is all-masked)

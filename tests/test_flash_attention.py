@@ -187,3 +187,25 @@ def test_flash_tiled_backward_matches_oracle_multi_tile(causal):
     for a, b_ in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    atol=5e-4, rtol=5e-4)
+
+
+def test_flash_gradients_bf16_close_to_f32_oracle():
+    """The bf16 backward path (p/ds downcast before the grad dots — the MXU
+    full-rate pattern) must stay close to the f32 full-attention oracle;
+    forward-only bf16 coverage would miss a broken gradient downcast."""
+    q, k, v = make_qkv(t=32, d=8)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, 16, 16, True)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(qb, kb, vb)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_full):
+        assert gf.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(gf, dtype=np.float32),
+                                   np.asarray(gr), atol=3e-2, rtol=5e-2)
