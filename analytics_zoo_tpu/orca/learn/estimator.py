@@ -24,7 +24,9 @@ def _marshal_shards(data: XShards, feature_cols, label_cols):
     parts = data.collect()
     xs, ys = [], []
     for p in parts:
-        if isinstance(p, dict):
+        if isinstance(p, np.ndarray):
+            xs.append(p)
+        elif isinstance(p, dict):
             xs.append(np.asarray(p["x"]))
             if "y" in p and p["y"] is not None:
                 ys.append(np.asarray(p["y"]))
@@ -43,6 +45,47 @@ def _marshal_shards(data: XShards, feature_cols, label_cols):
     x = np.concatenate(xs, axis=0)
     y = np.concatenate(ys, axis=0) if ys else None
     return x, y
+
+
+def host_sharded_featureset(data: XShards, feature_cols=None, label_cols=None,
+                            *, process_index: int, process_count: int):
+    """This host's partitions of an XShards → ``FeatureSet.from_host_shard``.
+
+    The multi-host ingest contract: partition ``i`` belongs to host
+    ``i % process_count``; each host marshals only its slice and yields its
+    local ``batch/process_count`` rows per global step. Lockstep is GUARANTEED
+    here: every host deterministically computes all hosts' row counts from the
+    shared partition layout and truncates its slice to the minimum, so no host
+    can run a trailing step the others skip (which would hang collectives).
+    """
+    from ...data.featureset import FeatureSet
+
+    def rows(p) -> int:
+        if isinstance(p, dict):
+            return len(p["x"])
+        if isinstance(p, tuple):
+            return len(p[0])
+        return len(p)
+
+    # counting needs no materialization unless a lazy chain could change
+    # partition lengths; raw parts are already resident so len() is free
+    parts = data.collect() if data._pending else list(data._parts)
+    counts = [sum(rows(p) for p in parts[r::process_count])
+              for r in range(process_count)]
+    empty = [r for r, c in enumerate(counts) if c == 0]
+    if empty:
+        raise ValueError(
+            f"hosts {empty} would receive no data: {len(parts)} partitions "
+            f"over {process_count} hosts (counts={counts}); repartition the "
+            f"XShards to at least one non-empty partition per host")
+    n_min = min(counts)
+
+    local = data.host_split(process_index, process_count)
+    x, y = _marshal_shards(local, feature_cols, label_cols)
+    x = x[:n_min]
+    tree = (x,) if y is None else (x, y[:n_min])
+    return FeatureSet.from_host_shard(tree, process_index=process_index,
+                                      process_count=process_count)
 
 
 def _marshal(data, feature_cols=None, label_cols=None):
@@ -100,8 +143,36 @@ class Estimator:
     def fit(self, data, epochs: int = 1, batch_size: int = 32,
             feature_cols: Optional[List[str]] = None,
             label_cols: Optional[List[str]] = None,
-            validation_data=None) -> "Estimator":
+            validation_data=None,
+            host_sharding: Optional[bool] = None) -> "Estimator":
+        """``host_sharding`` (default auto: on under a multi-host job): XShards
+        input is split by partition across hosts and each host marshals ONLY
+        its own slice into a ``FeatureSet.from_host_shard`` — the multi-host
+        sharded-ingest path; no host materializes the global dataset."""
         self._ensure_compiled()
+        if isinstance(data, XShards):
+            import jax
+
+            if host_sharding is None:
+                host_sharding = jax.process_count() > 1
+            if host_sharding:
+                fs = host_sharded_featureset(
+                    data, feature_cols, label_cols,
+                    process_index=jax.process_index(),
+                    process_count=jax.process_count())
+                val = None
+                if validation_data is not None:
+                    if isinstance(validation_data, XShards):
+                        val = host_sharded_featureset(
+                            validation_data, feature_cols, label_cols,
+                            process_index=jax.process_index(),
+                            process_count=jax.process_count())
+                    else:  # arrays: every host evaluates the full set
+                        val = _marshal(validation_data, feature_cols,
+                                       label_cols)
+                self.model.fit(fs, batch_size=batch_size, nb_epoch=epochs,
+                               validation_data=val)
+                return self
         x, y = _marshal(data, feature_cols, label_cols)
         val = None
         if validation_data is not None:
