@@ -35,6 +35,8 @@ ArrayTree = Any  # nested tuple/dict/list of np.ndarray, all with equal leading 
 class MemoryType:
     DRAM = "DRAM"
     PMEM = "PMEM"
+    # the reference's DIRECT tier = off-JVM-heap byte buffers (GC pressure
+    # relief); numpy arrays are already heap-external so it IS the DRAM tier
     DIRECT = "DIRECT"
 
     @staticmethod
