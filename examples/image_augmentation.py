@@ -1,0 +1,45 @@
+"""Image augmentation, 2D + 3D — reference ``apps/image-augmentation`` and
+``apps/image-augmentation-3d``: chained ImageProcessing stages over an
+ImageSet, plus the volumetric crop/rotate/affine pipeline.
+"""
+
+import os
+
+import numpy as np
+
+SMOKE = os.environ.get("ZOO_EXAMPLE_SMOKE") == "1"
+
+
+def main():
+    from analytics_zoo_tpu.data.image import (ImageBrightness, ImageChannelNormalize,
+                                              ImageHFlip, ImageRandomCrop,
+                                              ImageRandomPreprocessing,
+                                              ImageResize, ImageSet)
+    from analytics_zoo_tpu.data.image3d import (CenterCrop3D, RandomCrop3D,
+                                                Rotate3D)
+
+    rng = np.random.default_rng(0)
+    imgs = [rng.uniform(0, 255, (48, 48, 3)).astype("float32")
+            for _ in range(4 if SMOKE else 64)]
+    iset = ImageSet.from_arrays(imgs) \
+        .transform(ImageResize(40, 40)) \
+        .transform(ImageRandomCrop(32, 32)) \
+        .transform(ImageRandomPreprocessing(ImageHFlip(), prob=0.5)) \
+        .transform(ImageBrightness(-24.0, 24.0)) \
+        .transform(ImageChannelNormalize(123.0, 117.0, 104.0, 58.4, 57.1, 57.4))
+    x, _ = iset.to_arrays()
+    print("augmented 2D batch:", x.shape, "mean", round(float(x.mean()), 4))
+    assert x.shape[1:] == (32, 32, 3)
+
+    # 3D (volumetric) pipeline — image-augmentation-3d parity
+    vol = rng.uniform(size=(24, 24, 24)).astype("float32")
+    v1 = RandomCrop3D((16, 16, 16)).apply_image(vol, rng)
+    v2 = Rotate3D(yaw=0.3).apply_image(v1, rng)
+    v3 = CenterCrop3D((12, 12, 12)).apply_image(v2, rng)
+    print("augmented 3D volume:", v3.shape)
+    assert v3.shape[:3] == (12, 12, 12)
+    print("2D + 3D augmentation pipelines OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -104,6 +104,9 @@ CHEAP_EXAMPLES = [
     "rl_parameter_server.py",
     "rllib_style_ppo.py",
     "model_inference_app.py",
+    "tfnet_inference.py",
+    "torch_finetune.py",
+    "image_augmentation.py",
 ]
 # each of these costs >10s on the 1-core CI box (backbone compiles, multi-step
 # pipelines); the full tier runs them, the smoke tier skips
