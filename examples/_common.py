@@ -9,6 +9,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def force_cpu_if_no_tpu():
     import jax
 
+    # an explicit JAX_PLATFORMS=cpu wins unconditionally: the host's
+    # sitecustomize can override the env var inside jax, and probing a WEDGED
+    # accelerator tunnel with jax.devices() hangs forever instead of raising
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return
     try:
         jax.devices("tpu")
     except Exception:
