@@ -360,14 +360,15 @@ class FeatureSet:
             out.append(FeatureSet(
                 _tree_map(lambda a: np.asarray(a[sl]), self.data),
                 process_index=self.process_index, process_count=self.process_count,
-                seed=self.seed + 17 * (i + 1)))
+                seed=self.seed + 17 * (i + 1), host_shard=self.host_shard))
         return out
 
     def transform(self, fn) -> "FeatureSet":
         """Apply a preprocessing fn over the whole tree (ImageSet/TextSet transform
         chain parity — applied eagerly host-side)."""
         return FeatureSet(fn(self.data), process_index=self.process_index,
-                          process_count=self.process_count, seed=self.seed)
+                          process_count=self.process_count, seed=self.seed,
+                          host_shard=self.host_shard)
 
 
 def device_prefetch(batch_iter: Iterator[ArrayTree], sharding=None, depth: int = 2):
@@ -433,7 +434,7 @@ class BytesFeatureSet(FeatureSet):
                 list(self.data[0][sl]), self.decoder,
                 process_index=self.process_index,
                 process_count=self.process_count,
-                seed=self.seed + 17 * (i + 1)))
+                seed=self.seed + 17 * (i + 1), host_shard=self.host_shard))
         return out
 
     def transform(self, fn) -> "FeatureSet":
@@ -441,4 +442,5 @@ class BytesFeatureSet(FeatureSet):
         (arr,) = fn(self.data)
         return BytesFeatureSet(list(arr), self.decoder,
                                process_index=self.process_index,
-                               process_count=self.process_count, seed=self.seed)
+                               process_count=self.process_count, seed=self.seed,
+                               host_shard=self.host_shard)

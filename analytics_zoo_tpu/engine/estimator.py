@@ -600,8 +600,20 @@ class Estimator:
             for _ in range(max(1, passes)):
                 for hb in fs.batches(batch_size, shuffle=False,
                                      drop_remainder=False):
-                    hb = hb[:n_in]
-                    xb = hb[0] if len(hb) == 1 else list(hb)
+                    if isinstance(hb, dict):
+                        # dict-tree batches (from_generator/from_xshards): only
+                        # models whose apply takes the mapping whole can eat
+                        # them — positional multi-input graphs cannot tell
+                        # inputs from labels in an unordered mapping
+                        if getattr(self.model, "input_nodes", None):
+                            raise ValueError(
+                                "recalibrate_batchnorm got a dict-tree batch "
+                                "but the model takes positional graph inputs; "
+                                "pass x as an array/tuple FeatureSet instead")
+                        xb = hb
+                    else:
+                        hb = hb[:n_in]
+                        xb = hb[0] if len(hb) == 1 else list(hb)
                     mstate = fwd(self.train_state["params"], mstate, xb)
             self.train_state["model_state"] = mstate
         finally:

@@ -168,9 +168,14 @@ class _Executor:
 
     def op_Cast(self, n, ins):
         # onnx TensorProto enum -> numpy dtype (the subset real exports use)
+        enum = int(n.attr("to"))
         to = {1: jnp.float32, 2: jnp.uint8, 3: jnp.int8, 5: jnp.int16,
               6: jnp.int32, 7: jnp.int64, 9: jnp.bool_, 10: jnp.float16,
-              11: jnp.float64, 16: jnp.bfloat16}[int(n.attr("to"))]
+              11: jnp.float64, 16: jnp.bfloat16}.get(enum)
+        if to is None:
+            raise ValueError(
+                f"Cast node {n.name!r}: unsupported TensorProto dtype enum "
+                f"{enum} (supported: float/ints/bool/f16/bf16/f64)")
         return ins[0].astype(to)
 
     def op_LRN(self, n, ins):

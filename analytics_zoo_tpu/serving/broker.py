@@ -130,6 +130,20 @@ class _Store:
         tmp = self._aof_path + ".rewrite"
         with open(tmp, "w", encoding="utf-8") as f:
             for stream, entries in self.streams.items():
+                # delivered-but-unacked entries already trimmed out of the live
+                # window keep their payload in the pending map; persist them as
+                # "P" payload-only records (NOT appends — appending them would
+                # change stream indices and misalign group cursors if maxlen
+                # differs on the next start) so redelivery survives the rewrite
+                live = {i for i, _ in entries}
+                ghost: Dict[str, Any] = {}
+                for (s, _g), ents in self.pending.items():
+                    if s == stream:
+                        for i, (payload, _ts) in ents.items():
+                            if i not in live:
+                                ghost[i] = payload
+                for i in sorted(ghost, key=lambda e: int(e.split("-")[0])):
+                    f.write(json.dumps(["P", stream, i, ghost[i]]) + "\n")
                 for entry_id, payload in entries:
                     f.write(json.dumps(["A", stream, entry_id, payload]) + "\n")
             for (stream, group), cur in self.cursors.items():
@@ -151,6 +165,13 @@ class _Store:
         self._ops_since_rewrite = 0
 
     def _replay(self, path: str) -> None:
+        # payloads of replayed appends still possibly needed for redelivery,
+        # keyed by id — the live stream trims to maxlen, but a delivered-but-
+        # unacked entry must keep its payload even after it overflows out of
+        # the stream. Acked ids are pruned (bounding replay memory by the
+        # unacked set, not the whole inter-rewrite log); a later lookup for a
+        # pruned id falls back to the live stream.
+        all_payloads: Dict[str, Dict[str, Any]] = collections.defaultdict(dict)
         with open(path, encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
@@ -163,6 +184,7 @@ class _Store:
                 op = rec[0]
                 if op == "A":
                     _, stream, entry_id, payload = rec
+                    all_payloads[stream][entry_id] = payload
                     self._append(stream, entry_id, payload)
                     self._seq = max(self._seq, int(entry_id.split("-")[0]))
                 elif op == "G":
@@ -171,17 +193,35 @@ class _Store:
                     _, stream, group, new_cursor, ids = rec
                     key = (stream, group)
                     self.cursors[key] = new_cursor
-                    by_id = dict(self.streams[stream])
+                    by_id = all_payloads[stream]
+                    live_by_id = None
                     for i in ids:
-                        if i in by_id:
-                            # fresh timestamp: the redeliver list below makes
-                            # the first post-restart delivery; a stale ts would
-                            # ALSO trip the idle-reclaim scan = double delivery
-                            self.pending[key][i] = (by_id[i], time.monotonic())
+                        payload = by_id.get(i)
+                        if payload is None and i not in by_id:
+                            # pruned after an earlier ack but still live in
+                            # the stream (another group reading it)
+                            if live_by_id is None:
+                                live_by_id = dict(self.streams[stream])
+                            if i not in live_by_id:
+                                continue
+                            payload = live_by_id[i]
+                        # fresh timestamp: the redeliver list below makes
+                        # the first post-restart delivery; a stale ts would
+                        # ALSO trip the idle-reclaim scan = double delivery
+                        self.pending[key][i] = (payload, time.monotonic())
                 elif op == "K":
                     _, stream, group, ids = rec
+                    key = (stream, group)
                     for i in ids:
-                        self.pending[(stream, group)].pop(i, None)
+                        self.pending[key].pop(i, None)
+                        # prune unless another group still holds it pending
+                        if not any(i in ents for (s, g), ents
+                                   in self.pending.items()
+                                   if s == stream and (s, g) != key):
+                            all_payloads[stream].pop(i, None)
+                elif op == "P":
+                    _, stream, entry_id, payload = rec
+                    all_payloads[stream][entry_id] = payload
                 elif op == "H":
                     self.hashes[rec[1]] = rec[2]
                 elif op == "D":
@@ -240,11 +280,18 @@ class _Store:
             # then idle unacked entries from a dead/stalled consumer
             # (XAUTOCLAIM semantics)
             if len(out) < count and self.reclaim_idle_ms:
+                taken = {i for i, _ in out}
                 for i, (payload, ts) in self.pending[key].items():
                     if len(out) >= count:
                         break
-                    if (now - ts) * 1e3 >= self.reclaim_idle_ms:
+                    if i not in taken and (now - ts) * 1e3 >= self.reclaim_idle_ms:
                         out.append((i, payload))
+                        taken.add(i)
+                # an idle-reclaimed entry may still sit in the crash-redeliver
+                # queue (replay puts it in both); purge it there or it would be
+                # served a second time from the redeliver path
+                if redo:
+                    self.redeliver[key] = [e for e in redo if e[0] not in taken]
 
             def fresh():
                 return len(self.streams[stream]) - self.cursors[key]

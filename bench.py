@@ -39,6 +39,52 @@ EVAL_USERS = 1000
 # recorded --cpu-reference throughput on this host (1 core), used only if the
 # live CPU subprocess fails
 CPU_FALLBACK_SAMPLES_PER_SEC = 561_000.0
+# rolling record of live CPU-baseline measurements; vs_baseline is computed
+# against the MAX of (live run, recent history) so a live baseline depressed
+# by host-CPU contention (the reference subprocess shares one core with the
+# TPU host loop) can only make the reported ratio SMALLER, never inflate it
+BASELINE_HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                     "BASELINE_HISTORY.json")
+BASELINE_HISTORY_MAX_AGE_S = 14 * 24 * 3600
+
+
+def _baseline_history_load() -> list[dict]:
+    try:
+        with open(BASELINE_HISTORY_PATH) as f:
+            return [e for e in json.load(f)
+                    if time.time() - e.get("t", 0) < BASELINE_HISTORY_MAX_AGE_S]
+    except (OSError, ValueError):
+        return []
+
+
+def _baseline_history_append(samples_per_sec: float) -> None:
+    hist = _baseline_history_load()
+    hist.append({"t": time.time(), "samples_per_sec": samples_per_sec})
+    try:  # atomic replace: a kill mid-write must not destroy the history
+        tmp = BASELINE_HISTORY_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(hist[-50:], f)
+        os.replace(tmp, BASELINE_HISTORY_PATH)
+    except OSError:
+        pass
+
+
+def _enable_persistent_compile_cache() -> None:
+    """Persist XLA executables across bench runs so a re-run inside a short
+    tunnel-up window skips the ~20-40s compile and finishes in seconds."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "BENCH_JAX_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # cache is an optimization, never a failure mode
+        print(f"[bench] persistent compile cache unavailable: {e}",
+              file=sys.stderr)
 
 # peak bf16 FLOP/s per chip by device kind (public TPU specs)
 _PEAK_FLOPS = {
@@ -100,6 +146,7 @@ def run_ncf_implicit(platform: str | None = None, train_epochs: int = 8,
 
     if platform:
         jax.config.update("jax_platforms", platform)
+    _enable_persistent_compile_cache()
 
     from analytics_zoo_tpu.common import (MeshConfig, PrecisionConfig,
                                           RuntimeConfig, TrainConfig,
@@ -149,6 +196,7 @@ def run_ncf(platform: str | None = None, train_epochs: int = TRAIN_EPOCHS) -> di
 
     if platform:
         jax.config.update("jax_platforms", platform)
+    _enable_persistent_compile_cache()
 
     from analytics_zoo_tpu.common import (MeshConfig, PrecisionConfig,
                                           RuntimeConfig, TrainConfig,
@@ -220,6 +268,8 @@ def run_transformer_mfu(seq_len: int = 2048, batch: Optional[int] = None,
     import jax
     import jax.numpy as jnp
     import optax
+
+    _enable_persistent_compile_cache()
 
     from analytics_zoo_tpu.models.transformer import TransformerLM, lm_loss
     from analytics_zoo_tpu.nn.module import compute_dtype, set_policy
@@ -373,10 +423,22 @@ if __name__ == "__main__":
     main = run_ncf(platform=None if on_accel else "cpu")
 
     cpu = _cpu_reference_join(ref_procs[0]) if on_accel else main
+    # baseline policy: vs_baseline divides by the MAX of the live CPU run and
+    # recent recorded live runs, so contention-depressed live baselines can
+    # only shrink the reported ratio (see BASELINE_HISTORY_PATH comment)
+    history_max = max((e["samples_per_sec"] for e in _baseline_history_load()),
+                      default=0.0)
     if cpu is not None:
-        baseline_sps = cpu["samples_per_sec"]
+        live_sps = cpu["samples_per_sec"]
+        _baseline_history_append(live_sps)
         hr_cpu = cpu.get("hr@10")
-        baseline_src = "live_cpu_subprocess"
+        baseline_sps = max(live_sps, history_max)
+        baseline_src = ("live_cpu_subprocess" if live_sps >= history_max
+                        else "max_recent_live_cpu_history")
+    elif history_max > 0:
+        baseline_sps = history_max
+        hr_cpu = None
+        baseline_src = "max_recent_live_cpu_history"
     else:
         baseline_sps = CPU_FALLBACK_SAMPLES_PER_SEC
         hr_cpu = None
@@ -416,6 +478,10 @@ if __name__ == "__main__":
         "hr@10_cpu_reference": hr_cpu,
         "hr@10_gap": (round(main["hr@10"] - hr_cpu, 4)
                       if hr_cpu is not None else None),
+        # the 16-epoch explicit recipe sits near the 0.10 random-ranking
+        # floor by design (throughput recipe); the falsifiable ranking claim
+        # is the "implicit" entry's HR@10 (paper recipe, 0.55+)
+        "hr@10_role": "parity_check_only",
         "baseline_samples_per_sec": baseline_sps,
         "baseline_source": baseline_src,
         "total_samples_per_sec": main["samples_per_sec"],

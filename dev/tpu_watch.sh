@@ -1,0 +1,81 @@
+#!/bin/bash
+# Background TPU-tunnel watcher (VERDICT r3 #1b).
+#
+# The axon tunnel wedges for multi-hour stretches; the end-of-round driver
+# bench has landed in a tunnel-down window two rounds straight. This watcher
+# probes the tunnel every PROBE_EVERY seconds (subprocess + hard timeout — a
+# wedged tunnel hangs jax.devices() forever in-process) and, whenever the
+# tunnel is up and the freshest capture is older than REFRESH_S, re-runs
+# bench.py and serving_bench.py, wrapping the bench output into
+# BENCH_MIDROUND_r04.json. The freshest TPU capture is therefore never more
+# than one up-window old.
+#
+# Usage: nohup bash dev/tpu_watch.sh >/tmp/tpu_watch_r04.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+REPO=$(pwd)
+PROBE_EVERY=${PROBE_EVERY:-240}
+REFRESH_S=${REFRESH_S:-2700}        # re-capture if newest capture >45 min old
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-1800}
+STAMP=/tmp/tpu_watch_r04.last_ok
+
+probe() {
+  timeout 90 python -c \
+    "import jax; d=jax.devices(); assert d[0].platform != 'cpu'" \
+    >/dev/null 2>&1
+}
+
+capture() {
+  echo "[watch $(date -u +%H:%M:%S)] tunnel UP — running bench.py"
+  local out
+  out=$(BENCH_TPU_PROBE_WINDOW_S=0 timeout "$BENCH_TIMEOUT" \
+        python bench.py 2>/tmp/tpu_watch_bench.err | tail -1)
+  if [ -n "$out" ] && echo "$out" | python -c \
+      "import json,sys; r=json.load(sys.stdin); sys.exit(0 if r.get('tpu_available') else 1)" \
+      2>/dev/null; then
+    python - "$out" <<'PYEOF'
+import json, sys, time
+result = json.loads(sys.argv[1])
+wrapped = {
+    "note": ("bench.py output captured by the in-round tunnel watcher "
+             "(dev/tpu_watch.sh) during a tunnel-up window; recorded so the "
+             "round has a fresh TPU datapoint even if the end-of-round "
+             "driver run lands in a tunnel-down window. vs_baseline uses "
+             "the max-of-recent-live-CPU-baselines policy (BASELINE_HISTORY.json)."),
+    "captured_by": "builder tunnel watcher, `python bench.py` on the real chip",
+    "captured_at_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+    "result": result,
+}
+json.dump(wrapped, open("BENCH_MIDROUND_r04.json", "w"), indent=1)
+print("[watch] BENCH_MIDROUND_r04.json updated: value=%s vs_baseline=%s" %
+      (result.get("value"), result.get("vs_baseline")))
+PYEOF
+    date +%s > "$STAMP"
+  else
+    echo "[watch] bench.py produced no TPU capture (tail: $out)"
+    sed -n '$p' /tmp/tpu_watch_bench.err 2>/dev/null
+    return 1
+  fi
+  echo "[watch $(date -u +%H:%M:%S)] running serving_bench.py"
+  BENCH_TPU_PROBE_WINDOW_S=0 timeout 900 python serving_bench.py \
+    >/tmp/tpu_watch_serving.out 2>&1 \
+    && echo "[watch] serving_bench done: $(tail -1 /tmp/tpu_watch_serving.out)" \
+    || echo "[watch] serving_bench failed (see /tmp/tpu_watch_serving.out)"
+}
+
+echo "[watch] started $(date -u) repo=$REPO probe_every=${PROBE_EVERY}s"
+while true; do
+  if probe; then
+    last=0
+    [ -f "$STAMP" ] && last=$(cat "$STAMP")
+    age=$(( $(date +%s) - last ))
+    if [ "$age" -gt "$REFRESH_S" ]; then
+      capture
+    else
+      echo "[watch $(date -u +%H:%M:%S)] tunnel up; capture is ${age}s old — skip"
+    fi
+  else
+    echo "[watch $(date -u +%H:%M:%S)] tunnel down"
+  fi
+  sleep "$PROBE_EVERY"
+done

@@ -236,3 +236,29 @@ def test_bytes_featureset_trains_end_to_end(zoo_ctx):
     model.compile(optimizer="adam", loss="binary_crossentropy")
     model.fit(fs, batch_size=16, nb_epoch=2)
     assert np.isfinite(model.estimator.trainer_state.last_loss)
+
+
+def test_featureset_host_shard_propagates_through_slices_and_transform():
+    """ADVICE r3: slices()/transform() must keep host_shard, or a sliced
+    host-sharded FeatureSet silently reverts to strided-global sharding and
+    each host trains on 1/process_count of its own LOCAL shard."""
+    x = np.arange(16, dtype="float32").reshape(16, 1)
+    fs = FeatureSet.from_host_shard((x,), process_index=1, process_count=2)
+    assert fs.host_shard
+    for derived in (*fs.slices(2), fs.transform(lambda t: t)):
+        assert derived.host_shard, "host_shard dropped by slices()/transform()"
+    # host-shard semantics survive: a global batch of 8 yields the local
+    # half (4 rows) from THIS host's own data, not a stride of it
+    (b,) = next(fs.transform(lambda t: t).batches(8, shuffle=False))
+    assert b.shape == (4, 1)
+    assert set(b.reshape(-1)).issubset(set(x.reshape(-1)))
+
+
+def test_bytes_featureset_host_shard_propagates():
+    from analytics_zoo_tpu.data.featureset import BytesFeatureSet
+
+    recs = [bytes([i]) for i in range(8)]
+    fs = BytesFeatureSet(recs, lambda r: np.frombuffer(r, np.uint8).astype("f4"),
+                         process_index=0, process_count=2, host_shard=True)
+    for derived in (*fs.slices(2), fs.transform(lambda t: t)):
+        assert derived.host_shard

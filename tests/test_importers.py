@@ -435,3 +435,18 @@ def test_onnx_lrn_even_size_window():
         acc = sq[:, lo:hi + 1].sum(axis=1)
         want[:, c] = x[:, c] / (bias + (alpha / size) * acc) ** beta
     np.testing.assert_allclose(model.predict(x), want, atol=1e-5)
+
+
+def test_onnx_cast_unsupported_enum_is_diagnosable():
+    """ADVICE r3: an unsupported TensorProto 'to' enum must raise a ValueError
+    naming the node, not a bare KeyError deep inside execution."""
+    g = Graph(name="badcast")
+    g.initializers = {}
+    g.inputs = [ValueInfo("x", (None, 2))]
+    g.outputs = [ValueInfo("y", ())]
+    g.nodes = [Node("Cast", ["x"], ["y"], name="c0",
+                    attrs={"to": Attribute(name="to", i=8)})]  # 8 = string
+    model = load_onnx(encode_model(g))
+    model.compile(optimizer="adam", loss="mse")
+    with pytest.raises(ValueError, match="c0.*enum 8|enum 8"):
+        model.predict(np.zeros((1, 2), dtype="float32"))

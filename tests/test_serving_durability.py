@@ -228,3 +228,58 @@ def test_two_engines_share_group_and_survive_one_stopping(zoo_ctx, tmp_path):
         b.stop()
         proc.send_signal(signal.SIGKILL)
         proc.wait()
+
+
+def test_store_idle_reclaim_never_double_delivers_redeliver_entries(tmp_path):
+    """ADVICE r3: after a crash-restart an unacked entry sits in BOTH the
+    redeliver queue and the pending map; with a tiny reclaim_idle_ms the idle
+    scan must not serve it a second time alongside the redeliver path."""
+    from analytics_zoo_tpu.serving.broker import _Store
+
+    aof = str(tmp_path / "s.aof")
+    s = _Store(aof_path=aof)
+    s.xgroupcreate("in", "g", "0")
+    for i in range(3):
+        s.xadd("in", {"v": i})
+    got = s.xreadgroup("in", "g", 3, 0)          # deliver all, ack none
+    assert len(got) == 3
+    # crash: new store replays the log -> entries in redeliver AND pending
+    s2 = _Store(aof_path=aof, reclaim_idle_ms=500)
+    time.sleep(0.6)                               # everything is now "idle"
+    out = s2.xreadgroup("in", "g", 10, 0)
+    ids = [i for i, _ in out]
+    assert len(ids) == len(set(ids)) == 3, f"duplicate delivery: {ids}"
+    # delivery refreshed the pending timestamps, so an immediate re-read
+    # reclaims nothing
+    assert s2.xreadgroup("in", "g", 10, 0) == []
+
+
+def test_store_pending_payload_survives_maxlen_trim_and_rewrite(tmp_path):
+    """ADVICE r3: a delivered-but-unacked entry trimmed out of the live stream
+    by maxlen overflow must still be redeliverable after a restart (its payload
+    now rides the rewrite snapshot rather than the live window)."""
+    from analytics_zoo_tpu.serving.broker import _Store
+
+    aof = str(tmp_path / "s.aof")
+    s = _Store(maxlen=4, aof_path=aof)
+    s.xgroupcreate("in", "g", "0")
+    first = s.xadd("in", {"uri": "victim"})
+    (got,) = s.xreadgroup("in", "g", 1, 0)        # deliver, don't ack
+    assert got[0] == first
+    for i in range(6):                            # overflow: "victim" trims out
+        s.xadd("in", {"uri": f"f{i}"})
+    assert all(eid != first for eid, _ in s.streams["in"])
+    # restart #1: replay (A-records still in the raw log) + startup rewrite
+    s2 = _Store(maxlen=4, aof_path=aof, reclaim_idle_ms=60_000)
+    # restart #2: the rewrite snapshot alone must still carry the payload
+    s3 = _Store(maxlen=4, aof_path=aof, reclaim_idle_ms=60_000)
+    out = s3.xreadgroup("in", "g", 10, 0)
+    uris = [p["uri"] for _, p in out]
+    assert "victim" in uris, f"trimmed pending entry lost: {uris}"
+    # restarting with a LARGER maxlen must not resurrect the trimmed entry
+    # into the live window (payload rides a "P" record, not an append) —
+    # otherwise stream indices shift under every group cursor
+    s4 = _Store(maxlen=8, aof_path=aof, reclaim_idle_ms=60_000)
+    assert all(p["uri"] != "victim" for _, p in s4.streams["in"])
+    assert len(s4.streams["in"]) == 4
+    del s, s2, s3, s4

@@ -237,3 +237,26 @@ def test_recalibrate_batchnorm_closes_train_eval_gap(zoo_ctx):
     drop = [l for l in net.layers if isinstance(l, L.Dropout)][0]
     bn = [l for l in net.layers if isinstance(l, L.BatchNormalization)][0]
     assert drop.rate == 0.3 and bn.momentum == 0.99
+
+
+def test_recalibrate_batchnorm_rejects_dict_batches_for_graph_models(zoo_ctx):
+    """ADVICE r3: dict-tree FeatureSets can't be split into inputs/labels for
+    positional graph models — recalibrate must raise a clear ValueError, not
+    crash with a TypeError on hb[:n_in]."""
+    import pytest as _pytest
+
+    from analytics_zoo_tpu.data import FeatureSet
+    from analytics_zoo_tpu.nn import Input, Model
+    from analytics_zoo_tpu.nn import layers as L
+
+    inp = Input((4,))
+    out = L.Dense(2)(L.BatchNormalization()(inp))
+    net = Model(inp, out)
+    net.compile(optimizer="adam", loss="mse")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 4)).astype("float32")
+    net.fit(x, rng.standard_normal((32, 2)).astype("float32"),
+            batch_size=16, nb_epoch=1)
+    fs = FeatureSet({"x": x, "y": np.zeros((32, 2), "float32")})
+    with _pytest.raises(ValueError, match="dict-tree"):
+        net.estimator.recalibrate_batchnorm(fs, batch_size=16)
