@@ -284,14 +284,13 @@ class _Store:
                 for i, (payload, ts) in self.pending[key].items():
                     if len(out) >= count:
                         break
+                    # `taken` guards replay double-entries: an entry served
+                    # from the redeliver queue above is still in pending with
+                    # its pre-serve timestamp until this call commits, so the
+                    # idle scan could otherwise pick it a second time
                     if i not in taken and (now - ts) * 1e3 >= self.reclaim_idle_ms:
                         out.append((i, payload))
                         taken.add(i)
-                # an idle-reclaimed entry may still sit in the crash-redeliver
-                # queue (replay puts it in both); purge it there or it would be
-                # served a second time from the redeliver path
-                if redo:
-                    self.redeliver[key] = [e for e in redo if e[0] not in taken]
 
             def fresh():
                 return len(self.streams[stream]) - self.cursors[key]

@@ -46,7 +46,9 @@ wrapped = {
     "captured_at_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
     "result": result,
 }
-json.dump(wrapped, open("BENCH_MIDROUND_r04.json", "w"), indent=1)
+import os
+json.dump(wrapped, open("BENCH_MIDROUND_r04.json.tmp", "w"), indent=1)
+os.replace("BENCH_MIDROUND_r04.json.tmp", "BENCH_MIDROUND_r04.json")
 print("[watch] BENCH_MIDROUND_r04.json updated: value=%s vs_baseline=%s" %
       (result.get("value"), result.get("vs_baseline")))
 PYEOF
