@@ -1,0 +1,179 @@
+"""BERT fine-tuning heads beyond classification: token tagging (NER) and
+extractive QA (SQuAD).
+
+Parity: ``pyzoo/zoo/tfpark/text/estimator/bert_ner.py:49`` (BERTNER — dense
+softmax over the final encoder sequence output, masked token-level
+cross-entropy) and ``bert_squad.py:77`` (BERTSQuAD — a 2-unit dense head whose
+columns are start/end span logits trained with mean start/end cross-entropy).
+
+TPU-first design notes: where the reference builds a tf.estimator graph per
+mode around a JNI-driven BERT, here encoder+head is one jittable program and
+fit/evaluate/predict come from the KerasNet facade; padding is carried in the
+labels (``pad_tag``/-1) instead of a separate ``input_mask`` feature so the
+train step stays a fixed-shape (ids, labels) pair.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...nn.layers.attention import BERT
+from ...nn.module import Layer, get_initializer, param_dtype
+from ...nn.topology import KerasNet
+from ..common.zoo_model import register_model
+
+PAD_TAG = -1
+
+
+def ner_token_loss(y_true, y_pred):
+    """Masked token-level cross-entropy (bert_ner.py:28-37 parity: loss is
+    summed over real tokens and normalized by their count). ``y_true`` (B, T)
+    int with PAD_TAG on padding; ``y_pred`` (B, T, E) log-probabilities."""
+    y_pred = y_pred.astype(jnp.float32)
+    mask = (y_true != PAD_TAG).astype(jnp.float32)
+    labels = jnp.maximum(y_true, 0)
+    ll = jnp.take_along_axis(y_pred, labels[..., None], axis=-1)[..., 0]
+    return -(ll * mask).sum() / (mask.sum() + 1e-12)
+
+
+def squad_span_loss(y_true, y_pred):
+    """Mean of start/end position cross-entropies (bert_squad.py:46-60
+    parity). ``y_true`` (B, 2) int [start, end]; ``y_pred`` (B, 2, T)
+    log-softmax over positions."""
+    y_pred = y_pred.astype(jnp.float32)
+    ll = jnp.take_along_axis(y_pred, y_true[..., None].astype(jnp.int32),
+                             axis=-1)[..., 0]               # (B, 2)
+    return -jnp.mean(ll)
+
+
+class _BERTHeadBase(Layer, KerasNet):
+    """Shared encoder plumbing for the fine-tune heads."""
+
+    def __init__(self, head_units: int, vocab: int = 30522,
+                 hidden_size: int = 256, n_block: int = 4, n_head: int = 4,
+                 seq_len: int = 128, intermediate_size: Optional[int] = None,
+                 dropout: float = 0.1, name=None):
+        super().__init__(name=name)
+        self.head_units = int(head_units)
+        self.dropout = float(dropout)
+        self.cfg = dict(vocab=vocab, hidden_size=hidden_size, n_block=n_block,
+                        n_head=n_head, seq_len=seq_len,
+                        intermediate_size=intermediate_size or 4 * hidden_size)
+        self.bert = BERT(vocab=vocab, hidden_size=hidden_size, n_block=n_block,
+                         n_head=n_head, seq_len=seq_len,
+                         intermediate_size=self.cfg["intermediate_size"],
+                         name=f"{self.name}_bert")
+
+    @property
+    def input_shape(self):
+        return (self.cfg["seq_len"],)
+
+    def build(self, rng, input_shape=None):
+        k_bert, k_head = jax.random.split(rng)
+        bert_p, _ = self.bert.build(k_bert, input_shape)
+        head_k = get_initializer("glorot_uniform")(
+            k_head, (self.cfg["hidden_size"], self.head_units), param_dtype())
+        return {"bert": bert_p, "head_kernel": head_k,
+                "head_bias": jnp.zeros((self.head_units,), param_dtype())}, {}
+
+    def _sequence_logits(self, params, x, *, training, rng):
+        """(B, T, head_units) logits over the final encoder sequence output."""
+        k_drop = k_bert = rng
+        if rng is not None:
+            k_bert, k_drop = jax.random.split(rng)
+        (seq, _pooled), _ = self.bert.apply(params["bert"], {}, x,
+                                            training=training, rng=k_bert)
+        if training and self.dropout > 0:
+            keep = 1.0 - self.dropout
+            mask = jax.random.bernoulli(k_drop, keep, seq.shape)
+            seq = jnp.where(mask, seq / keep, 0.0).astype(seq.dtype)
+        return seq @ jnp.asarray(params["head_kernel"], seq.dtype) \
+            + jnp.asarray(params["head_bias"], seq.dtype)
+
+    def constructor_config(self):
+        return dict(dropout=self.dropout, **self.cfg)
+
+    def save_model(self, path: str):
+        from ..common.zoo_model import save_model_bundle
+
+        save_model_bundle(path, self, config=self.constructor_config())
+
+
+@register_model("BERTNER")
+class BERTNER(_BERTHeadBase):
+    """ids (B, T) [or [ids, segment_ids]] → per-token entity log-probs
+    (B, T, num_entities). Train with :func:`ner_token_loss` (labels padded
+    with PAD_TAG); cased vocabularies recommended, as in the reference."""
+
+    def __init__(self, num_entities: int, **kw):
+        self.num_entities = int(num_entities)
+        super().__init__(head_units=self.num_entities, **kw)
+
+    loss = staticmethod(ner_token_loss)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        logits = self._sequence_logits(params, x, training=training, rng=rng)
+        return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1), state
+
+    def predict_tags(self, x, batch_size: int = 32):
+        """argmax entity ids (B, T) — the PREDICT-mode output of the
+        reference's estimator spec (bert_ner.py:41-43)."""
+        import numpy as np
+
+        logp = self.predict(x, batch_size=batch_size)
+        return np.argmax(np.asarray(logp), axis=-1)
+
+    def compute_output_shape(self, input_shape):
+        return (self.cfg["seq_len"], self.num_entities)
+
+    def constructor_config(self):
+        return dict(num_entities=self.num_entities,
+                    **super().constructor_config())
+
+    @classmethod
+    def load_model(cls, path: str) -> "BERTNER":
+        from ..common.zoo_model import load_model_bundle
+
+        model, _ = load_model_bundle(path)
+        model.compile(optimizer="adam", loss=cls.loss)  # ready to predict
+        return model
+
+
+@register_model("BERTSQuAD")
+class BERTSQuAD(_BERTHeadBase):
+    """ids (B, T) [or [ids, segment_ids]] → (B, 2, T) start/end position
+    log-probs. Train with :func:`squad_span_loss` on (B, 2) [start, end]
+    labels; ``predict_spans`` returns the argmax span per example."""
+
+    def __init__(self, **kw):
+        super().__init__(head_units=2, **kw)
+
+    loss = staticmethod(squad_span_loss)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        logits = self._sequence_logits(params, x, training=training, rng=rng)
+        # (B, T, 2) -> (B, 2, T): each row is a distribution over positions
+        logits = jnp.swapaxes(logits, 1, 2).astype(jnp.float32)
+        return jax.nn.log_softmax(logits, axis=-1), state
+
+    def predict_spans(self, x, batch_size: int = 32):
+        """(start, end) argmax positions, each (B,) — the reference PREDICT
+        output carries start/end logits per unique_id (bert_squad.py:64-69)."""
+        import numpy as np
+
+        logp = np.asarray(self.predict(x, batch_size=batch_size))
+        return logp[:, 0].argmax(-1), logp[:, 1].argmax(-1)
+
+    def compute_output_shape(self, input_shape):
+        return (2, self.cfg["seq_len"])
+
+    @classmethod
+    def load_model(cls, path: str) -> "BERTSQuAD":
+        from ..common.zoo_model import load_model_bundle
+
+        model, _ = load_model_bundle(path)
+        model.compile(optimizer="adam", loss=cls.loss)  # ready to predict
+        return model

@@ -1,0 +1,415 @@
+"""Keras-level sequence-labelling text models: NER, SequenceTagger (POS +
+chunk), IntentEntity (joint intent + slots).
+
+Parity: ``pyzoo/zoo/tfpark/text/keras/ner.py:21`` (word+char BiLSTM with a CRF
+sequence classifier), ``pos_tagging.py:22`` (SequenceTagger: BiLSTM stack with
+softmax-or-CRF chunk head and a POS head) and ``intent_extraction.py:21``
+(IntentEntity: multi-task intent classification + slot tagging). The reference
+delegates to nlp-architect Keras graphs; here each model is one jittable
+module over this repo's Embedding/Bidirectional-LSTM/CRF layers.
+
+TPU-first notes: the char feature extractor reshapes (B, T, W) → (B·T, W) so
+the per-word BiLSTM runs as ONE batched scan (no vmap over words); the CRF
+loss/decode are dense ``lax.scan`` dynamic programs (nn/layers/crf.py); all
+sequence lengths are static — padding rides the label tensor (pad_tag=-1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...nn.layers.crf import (CRF, crf_decode, crf_log_likelihood,
+                              crf_nll_from_packed)
+from ...nn.layers.embedding import Embedding
+from ...nn.layers.recurrent import LSTM, Bidirectional
+from ...nn.module import Layer, get_initializer, param_dtype
+from ...nn.topology import KerasNet
+from ..common.zoo_model import register_model
+
+PAD_TAG = -1
+
+
+def masked_tag_loss(y_true, y_pred):
+    """Masked sparse CE over (B, T) int tags vs (B, T, E) probabilities."""
+    logp = jnp.log(jnp.clip(y_pred.astype(jnp.float32), 1e-12, 1.0))
+    mask = (y_true != PAD_TAG).astype(jnp.float32)
+    labels = jnp.maximum(y_true, 0)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -(ll * mask).sum() / (mask.sum() + 1e-12)
+
+
+def crf_tag_loss(y_true, y_pred):
+    """CRF NLL given a ``(emissions, packed_energies)`` model output pair;
+    PAD_TAG label positions are masked ('pad' crf_mode)."""
+    emissions, packed = y_pred
+    return crf_nll_from_packed(y_true, emissions, packed, pad_tag=PAD_TAG)
+
+
+def crf_tag_loss_reg(y_true, y_pred):
+    """CRF NLL scoring FULL-length sequences — the reference's 'reg' crf_mode
+    (all sequences equal length, no masking)."""
+    emissions, packed = y_pred
+    mask = jnp.ones(y_true.shape, bool)
+    trans, start, end = CRF.unpack(packed[0])
+    ll = crf_log_likelihood(emissions, jnp.maximum(y_true, 0), mask,
+                            trans, start, end)
+    return -jnp.mean(ll)
+
+
+def _dense_params(rng, in_dim, out):
+    k = get_initializer("glorot_uniform")(rng, (in_dim, out), param_dtype())
+    return {"kernel": k, "bias": jnp.zeros((out,), param_dtype())}
+
+
+def _dense(p, x):
+    return x @ jnp.asarray(p["kernel"], x.dtype) + jnp.asarray(p["bias"], x.dtype)
+
+
+def _dropout(x, rate, training, rng):
+    if not training or rate <= 0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class _WordCharEncoder(Layer):
+    """[word_ids (B, T), char_ids (B, T, W)] → (B, T, D_word + 2·char_dim):
+    word embeddings concatenated with a per-word char-BiLSTM summary."""
+
+    def __init__(self, word_vocab_size, char_vocab_size, word_emb_dim,
+                 char_emb_dim, char_lstm_dim=None, name=None):
+        super().__init__(name=name)
+        self.word_emb = Embedding(word_vocab_size, word_emb_dim,
+                                  name=f"{self.name}_wemb")
+        self.char_emb = Embedding(char_vocab_size, char_emb_dim,
+                                  name=f"{self.name}_cemb")
+        self.char_rnn = Bidirectional(
+            LSTM(char_lstm_dim or char_emb_dim, name=f"{self.name}_clstm"))
+        self.out_dim = word_emb_dim + 2 * (char_lstm_dim or char_emb_dim)
+        self._char_emb_dim = char_emb_dim
+
+    def build(self, rng, input_shape=None):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        wp, _ = self.word_emb.build(k1, None)
+        cp, _ = self.char_emb.build(k2, None)
+        rp, _ = self.char_rnn.build(k3, (None, self._char_emb_dim))
+        return {"word": wp, "char": cp, "char_rnn": rp}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        word_ids, char_ids = x
+        w, _ = self.word_emb.apply(params["word"], {}, word_ids)
+        c, _ = self.char_emb.apply(params["char"], {}, char_ids)
+        b, t, wl, d = c.shape
+        # one batched scan over (B·T, W, D) — the TPU-friendly layout
+        cf, _ = self.char_rnn.apply(params["char_rnn"], {},
+                                    c.reshape(b * t, wl, d))
+        return jnp.concatenate([w, cf.reshape(b, t, -1)], axis=-1), state
+
+
+@register_model("NER")
+class NER(Layer, KerasNet):
+    """Word+char BiLSTM-CRF named-entity tagger (ner.py:21 parity).
+
+    Inputs: [word indices (B, T), char indices (B, T, word_length)].
+    Output: ``(emissions (B, T, E), packed CRF energies)`` — train with
+    ``model.loss`` (CRF NLL); ``predict_tags`` runs Viterbi decoding.
+    ``crf_mode='reg'`` scores full-length sequences (the default, like the
+    reference); ``'pad'`` handles padded batches — PAD_TAG label positions
+    are masked at training time and word id 0 marks padding at decode.
+    """
+
+    # class-level default ('reg'); __init__ rebinds per crf_mode
+    loss = staticmethod(crf_tag_loss_reg)
+
+    def __init__(self, num_entities: int, word_vocab_size: int,
+                 char_vocab_size: int, word_length: int = 12,
+                 word_emb_dim: int = 100, char_emb_dim: int = 30,
+                 tagger_lstm_dim: int = 100, dropout: float = 0.5,
+                 crf_mode: str = "reg", name=None):
+        super().__init__(name=name)
+        if crf_mode not in ("reg", "pad"):
+            raise ValueError("crf_mode should be either 'reg' or 'pad'")
+        self.crf_mode = crf_mode
+        self.loss = crf_tag_loss if crf_mode == "pad" else crf_tag_loss_reg
+        self.config = dict(num_entities=num_entities,
+                           word_vocab_size=word_vocab_size,
+                           char_vocab_size=char_vocab_size,
+                           word_length=word_length, word_emb_dim=word_emb_dim,
+                           char_emb_dim=char_emb_dim,
+                           tagger_lstm_dim=tagger_lstm_dim, dropout=dropout,
+                           crf_mode=crf_mode)
+        self.num_entities = int(num_entities)
+        self.dropout = float(dropout)
+        self.encoder = _WordCharEncoder(word_vocab_size, char_vocab_size,
+                                        word_emb_dim, char_emb_dim,
+                                        name=f"{self.name}_enc")
+        self.rnn1 = Bidirectional(LSTM(tagger_lstm_dim, return_sequences=True,
+                                       name=f"{self.name}_tag1"))
+        self.rnn2 = Bidirectional(LSTM(tagger_lstm_dim, return_sequences=True,
+                                       name=f"{self.name}_tag2"))
+        self.crf = CRF(self.num_entities, name=f"{self.name}_crf")
+
+    def build(self, rng, input_shape=None):
+        ks = jax.random.split(rng, 5)
+        enc_p, _ = self.encoder.build(ks[0])
+        d = self.encoder.out_dim
+        r1, _ = self.rnn1.build(ks[1], (None, d))
+        h = 2 * self.rnn1.forward.output_dim
+        r2, _ = self.rnn2.build(ks[2], (None, h))
+        head = _dense_params(ks[3], h, self.num_entities)
+        crf_p, _ = self.crf.build(ks[4])
+        return {"enc": enc_p, "rnn1": r1, "rnn2": r2, "head": head,
+                "crf": crf_p}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        h, _ = self.encoder.apply(params["enc"], {}, x, training=training)
+        h = _dropout(h, self.dropout, training, rng)
+        h, _ = self.rnn1.apply(params["rnn1"], {}, h)
+        h, _ = self.rnn2.apply(params["rnn2"], {}, h)
+        emissions = _dense(params["head"], h)
+        return self.crf.apply(params["crf"], {}, emissions)[0], state
+
+    def predict_tags(self, x, batch_size: int = 32):
+        """Viterbi-decoded entity ids (B, T). In 'pad' mode word id 0 marks
+        padding and those positions decode to tag 0."""
+        import numpy as np
+
+        emissions, packed = self.predict(x, batch_size=batch_size)
+        trans, start, end = CRF.unpack(jnp.asarray(packed[0]))
+        if self.crf_mode == "pad":
+            words = x[0] if isinstance(x, (list, tuple)) else x
+            mask = jnp.asarray(words) != 0
+        else:
+            mask = jnp.ones(emissions.shape[:2], bool)
+        return np.asarray(crf_decode(jnp.asarray(emissions), mask,
+                                     trans, start, end))
+
+    def compute_output_shape(self, input_shape):
+        t = input_shape[0][0] if input_shape else None
+        return [(t, self.num_entities),
+                (self.num_entities + 2, self.num_entities)]
+
+    def save_model(self, path: str):
+        from ..common.zoo_model import save_model_bundle
+
+        save_model_bundle(path, self, config=self.config)
+
+    @classmethod
+    def load_model(cls, path: str) -> "NER":
+        from ..common.zoo_model import load_model_bundle
+
+        model, _ = load_model_bundle(path)
+        model.compile(optimizer="adam", loss=model.loss)  # ready to predict
+        return model
+
+
+@register_model("SequenceTagger")
+class SequenceTagger(Layer, KerasNet):
+    """Three-BiLSTM sentence tagger with POS and chunk heads
+    (pos_tagging.py:22 parity).
+
+    Inputs: word indices (B, T), plus char indices (B, T, word_length) when
+    ``char_vocab_size`` is set. Outputs ``(pos_probs (B, T, P),
+    chunk_probs (B, T, C))`` with ``classifier='softmax'`` — train with
+    ``SequenceTagger.loss`` — or ``(pos_probs, chunk_emissions, packed)`` with
+    ``classifier='crf'`` and ``SequenceTagger.crf_loss`` (labels y = (pos,
+    chunk) int pairs, PAD_TAG-padded).
+    """
+
+    def __init__(self, num_pos_labels: int, num_chunk_labels: int,
+                 word_vocab_size: int, char_vocab_size: Optional[int] = None,
+                 word_length: int = 12, feature_size: int = 100,
+                 dropout: float = 0.2, classifier: str = "softmax", name=None):
+        super().__init__(name=name)
+        classifier = classifier.lower()
+        if classifier not in ("softmax", "crf"):
+            raise ValueError("classifier should be either softmax or crf")
+        self.config = dict(num_pos_labels=num_pos_labels,
+                           num_chunk_labels=num_chunk_labels,
+                           word_vocab_size=word_vocab_size,
+                           char_vocab_size=char_vocab_size,
+                           word_length=word_length, feature_size=feature_size,
+                           dropout=dropout, classifier=classifier)
+        self.num_pos = int(num_pos_labels)
+        self.num_chunk = int(num_chunk_labels)
+        self.classifier = classifier
+        self.dropout = float(dropout)
+        self.has_char = char_vocab_size is not None
+        if self.has_char:
+            self.encoder = _WordCharEncoder(word_vocab_size, char_vocab_size,
+                                            feature_size, feature_size // 2,
+                                            name=f"{self.name}_enc")
+            in_dim = self.encoder.out_dim
+        else:
+            self.word_emb = Embedding(word_vocab_size, feature_size,
+                                      name=f"{self.name}_wemb")
+            in_dim = feature_size
+        self._in_dim = in_dim
+        self.rnns = [Bidirectional(LSTM(feature_size, return_sequences=True,
+                                        name=f"{self.name}_l{i}"))
+                     for i in range(3)]
+        if classifier == "crf":
+            self.crf = CRF(self.num_chunk, name=f"{self.name}_crf")
+
+    @staticmethod
+    def loss(y_true, y_pred):
+        """softmax mode: summed masked CE of the POS and chunk heads."""
+        pos_y, chunk_y = y_true
+        pos_p, chunk_p = y_pred
+        return masked_tag_loss(pos_y, pos_p) + masked_tag_loss(chunk_y, chunk_p)
+
+    @staticmethod
+    def crf_loss(y_true, y_pred):
+        """crf mode: POS softmax CE + chunk CRF NLL."""
+        pos_y, chunk_y = y_true
+        pos_p, chunk_em, packed = y_pred
+        return masked_tag_loss(pos_y, pos_p) \
+            + crf_tag_loss(chunk_y, (chunk_em, packed))
+
+    def build(self, rng, input_shape=None):
+        ks = jax.random.split(rng, 7)
+        if self.has_char:
+            enc_p, _ = self.encoder.build(ks[0])
+            params = {"enc": enc_p}
+        else:
+            wp, _ = self.word_emb.build(ks[0], None)
+            params = {"wemb": wp}
+        d = self._in_dim
+        for i, rnn in enumerate(self.rnns):
+            p, _ = rnn.build(ks[1 + i], (None, d))
+            params[f"rnn{i}"] = p
+            d = 2 * rnn.forward.output_dim
+        params["pos_head"] = _dense_params(ks[4], d, self.num_pos)
+        params["chunk_head"] = _dense_params(ks[5], d, self.num_chunk)
+        if self.classifier == "crf":
+            params["crf"], _ = self.crf.build(ks[6])
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if self.has_char:
+            h, _ = self.encoder.apply(params["enc"], {}, x, training=training)
+        else:
+            ids = x[0] if isinstance(x, (list, tuple)) else x
+            h, _ = self.word_emb.apply(params["wemb"], {}, ids)
+        h = _dropout(h, self.dropout, training, rng)
+        for i, rnn in enumerate(self.rnns):
+            h, _ = rnn.apply(params[f"rnn{i}"], {}, h)
+        pos = jax.nn.softmax(
+            _dense(params["pos_head"], h).astype(jnp.float32), axis=-1)
+        chunk_logits = _dense(params["chunk_head"], h)
+        if self.classifier == "crf":
+            (em, packed), _ = self.crf.apply(params["crf"], {}, chunk_logits)
+            return (pos, em, packed), state
+        chunk = jax.nn.softmax(chunk_logits.astype(jnp.float32), axis=-1)
+        return (pos, chunk), state
+
+    def compute_output_shape(self, input_shape):
+        t = None
+        if self.classifier == "crf":
+            return [(t, self.num_pos), (t, self.num_chunk),
+                    (self.num_chunk + 2, self.num_chunk)]
+        return [(t, self.num_pos), (t, self.num_chunk)]
+
+    def save_model(self, path: str):
+        from ..common.zoo_model import save_model_bundle
+
+        save_model_bundle(path, self, config=self.config)
+
+    @classmethod
+    def load_model(cls, path: str) -> "SequenceTagger":
+        from ..common.zoo_model import load_model_bundle
+
+        model, _ = load_model_bundle(path)
+        loss = cls.crf_loss if model.classifier == "crf" else cls.loss
+        model.compile(optimizer="adam", loss=loss)  # ready to predict
+        return model
+
+
+# the reference exposes the same model under the POS-tagging module name
+POSTagger = SequenceTagger
+
+
+@register_model("IntentEntity")
+class IntentEntity(Layer, KerasNet):
+    """Joint intent classification + slot filling (intent_extraction.py:21
+    parity).
+
+    Inputs: [word indices (B, T), char indices (B, T, word_length)].
+    Outputs ``(intent_probs (B, num_intents), slot_probs (B, T,
+    num_entities))``; train with ``IntentEntity.loss`` on labels
+    ``(intent (B,), slots (B, T))`` (slots PAD_TAG-padded).
+    """
+
+    def __init__(self, num_intents: int, num_entities: int,
+                 word_vocab_size: int, char_vocab_size: int,
+                 word_length: int = 12, word_emb_dim: int = 100,
+                 char_emb_dim: int = 30, char_lstm_dim: int = 30,
+                 tagger_lstm_dim: int = 100, dropout: float = 0.2, name=None):
+        super().__init__(name=name)
+        self.config = dict(num_intents=num_intents, num_entities=num_entities,
+                           word_vocab_size=word_vocab_size,
+                           char_vocab_size=char_vocab_size,
+                           word_length=word_length, word_emb_dim=word_emb_dim,
+                           char_emb_dim=char_emb_dim,
+                           char_lstm_dim=char_lstm_dim,
+                           tagger_lstm_dim=tagger_lstm_dim, dropout=dropout)
+        self.num_intents = int(num_intents)
+        self.num_entities = int(num_entities)
+        self.dropout = float(dropout)
+        self.encoder = _WordCharEncoder(word_vocab_size, char_vocab_size,
+                                        word_emb_dim, char_emb_dim,
+                                        char_lstm_dim=char_lstm_dim,
+                                        name=f"{self.name}_enc")
+        self.tagger = Bidirectional(LSTM(tagger_lstm_dim, return_sequences=True,
+                                         name=f"{self.name}_tag"))
+
+    @staticmethod
+    def loss(y_true, y_pred):
+        intent_y, slot_y = y_true
+        intent_p, slot_p = y_pred
+        intent_ll = jnp.take_along_axis(
+            jnp.log(jnp.clip(intent_p.astype(jnp.float32), 1e-12, 1.0)),
+            intent_y[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        return -jnp.mean(intent_ll) + masked_tag_loss(slot_y, slot_p)
+
+    def build(self, rng, input_shape=None):
+        ks = jax.random.split(rng, 4)
+        enc_p, _ = self.encoder.build(ks[0])
+        tag_p, _ = self.tagger.build(ks[1], (None, self.encoder.out_dim))
+        h = 2 * self.tagger.forward.output_dim
+        return {"enc": enc_p, "tagger": tag_p,
+                "intent_head": _dense_params(ks[2], h, self.num_intents),
+                "slot_head": _dense_params(ks[3], h, self.num_entities)}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        h, _ = self.encoder.apply(params["enc"], {}, x, training=training)
+        h = _dropout(h, self.dropout, training, rng)
+        h, _ = self.tagger.apply(params["tagger"], {}, h)
+        # intent reads the mean-pooled tagger states (fixed-shape analog of
+        # the reference's final-state readout)
+        intent = jax.nn.softmax(
+            _dense(params["intent_head"], h.mean(axis=1)).astype(jnp.float32),
+            axis=-1)
+        slots = jax.nn.softmax(
+            _dense(params["slot_head"], h).astype(jnp.float32), axis=-1)
+        return (intent, slots), state
+
+    def compute_output_shape(self, input_shape):
+        return [(self.num_intents,), (None, self.num_entities)]
+
+    def save_model(self, path: str):
+        from ..common.zoo_model import save_model_bundle
+
+        save_model_bundle(path, self, config=self.config)
+
+    @classmethod
+    def load_model(cls, path: str) -> "IntentEntity":
+        from ..common.zoo_model import load_model_bundle
+
+        model, _ = load_model_bundle(path)
+        model.compile(optimizer="adam", loss=cls.loss)  # ready to predict
+        return model

@@ -31,6 +31,7 @@ from .attention import (BERT, MultiHeadAttention, PositionalEmbedding,
                         TransformerLayer)
 from .embedding import (Embedding, FusedPairEmbedding, SparseEmbedding,
                         WordEmbedding)
+from .crf import CRF, crf_decode, crf_log_likelihood
 from .merge import Merge, merge
 from .normalization import BatchNormalization, LayerNormalization
 from .recurrent import (GRU, LSTM, Bidirectional, ConvLSTM2D, ConvLSTM3D,
@@ -50,8 +51,9 @@ __all__ = [
     "Activation", "AddConstant", "AtrousConvolution1D", "AtrousConvolution2D",
     "AveragePooling1D", "AveragePooling2D", "AveragePooling3D",
     "BatchNormalization", "Bidirectional", "BinaryThreshold", "CAdd", "CMul",
-    "Conv1D", "Conv2D", "Conv3D", "ConvLSTM2D", "ConvLSTM3D", "Convolution1D",
-    "Convolution2D", "Convolution3D", "Cropping1D", "Cropping2D", "Cropping3D",
+    "CRF", "Conv1D", "Conv2D", "Conv3D", "ConvLSTM2D", "ConvLSTM3D",
+    "Convolution1D", "Convolution2D", "Convolution3D", "Cropping1D",
+    "Cropping2D", "Cropping3D", "crf_decode", "crf_log_likelihood",
     "Deconvolution2D", "Dense", "DepthwiseConv2D", "Dropout", "ELU", "Embedding", "FusedPairEmbedding",
     "ERF", "Exp", "Expand", "ExpandDim", "Flatten", "GRU", "GaussianDropout",
     "GaussianNoise", "GaussianSampler", "GetShape", "GlobalAveragePooling1D",

@@ -1,0 +1,148 @@
+"""Linear-chain CRF — the sequence classifier behind the reference's NER/tagger
+models (pyzoo/zoo/tfpark/text/keras/ner.py:21 uses nlp-architect's NERCRF;
+intent_extraction/pos models tag with a CRF head as well).
+
+TPU-native design: both the partition function (forward algorithm) and Viterbi
+decoding are ``lax.scan`` over time with dense (B, E) carries — no Python
+loops, no dynamic shapes; the (B, E, E) score tensor per step is tiny (E =
+label count) and fuses into vector ops. Padding is handled with a boolean mask
+so batches stay rectangular (the reference's 'pad' crf_mode).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..module import Layer, param_dtype
+
+
+def crf_log_likelihood(emissions, tags, mask, transitions, start, end):
+    """log p(tags | emissions) per sequence.
+
+    emissions: (B, T, E) float; tags: (B, T) int (positions with mask==0 are
+    ignored); mask: (B, T) bool/0-1, True on real tokens (must be a prefix —
+    left-aligned sequences); transitions: (E, E); start/end: (E,).
+    """
+    emissions = emissions.astype(jnp.float32)
+    transitions = transitions.astype(jnp.float32)
+    start = start.astype(jnp.float32)
+    end = end.astype(jnp.float32)
+    mask = mask.astype(jnp.float32)
+    b, t, e = emissions.shape
+    tags = jnp.clip(tags, 0, e - 1)
+
+    # ---- numerator: score of the given path
+    em_score = jnp.take_along_axis(emissions, tags[..., None],
+                                   axis=2)[..., 0]          # (B, T)
+    em_score = (em_score * mask).sum(axis=1)
+    trans_score = transitions[tags[:, :-1], tags[:, 1:]]    # (B, T-1)
+    trans_score = (trans_score * mask[:, 1:]).sum(axis=1)
+    last_idx = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
+    last_tag = jnp.take_along_axis(tags, last_idx[:, None], axis=1)[:, 0]
+    path = em_score + trans_score + start[tags[:, 0]] + end[last_tag]
+
+    # ---- denominator: log partition via the forward algorithm
+    def step(alpha, xs):
+        em_t, m_t = xs                                      # (B, E), (B,)
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + transitions[None], axis=1)
+        nxt = nxt + em_t
+        alpha = jnp.where(m_t[:, None] > 0, nxt, alpha)     # hold at padding
+        return alpha, None
+
+    alpha0 = start[None] + emissions[:, 0]
+    xs = (jnp.swapaxes(emissions[:, 1:], 0, 1),
+          jnp.swapaxes(mask[:, 1:], 0, 1))
+    alpha, _ = jax.lax.scan(step, alpha0, xs)
+    log_z = jax.nn.logsumexp(alpha + end[None], axis=1)
+    return path - log_z
+
+
+def crf_decode(emissions, mask, transitions, start, end):
+    """Viterbi: most-likely tag path, (B, T) int32. Same conventions as
+    :func:`crf_log_likelihood`; padded positions return tag 0."""
+    emissions = emissions.astype(jnp.float32)
+    transitions = transitions.astype(jnp.float32)
+    mask_f = mask.astype(jnp.float32)
+    b, t, e = emissions.shape
+
+    def fwd(alpha, xs):
+        em_t, m_t = xs
+        scores = alpha[:, :, None] + transitions[None]      # (B, E, E)
+        best_prev = jnp.argmax(scores, axis=1)              # (B, E)
+        nxt = jnp.max(scores, axis=1) + em_t
+        nxt = jnp.where(m_t[:, None] > 0, nxt, alpha)
+        # padded steps keep the identity backpointer so the backtrace
+        # passes through them unchanged
+        ident = jnp.broadcast_to(jnp.arange(e, dtype=best_prev.dtype)[None],
+                                 (b, e))
+        best_prev = jnp.where(m_t[:, None] > 0, best_prev, ident)
+        return nxt, best_prev
+
+    alpha0 = start.astype(jnp.float32)[None] + emissions[:, 0]
+    xs = (jnp.swapaxes(emissions[:, 1:], 0, 1),
+          jnp.swapaxes(mask_f[:, 1:], 0, 1))
+    alpha, back = jax.lax.scan(fwd, alpha0, xs)             # back: (T-1, B, E)
+    last = jnp.argmax(alpha + end.astype(jnp.float32)[None], axis=1)  # (B,)
+
+    def bwd(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first, rest = jax.lax.scan(bwd, last, back, reverse=True)
+    tags = jnp.concatenate([first[None], rest], axis=0)     # (T, B)
+    tags = jnp.swapaxes(tags, 0, 1).astype(jnp.int32)
+    return jnp.where(mask.astype(bool), tags, 0)
+
+
+class CRF(Layer):
+    """CRF head over emission scores (B, T, E).
+
+    ``apply`` passes emissions through together with the (tiled) transition
+    parameters — ``(emissions, start_end_trans)`` — so downstream losses can
+    compute the exact negative log-likelihood through the standard
+    ``f(y_true, y_pred)`` interface and gradients reach the transitions.
+    """
+
+    def __init__(self, num_tags: int, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.num_tags = int(num_tags)
+
+    def build(self, rng, input_shape=None):
+        e = self.num_tags
+        return {"transitions": jnp.zeros((e, e), param_dtype()),
+                "start": jnp.zeros((e,), param_dtype()),
+                "end": jnp.zeros((e,), param_dtype())}, {}
+
+    def pack(self, params):
+        """(E+2, E) packed energies: rows [0..E) transitions, row E start,
+        row E+1 end — a single dense array that can ride the model output."""
+        return jnp.concatenate([
+            jnp.asarray(params["transitions"], jnp.float32),
+            jnp.asarray(params["start"], jnp.float32)[None],
+            jnp.asarray(params["end"], jnp.float32)[None]], axis=0)
+
+    @staticmethod
+    def unpack(packed):
+        e = packed.shape[-1]
+        return packed[..., :e, :], packed[..., e, :], packed[..., e + 1, :]
+
+    def apply(self, params, state, emissions, *, training=False, rng=None):
+        packed = jnp.broadcast_to(self.pack(params)[None],
+                                  (emissions.shape[0],) + (self.num_tags + 2,
+                                                           self.num_tags))
+        return (emissions, packed), state
+
+    def compute_output_shape(self, input_shape):
+        t = input_shape[0] if input_shape else None
+        return [(t, self.num_tags), (self.num_tags + 2, self.num_tags)]
+
+
+def crf_nll_from_packed(tags, emissions, packed, pad_tag: int = -1):
+    """Mean NLL given the CRF layer's ``(emissions, packed)`` output pair.
+    ``tags`` uses ``pad_tag`` (default -1) on padded positions."""
+    mask = tags != pad_tag
+    trans, start, end = CRF.unpack(packed[0])
+    ll = crf_log_likelihood(emissions, jnp.maximum(tags, 0), mask,
+                            trans, start, end)
+    return -jnp.mean(ll)
