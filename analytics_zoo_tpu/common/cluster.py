@@ -137,6 +137,9 @@ class ClusterLauncher:
         env.update(self.env_extra)
         env.update({
             "ZOO_TPU_COORDINATOR": self.coordinator,
+            # RuntimeConfig field name — picked up by apply_env_overrides so
+            # init_zoo_context() in the worker needs no explicit wiring
+            "ZOO_TPU_COORDINATOR_ADDRESS": self.coordinator,
             "ZOO_TPU_NUM_PROCESSES": str(self.num_processes),
             "ZOO_TPU_PROCESS_ID": str(rank),
         })

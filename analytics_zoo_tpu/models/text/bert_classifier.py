@@ -3,51 +3,26 @@
 Parity: ``pyzoo/zoo/tfpark/text/estimator/bert_classifier.py`` (BERT + dense
 head driven by an estimator) and the Keras-layer BERT (BERT.scala). Here the
 encoder and head are one compiled program; fit/evaluate/predict come from the
-shared KerasNet facade.
+shared KerasNet facade, and the encoder/head plumbing is shared with the
+other fine-tune heads (``bert_estimators._BERTHeadBase``).
 """
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 
-from ...nn.layers.attention import BERT
-from ...nn.module import Layer, get_initializer, param_dtype
-from ...nn.topology import KerasNet
 from ..common.zoo_model import register_model
+from .bert_estimators import _BERTHeadBase
 
 
 @register_model("BERTClassifier")
-class BERTClassifier(Layer, KerasNet):
+class BERTClassifier(_BERTHeadBase):
     """ids (B, T) [or [ids, segment_ids]] → class probabilities (B, C)."""
 
-    def __init__(self, num_classes: int, vocab: int = 30522,
-                 hidden_size: int = 256, n_block: int = 4, n_head: int = 4,
-                 seq_len: int = 128, intermediate_size: Optional[int] = None,
-                 name=None):
-        super().__init__(name=name)
+    def __init__(self, num_classes: int, dropout: float = 0.0, **kw):
         self.num_classes = int(num_classes)
-        self.cfg = dict(vocab=vocab, hidden_size=hidden_size, n_block=n_block,
-                        n_head=n_head, seq_len=seq_len,
-                        intermediate_size=intermediate_size or 4 * hidden_size)
-        self.bert = BERT(vocab=vocab, hidden_size=hidden_size, n_block=n_block,
-                         n_head=n_head, seq_len=seq_len,
-                         intermediate_size=self.cfg["intermediate_size"],
-                         name=f"{self.name}_bert")
-
-    @property
-    def input_shape(self):
-        return (self.cfg["seq_len"],)
-
-    def build(self, rng, input_shape=None):
-        k_bert, k_head = jax.random.split(rng)
-        bert_p, _ = self.bert.build(k_bert, input_shape)
-        head_k = get_initializer("glorot_uniform")(
-            k_head, (self.cfg["hidden_size"], self.num_classes), param_dtype())
-        return {"bert": bert_p, "head_kernel": head_k,
-                "head_bias": jnp.zeros((self.num_classes,), param_dtype())}, {}
+        super().__init__(head_units=self.num_classes, dropout=dropout, **kw)
 
     def apply(self, params, state, x, *, training=False, rng=None):
         (_, pooled), _ = self.bert.apply(params["bert"], {}, x,
@@ -60,12 +35,8 @@ class BERTClassifier(Layer, KerasNet):
         return (self.num_classes,)
 
     def constructor_config(self):
-        return dict(num_classes=self.num_classes, **self.cfg)
-
-    def save_model(self, path: str):
-        from ..common.zoo_model import save_model_bundle
-
-        save_model_bundle(path, self, config=self.constructor_config())
+        return dict(num_classes=self.num_classes,
+                    **super().constructor_config())
 
     @classmethod
     def load_model(cls, path: str) -> "BERTClassifier":

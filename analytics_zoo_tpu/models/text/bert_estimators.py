@@ -81,15 +81,14 @@ class _BERTHeadBase(Layer, KerasNet):
 
     def _sequence_logits(self, params, x, *, training, rng):
         """(B, T, head_units) logits over the final encoder sequence output."""
+        from .sequence_models import _dropout
+
         k_drop = k_bert = rng
         if rng is not None:
             k_bert, k_drop = jax.random.split(rng)
         (seq, _pooled), _ = self.bert.apply(params["bert"], {}, x,
                                             training=training, rng=k_bert)
-        if training and self.dropout > 0:
-            keep = 1.0 - self.dropout
-            mask = jax.random.bernoulli(k_drop, keep, seq.shape)
-            seq = jnp.where(mask, seq / keep, 0.0).astype(seq.dtype)
+        seq = _dropout(seq, self.dropout, training, k_drop)
         return seq @ jnp.asarray(params["head_kernel"], seq.dtype) \
             + jnp.asarray(params["head_bias"], seq.dtype)
 

@@ -369,12 +369,12 @@ class IntentEntity(Layer, KerasNet):
 
     @staticmethod
     def loss(y_true, y_pred):
+        from ...nn.losses import sparse_categorical_crossentropy
+
         intent_y, slot_y = y_true
         intent_p, slot_p = y_pred
-        intent_ll = jnp.take_along_axis(
-            jnp.log(jnp.clip(intent_p.astype(jnp.float32), 1e-12, 1.0)),
-            intent_y[:, None].astype(jnp.int32), axis=-1)[:, 0]
-        return -jnp.mean(intent_ll) + masked_tag_loss(slot_y, slot_p)
+        return sparse_categorical_crossentropy(intent_y, intent_p) \
+            + masked_tag_loss(slot_y, slot_p)
 
     def build(self, rng, input_shape=None):
         ks = jax.random.split(rng, 4)
