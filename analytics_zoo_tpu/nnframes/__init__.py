@@ -17,6 +17,9 @@ the array batch dimension).
 
 from .nn_estimator import NNEstimator, NNModel, NNClassifier, NNClassifierModel
 from .nn_image_reader import NNImageReader
+from .xgb import (XGBClassifier, XGBClassifierModel, XGBRegressor,
+                  XGBRegressorModel)
 
 __all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
-           "NNImageReader"]
+           "NNImageReader", "XGBClassifier", "XGBClassifierModel",
+           "XGBRegressor", "XGBRegressorModel"]

@@ -1,0 +1,97 @@
+"""One-command serving stack: broker + streaming engine + HTTP frontend, run
+in the FOREGROUND — the container/systemd entrypoint the reference covers with
+``docker/cluster-serving`` (Redis + Flink job + FrontEnd jar in one image).
+
+    python -m analytics_zoo_tpu.serving.stack --model /models/my_zoo_bundle
+    python -m analytics_zoo_tpu.serving.stack --demo       # built-in demo MLP
+
+HTTP on ``--http-port`` (default 8080): POST /predict {"instances": [...]},
+GET /metrics. The broker persists to ``--aof`` when given, so a container
+restart on the same volume redelivers in-flight requests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from .broker import start_broker
+from .config import ServingConfig
+from .engine import ClusterServing
+from .http_frontend import FrontEndApp
+
+
+def _demo_model():
+    """Tiny MLP so the stack can be driven before a real bundle exists."""
+    import numpy as np
+
+    from ..nn import Sequential
+    from ..nn import layers as L
+
+    model = Sequential([L.Dense(64, activation="relu", input_shape=(16,)),
+                        L.Dense(4, activation="softmax")])
+    model.compile(optimizer="adam", loss="categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 16)).astype("float32")
+    y = np.eye(4, dtype="float32")[rng.integers(0, 4, 128)]
+    model.fit(x, y, batch_size=32, nb_epoch=1)
+    return model
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="foreground serving stack")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--http-port", type=int, default=8080)
+    ap.add_argument("--broker-port", type=int, default=6380)
+    ap.add_argument("--aof", default=None)
+    ap.add_argument("--model", default=None, help="zoo model bundle path")
+    ap.add_argument("--config", default=None, help="ServingConfig yaml")
+    ap.add_argument("--int8", action="store_true")
+    ap.add_argument("--demo", action="store_true",
+                    help="serve a built-in demo model (no bundle needed)")
+    ap.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                    help="force the JAX backend (e.g. cpu when the TPU "
+                         "tunnel/runtime is unavailable)")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    cfg = (ServingConfig.from_yaml(args.config) if args.config
+           else ServingConfig())
+    cfg.queue_host, cfg.queue_port = "127.0.0.1", args.broker_port
+    if args.model:
+        cfg.model_path = args.model
+    if args.int8:
+        cfg.int8 = True
+    if not cfg.model_path and not args.demo:
+        ap.error("pass --model <bundle>, --config with model/path, or --demo")
+
+    broker = start_broker("127.0.0.1", args.broker_port, aof_path=args.aof)
+    serving = ClusterServing(_demo_model() if args.demo and not cfg.model_path
+                             else None, config=cfg)
+    serving.start()
+    app = FrontEndApp(cfg, host=args.host, port=args.http_port)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    threading.Thread(target=app.serve, daemon=True,
+                     name="zoo-http-frontend").start()
+    logging.info("serving stack up: http=%s:%d broker=127.0.0.1:%d%s",
+                 args.host, args.http_port, args.broker_port,
+                 f" aof={args.aof}" if args.aof else "")
+    stop.wait()
+    logging.info("shutting down")
+    app.stop()
+    serving.stop()
+    broker.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,45 @@
+"""Foreground serving-stack entrypoint (docker `serve` command): broker +
+engine + HTTP frontend come up in one process, answer /predict and /metrics,
+and shut down cleanly on SIGTERM."""
+
+import json
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+
+@pytest.mark.slow
+def test_stack_boots_predicts_and_stops():
+    http_port, broker_port = 18191, 16391
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "analytics_zoo_tpu.serving.stack", "--demo",
+         "--platform", "cpu", "--http-port", str(http_port),
+         "--broker-port", str(broker_port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    url = f"http://127.0.0.1:{http_port}"
+    try:
+        deadline = time.time() + 120
+        while True:
+            try:
+                urllib.request.urlopen(url + "/metrics", timeout=2)
+                break
+            except Exception:
+                if proc.poll() is not None:
+                    raise AssertionError(proc.stdout.read())
+                if time.time() > deadline:
+                    raise AssertionError("frontend never came up")
+                time.sleep(0.5)
+        body = json.dumps({"instances": [{"x": [0.1] * 16}]}).encode()
+        r = urllib.request.urlopen(urllib.request.Request(
+            url + "/predict", body, {"Content-Type": "application/json"}),
+            timeout=60)
+        resp = json.loads(r.read())
+        assert len(resp["predictions"]) == 1
+        assert len(resp["predictions"][0]) == 4      # demo model classes
+    finally:
+        proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=30) == 0
