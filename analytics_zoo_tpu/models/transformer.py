@@ -104,9 +104,15 @@ class TransformerLM(Layer, KerasNet):
 
 
 def lm_loss(y_true, logits):
-    """Next-token cross entropy over (B, T) int targets and (B, T, V) logits."""
+    """Next-token cross entropy over (B, T) int targets and (B, T, V) logits.
+
+    lse-form (CE = logsumexp(z) − z[label]) so only (B, T) reductions
+    materialize in f32 — the log_softmax form writes a second full (B, T, V)
+    f32 tensor, which at batch 32 × seq 2048 × 32k vocab is 8 GB of HBM
+    traffic per step for no mathematical difference."""
     logits = jnp.asarray(logits, jnp.float32)
     labels = jnp.asarray(y_true, jnp.int32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.mean(picked)
+    lse = jax.nn.logsumexp(logits, axis=-1)                      # (B, T)
+    picked = jnp.take_along_axis(logits, labels[..., None],
+                                 axis=-1)[..., 0]                # (B, T)
+    return jnp.mean(lse - picked)

@@ -326,7 +326,7 @@ def run_transformer_mfu(seq_len: int = 2048, batch: Optional[int] = None,
     prev_compute = compute_dtype()
     set_policy(compute_dtype="bfloat16")
     try:
-        candidates = [batch] if batch else [4, 8, 16]
+        candidates = [batch] if batch else [4, 8, 16, 32]
         best, tried = None, []
         for b in candidates:
             try:

@@ -15,9 +15,19 @@ def force_cpu_if_no_tpu():
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         jax.config.update("jax_platforms", "cpu")
         return
+    # probe the accelerator in a SUBPROCESS with a hard timeout: an in-process
+    # jax.devices() on a wedged tunnel blocks forever inside PJRT client init,
+    # which no try/except can catch. Reuse the bench's probe (repo root is on
+    # sys.path); ANY probe failure — timeout, fork error, missing interpreter
+    # — means "no usable accelerator" and falls back to CPU.
     try:
-        jax.devices("tpu")
+        from bench import _accelerator_alive
+
+        alive = _accelerator_alive(
+            timeout_s=int(os.environ.get("ZOO_EXAMPLE_PROBE_TIMEOUT_S", 60)))
     except Exception:
+        alive = False
+    if not alive:
         jax.config.update("jax_platforms", "cpu")
 
 
