@@ -221,18 +221,21 @@ _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 def ring_attention_local(q, k, v, *, axis_name: str = "sp", causal: bool = False,
                          use_flash: Optional[bool] = None,
-                         block_q: int = 128, block_k: int = 128):
+                         block_q: Optional[int] = None,
+                         block_k: Optional[int] = None):
     """Ring attention over ``axis_name``; called INSIDE shard_map.
 
     q/k/v: local blocks (B, T_local, H, D); global seq is sharded over the ring.
     The per-step body is the pallas flash kernel whenever pallas is available
     and the local sequence tiles evenly (``use_flash=None`` auto-detects);
-    otherwise the plain-jnp online-softmax body runs.
+    otherwise the plain-jnp online-softmax body runs. Tile sizes default to
+    ``default_blocks()`` (env-tunable, like every flash call site).
     """
-    from .flash_attention import _HAS_PALLAS
+    from .flash_attention import _HAS_PALLAS, default_blocks
 
-    b_q = min(block_q, q.shape[1])
-    b_k = min(block_k, k.shape[1])
+    env_q, env_k = default_blocks()
+    b_q = min(env_q if block_q is None else block_q, q.shape[1])
+    b_k = min(env_k if block_k is None else block_k, k.shape[1])
     tiles_ok = q.shape[1] % b_q == 0 and k.shape[1] % b_k == 0
     if use_flash is None:
         # auto only on real TPU: elsewhere the kernel runs in interpret mode

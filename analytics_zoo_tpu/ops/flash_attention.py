@@ -320,16 +320,31 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal: bool, block_q: int,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None):
+def flash_attention(q, k, v, causal: bool = False,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None):
     """Blockwise attention, (B, T, H, D) → (B, T, H, D).
 
-    Falls back to plain fused attention when pallas is unavailable or the
-    sequence does not tile evenly (the caller may pad instead).
+    ``block_q``/``block_k`` default to :func:`default_blocks` (128×128,
+    overridable via ``ZOO_FLASH_BLOCK_Q/K`` — honored by EVERY call site:
+    direct, sharded, ring and Ulysses). Falls back to plain fused attention
+    when pallas is unavailable or the sequence does not tile evenly (the
+    caller may pad instead).
     """
     out, _ = _flash_attention_fwd_res(q, k, v, causal, block_q, block_k,
                                       interpret)
     return out
+
+
+def default_blocks() -> tuple:
+    """Flash tile sizes, env-tunable for sweeps (dev/mfu_sweep.py):
+    ``ZOO_FLASH_BLOCK_Q`` / ``ZOO_FLASH_BLOCK_K``, default 128×128. Read at
+    trace time — a jitted program bakes the values it saw."""
+    import os
+
+    return (int(os.environ.get("ZOO_FLASH_BLOCK_Q", 128)),
+            int(os.environ.get("ZOO_FLASH_BLOCK_K", 128)))
 
 
 def _tiles_ok(q, k, block_q, block_k):
@@ -341,10 +356,12 @@ def _interpret_default() -> bool:
 
 
 def _resolve(q, k, block_q, block_k, interpret):
-    """Clamp tile sizes to the sequence and resolve interpret mode — shared by
-    the forward and the VJP backward so both always use identical tiling."""
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
+    """Resolve env-default tile sizes, clamp them to the sequence, and resolve
+    interpret mode — shared by the forward and the VJP backward so both
+    always use identical tiling."""
+    env_q, env_k = default_blocks()
+    block_q = min(env_q if block_q is None else block_q, q.shape[1])
+    block_k = min(env_k if block_k is None else block_k, k.shape[1])
     interpret = _interpret_default() if interpret is None else interpret
     return block_q, block_k, interpret
 

@@ -19,7 +19,27 @@ def force_cpu_if_no_tpu():
     # jax.devices() on a wedged tunnel blocks forever inside PJRT client init,
     # which no try/except can catch. Reuse the bench's probe (repo root is on
     # sys.path); ANY probe failure — timeout, fork error, missing interpreter
-    # — means "no usable accelerator" and falls back to CPU.
+    # — means "no usable accelerator" and falls back to CPU. The verdict is
+    # cached on disk with a short TTL so running many example scripts back to
+    # back pays for ONE probe, not 31 (each probe fully initializes PJRT).
+    alive = _cached_probe()
+    if not alive:
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _cached_probe(ttl_s: float = 300.0) -> bool:
+    import json
+    import tempfile
+    import time
+
+    cache = os.path.join(tempfile.gettempdir(), "zoo_example_probe.json")
+    try:
+        with open(cache) as f:
+            entry = json.load(f)
+        if time.time() - entry["t"] < ttl_s:
+            return bool(entry["alive"])
+    except (OSError, ValueError, KeyError):
+        pass
     try:
         from bench import _accelerator_alive
 
@@ -27,8 +47,14 @@ def force_cpu_if_no_tpu():
             timeout_s=int(os.environ.get("ZOO_EXAMPLE_PROBE_TIMEOUT_S", 60)))
     except Exception:
         alive = False
-    if not alive:
-        jax.config.update("jax_platforms", "cpu")
+    try:
+        tmp = cache + f".{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"t": time.time(), "alive": alive}, f)
+        os.replace(tmp, cache)
+    except OSError:
+        pass
+    return alive
 
 
 SMOKE = os.environ.get("ZOO_EXAMPLE_SMOKE", "0") == "1"

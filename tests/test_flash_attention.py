@@ -209,3 +209,31 @@ def test_flash_gradients_bf16_close_to_f32_oracle():
         assert gf.dtype == jnp.bfloat16
         np.testing.assert_allclose(np.asarray(gf, dtype=np.float32),
                                    np.asarray(gr), atol=3e-2, rtol=5e-2)
+
+
+@pytest.mark.parametrize("bq,bk", [(64, 128), (256, 64), (128, 256)])
+def test_flash_nondefault_tile_sizes_match_oracle(bq, bk):
+    """dev/mfu_sweep.py sweeps flash tile sizes via ZOO_FLASH_BLOCK_Q/K —
+    every tiling must stay numerically identical to the oracle, fwd and dq."""
+    rng = np.random.default_rng(3)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 256, 2, 16)), jnp.float32)
+               for _ in range(3))
+    ref = full_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, True, bq, bk)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    g = jax.grad(lambda a: jnp.sum(flash_attention(a, k, v, True, bq, bk) ** 2))(q)
+    gr = jax.grad(lambda a: jnp.sum(full_attention(a, k, v, causal=True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_default_blocks_env_knobs(monkeypatch):
+    from analytics_zoo_tpu.ops.flash_attention import default_blocks
+
+    monkeypatch.delenv("ZOO_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.delenv("ZOO_FLASH_BLOCK_K", raising=False)
+    assert default_blocks() == (128, 128)
+    monkeypatch.setenv("ZOO_FLASH_BLOCK_Q", "256")
+    monkeypatch.setenv("ZOO_FLASH_BLOCK_K", "512")
+    assert default_blocks() == (256, 512)
