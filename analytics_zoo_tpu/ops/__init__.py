@@ -1,7 +1,8 @@
-"""TPU compute ops: attention strategies (full/ring/Ulysses), pallas kernels."""
+"""TPU compute ops: attention strategies (full/ring/zigzag/Ulysses), pallas kernels."""
 
 from .attention import (full_attention, ring_attention_local, sharded_attention,
-                        ulysses_attention_local)
+                        ulysses_attention_local, zigzag_permutation,
+                        zigzag_ring_attention_local)
 
 __all__ = ["full_attention", "ring_attention_local", "sharded_attention",
            "ulysses_attention_local"]

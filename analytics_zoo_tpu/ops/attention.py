@@ -2,15 +2,20 @@
 
 The reference's attention is fixed-length single-node (TransformerLayer.scala,
 BERT.scala — SURVEY.md §5.7: no ring attention, no sequence parallelism). Here
-long-context is first-class: three interchangeable strategies over the global mesh:
+long-context is first-class: interchangeable strategies over the global mesh:
 
 * ``full``    — plain batched attention; GSPMD shards it over dp/tp axes.
 * ``ring``    — ring attention over the ``sp`` axis: K/V blocks rotate around the
-                ring via ``lax.ppermute`` while each device keeps an online-softmax
-                accumulator for its local Q block. Peak memory per device is
-                O(T/sp · T/sp) and the K/V transfer rides ICI neighbor links.
+                ring via ``lax.ppermute``. On TPU each ring step runs the pallas
+                flash kernel (O(block) score memory); off TPU a plain-jnp
+                online-softmax body runs. K/V transfers ride ICI neighbor links.
+* ``zigzag``  — causal ring over the zigzag layout (device d holds the chunk
+                pair (d, 2n−1−d)): the causal schedule is load-balanced — every
+                device does ~2 half-blocks per step instead of the plain ring's
+                tail-heavy triangle. Causal + TPU only; else falls to ``ring``.
 * ``ulysses`` — DeepSpeed-Ulysses-style all-to-all: resharding from sequence-split
-                to head-split, local full attention, then the inverse all-to-all.
+                to head-split, local (flash on TPU) attention over the full
+                sequence, then the inverse all-to-all.
 
 All strategies compute bitwise-comparable results (up to float reassociation) and
 are differentiable (pure jnp/lax — JAX autodiff through collectives).
@@ -219,6 +224,206 @@ def _ring_flash_vjp_bwd(axis_name, causal, block_q, block_k, res, g):
 _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
+# ----------------------------------------------------------- zigzag ring
+def zigzag_permutation(t: int, n: int):
+    """Sequence-axis permutation for load-balanced CAUSAL ring attention.
+
+    Contiguous chunking starves the early devices: device d has only d+1
+    non-future blocks of n, so the wall-clock is set by the last device while
+    the first sits idle (~2× waste at large n). Zigzag gives device d the
+    chunk PAIR (d, 2n−1−d) of 2n half-chunks — causal work per device becomes
+    (d+1) + (2n−1−d − (n−1)) … = 2n+1 half-pairs, EQUAL for every d. Returns
+    the permutation such that ``x[:, perm]`` sharded over ``n`` devices puts
+    that pair on device d; invert with ``np.argsort(perm)``.
+    """
+    import numpy as np
+
+    if t % (2 * n):
+        raise ValueError(f"zigzag needs seq len divisible by 2*sp ({2 * n}); "
+                         f"got {t}")
+    c = t // (2 * n)
+    order = []
+    for d in range(n):
+        order += [d, 2 * n - 1 - d]
+    return np.concatenate([np.arange(c) + ch * c for ch in order])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _zigzag_ring_flash(q, k, v, axis_name, block_q, block_k):
+    """Causal ring attention over the zigzag layout; called INSIDE shard_map.
+
+    The local sequence is [lo | hi] = chunks (idx, 2n−1−idx). Of the four
+    (q-half × visiting-k-half) pairs, two are STATIC: q_lo×k_hi is always
+    future (skipped at trace time) and q_hi×k_lo always strictly past (dense
+    flash, no cond); only the two same-half pairs need runtime 3-way
+    dispatch. Per-step work is therefore ~2 half-blocks on every device —
+    the balanced schedule the plain causal ring lacks."""
+    out, _ = _zigzag_fwd_res(q, k, v, axis_name, block_q, block_k)
+    return out
+
+
+def _zigzag_split(x, axis=1):
+    c = x.shape[axis] // 2
+    lo = jax.lax.slice_in_dim(x, 0, c, axis=axis)
+    hi = jax.lax.slice_in_dim(x, c, 2 * c, axis=axis)
+    return lo, hi
+
+
+def _zigzag_fwd_res(q, k, v, axis_name, block_q, block_k):
+    from .flash_attention import _flash_fwd, _interpret_default
+
+    interpret = _interpret_default()
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, t_loc, h, d = q.shape
+    c = t_loc // 2
+    bq, bk = min(block_q, c), min(block_k, c)
+    q_lo, q_hi = _zigzag_split(q)
+    k_lo, k_hi = _zigzag_split(k)
+    v_lo, v_hi = _zigzag_split(v)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def fwd(causal_flag):
+        def run(op):
+            qh, kh, vh = op
+            return _flash_fwd(qh, kh, vh, causal=causal_flag, block_q=bq,
+                              block_k=bk, interpret=interpret)
+        return run
+
+    def future(op):
+        return (jnp.zeros((b, c, h, d), q.dtype),
+                jnp.full((b, h, c), NEG_INF, jnp.float32))
+
+    def step(carry, i):
+        o_lo, lse_lo, o_hi, lse_hi, kl, kh, vl, vh = carry
+        src = (idx - i) % n
+        # q_hi × k_lo: hi chunk (2n−1−idx) is ALWAYS past every lo chunk
+        o_blk, lse_blk = fwd(False)((q_hi, kl, vl))
+        o_hi, lse_hi = _merge_blocks(o_hi, lse_hi, o_blk, lse_blk)
+        # q_lo × k_lo: past iff src < idx on lo chunk ids
+        o_blk, lse_blk = _block_cases(src, idx, True, fwd(True), fwd(False),
+                                      future, (q_lo, kl, vl))
+        o_lo, lse_lo = _merge_blocks(o_lo, lse_lo, o_blk, lse_blk)
+        # q_hi × k_hi: hi ids invert the order — past iff src > idx
+        o_blk, lse_blk = _block_cases(idx, src, True, fwd(True), fwd(False),
+                                      future, (q_hi, kh, vh))
+        o_hi, lse_hi = _merge_blocks(o_hi, lse_hi, o_blk, lse_blk)
+        roll = lambda x: jax.lax.ppermute(x, axis_name, perm)
+        return (o_lo, lse_lo, o_hi, lse_hi,
+                roll(kl), roll(kh), roll(vl), roll(vh)), None
+
+    z_o = jnp.zeros((b, c, h, d), jnp.float32)
+    z_l = jnp.full((b, h, c), NEG_INF, jnp.float32)
+    (o_lo, lse_lo, o_hi, lse_hi, *_), _ = jax.lax.scan(
+        step, (z_o, z_l, z_o, z_l, k_lo, k_hi, v_lo, v_hi), jnp.arange(n))
+    out = jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
+    lse = jnp.concatenate([lse_lo, lse_hi], axis=2)
+    return out, (q, k, v, out, lse)
+
+
+def _zigzag_vjp_fwd(q, k, v, axis_name, block_q, block_k):
+    return _zigzag_fwd_res(q, k, v, axis_name, block_q, block_k)
+
+
+def _zigzag_vjp_bwd(axis_name, block_q, block_k, res, g):
+    """Backward ring pass with the same 4-pair structure: (k, v, dk, dv)
+    half-bundles rotate together and return home fully accumulated after n
+    steps; dq halves accumulate locally. Every pair recomputes P from the
+    saved global lse via the tiled flash backward kernels."""
+    from .flash_attention import _flash_bwd, _interpret_default
+
+    q, k, v, out, lse = res
+    interpret = _interpret_default()
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, t_loc, h, d = q.shape
+    c = t_loc // 2
+    bq, bk = min(block_q, c), min(block_k, c)
+    q_lo, q_hi = _zigzag_split(q)
+    k_lo, k_hi = _zigzag_split(k)
+    v_lo, v_hi = _zigzag_split(v)
+    o_lo, o_hi = _zigzag_split(out)
+    g_lo, g_hi = _zigzag_split(g)
+    lse_lo, lse_hi = _zigzag_split(lse, axis=2)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def bwd(qh, oh, lseh, gh, causal_flag):
+        def run(op):
+            kh, vh = op
+            return _flash_bwd(qh, kh, vh, oh, lseh, gh, causal=causal_flag,
+                              block_q=bq, block_k=bk, interpret=interpret)
+        return run
+
+    def future(op):
+        kh, vh = op
+        return (jnp.zeros((b, c, h, d), q.dtype), jnp.zeros_like(kh),
+                jnp.zeros_like(vh))
+
+    def step(carry, i):
+        dq_lo, dq_hi, kl, kh, vl, vh, dkl, dkh, dvl, dvh = carry
+        src = (idx - i) % n
+        # q_hi × k_lo: always past (dense)
+        dqc, dkc, dvc = bwd(q_hi, o_hi, lse_hi, g_hi, False)((kl, vl))
+        dq_hi = dq_hi + dqc.astype(jnp.float32)
+        dkl = dkl + dkc.astype(jnp.float32)
+        dvl = dvl + dvc.astype(jnp.float32)
+        # q_lo × k_lo
+        dqc, dkc, dvc = _block_cases(
+            src, idx, True, bwd(q_lo, o_lo, lse_lo, g_lo, True),
+            bwd(q_lo, o_lo, lse_lo, g_lo, False), future, (kl, vl))
+        dq_lo = dq_lo + dqc.astype(jnp.float32)
+        dkl = dkl + dkc.astype(jnp.float32)
+        dvl = dvl + dvc.astype(jnp.float32)
+        # q_hi × k_hi (inverted order)
+        dqc, dkc, dvc = _block_cases(
+            idx, src, True, bwd(q_hi, o_hi, lse_hi, g_hi, True),
+            bwd(q_hi, o_hi, lse_hi, g_hi, False), future, (kh, vh))
+        dq_hi = dq_hi + dqc.astype(jnp.float32)
+        dkh = dkh + dkc.astype(jnp.float32)
+        dvh = dvh + dvc.astype(jnp.float32)
+        roll = lambda x: jax.lax.ppermute(x, axis_name, perm)
+        return (dq_lo, dq_hi, roll(kl), roll(kh), roll(vl), roll(vh),
+                roll(dkl), roll(dkh), roll(dvl), roll(dvh)), None
+
+    z = lambda: jnp.zeros((b, c, h, d), jnp.float32)
+    (dq_lo, dq_hi, _, _, _, _, dkl, dkh, dvl, dvh), _ = jax.lax.scan(
+        step, (z(), z(), k_lo, k_hi, v_lo, v_hi, z(), z(), z(), z()),
+        jnp.arange(n))
+    cat = lambda a, b_, dt: jnp.concatenate([a, b_], axis=1).astype(dt)
+    return (cat(dq_lo, dq_hi, q.dtype), cat(dkl, dkh, k.dtype),
+            cat(dvl, dvh, v.dtype))
+
+
+_zigzag_ring_flash.defvjp(_zigzag_vjp_fwd, _zigzag_vjp_bwd)
+
+
+def zigzag_ring_attention_local(q, k, v, *, axis_name: str = "sp",
+                                causal: bool = True,
+                                block_q: Optional[int] = None,
+                                block_k: Optional[int] = None):
+    """Load-balanced causal ring attention; called INSIDE shard_map over the
+    ZIGZAG layout (``zigzag_permutation``). Causal only — without masking the
+    plain ring is already balanced."""
+    from .flash_attention import _HAS_PALLAS, default_blocks
+
+    if not causal:
+        return ring_attention_local(q, k, v, axis_name=axis_name, causal=False,
+                                    block_q=block_q, block_k=block_k)
+    if q.shape[1] % 2:
+        raise ValueError("zigzag local block needs an even sequence length")
+    if not _HAS_PALLAS:
+        raise ValueError("zigzag ring needs pallas (use strategy='ring' "
+                         "for the jnp fallback)")
+    env_q, env_k = default_blocks()
+    c = q.shape[1] // 2
+    b_q = min(env_q if block_q is None else block_q, c)
+    b_k = min(env_k if block_k is None else block_k, c)
+    if c % b_q or c % b_k:
+        raise ValueError(f"zigzag half-chunk {c} must tile by blocks "
+                         f"({b_q}/{b_k})")
+    return _zigzag_ring_flash(q, k, v, axis_name, b_q, b_k)
+
+
 def ring_attention_local(q, k, v, *, axis_name: str = "sp", causal: bool = False,
                          use_flash: Optional[bool] = None,
                          block_q: Optional[int] = None,
@@ -291,9 +496,9 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
     specs shard batch over dp/fsdp, sequence over sp, heads over tp — so tensor and
     sequence parallelism compose.
     """
-    if strategy not in ("auto", "full", "flash", "ring", "ulysses"):
+    if strategy not in ("auto", "full", "flash", "ring", "zigzag", "ulysses"):
         raise ValueError(f"unknown attention strategy {strategy!r}; "
-                         "known: auto, full, flash, ring, ulysses")
+                         "known: auto, full, flash, ring, zigzag, ulysses")
     sp = mesh.shape[seq_axis]
     if strategy == "auto":
         strategy = "ring" if sp > 1 else "full"
@@ -326,6 +531,29 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
         return full_attention(q, k, v, causal=causal)
 
     spec = P(batch_axes, seq_axis, head_axis, None)
+    if strategy == "zigzag":
+        import os
+
+        if not causal:
+            strategy = "ring"         # balanced already; zigzag buys nothing
+        elif (jax.default_backend() != "tpu"
+              and os.environ.get("ZOO_FORCE_ZIGZAG") != "1"):
+            # interpret-mode pallas off TPU is orders slower than the jnp
+            # ring body; tests force the kernel with ZOO_FORCE_ZIGZAG=1
+            strategy = "ring"
+        else:
+            import numpy as np
+
+            perm = zigzag_permutation(q.shape[1], sp)
+            inv = np.argsort(perm)
+            wrapped = jax.shard_map(
+                functools.partial(zigzag_ring_attention_local,
+                                  axis_name=seq_axis, causal=True),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False)
+            # constant-index gathers; GSPMD lowers them to ICI permutes
+            o = wrapped(q[:, perm], k[:, perm], v[:, perm])
+            return o[:, inv]
     fn = {"ring": ring_attention_local,
           "ulysses": ulysses_attention_local}[strategy]
     wrapped = jax.shard_map(

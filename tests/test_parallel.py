@@ -174,3 +174,53 @@ def test_ring_flash_memory_is_linear_in_seq_not_quadratic(mesh_sp8):
     assert jnp_2k / jnp_1k > 3.0, (jnp_1k, jnp_2k)   # quadratic blowup
     assert fl_2k / fl_1k < 2.5, (fl_1k, fl_2k)       # linear in T
     assert jnp_2k > 4 * fl_2k, (jnp_2k, fl_2k)       # and already 4x smaller
+
+
+def test_zigzag_ring_matches_oracle_fwd_and_grad(mesh6, monkeypatch):
+    """Load-balanced causal ring (zigzag layout): device d holds chunks
+    (d, 2n-1-d), so q_hi x k_lo is statically past and q_lo x k_hi statically
+    future - per-step work equalizes at ~2 half-blocks per device. Must stay
+    bitwise-comparable to the full-attention oracle."""
+    monkeypatch.setenv("ZOO_FORCE_ZIGZAG", "1")   # off-TPU falls to ring
+    B, T, H, D = 2, 64, 2, 16
+    rng = np.random.default_rng(4)
+    q, k, v = (jnp.asarray(rng.normal(size=(B, T, H, D)).astype("float32"))
+               for _ in range(3))
+    ref = full_attention(q, k, v, causal=True)
+    out = jax.jit(lambda a, b, c: sharded_attention(
+        a, b, c, mesh6, strategy="zigzag", causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+    loss = lambda fn: lambda a, b, c: jnp.sum(fn(a, b, c) ** 2)
+    g_z = jax.jit(jax.grad(loss(lambda a, b, c: sharded_attention(
+        a, b, c, mesh6, strategy="zigzag", causal=True)),
+        argnums=(0, 1, 2)))(q, k, v)
+    g_full = jax.grad(loss(lambda a, b, c: full_attention(a, b, c, causal=True)),
+                      argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_z, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_zigzag_noncausal_falls_back_to_ring(mesh6):
+    B, T, H, D = 2, 32, 2, 8
+    rng = np.random.default_rng(5)
+    q, k, v = (jnp.asarray(rng.normal(size=(B, T, H, D)).astype("float32"))
+               for _ in range(3))
+    ref = full_attention(q, k, v, causal=False)
+    out = jax.jit(lambda a, b, c: sharded_attention(
+        a, b, c, mesh6, strategy="zigzag", causal=False))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_permutation_validates_and_inverts():
+    from analytics_zoo_tpu.ops.attention import zigzag_permutation
+
+    with pytest.raises(ValueError, match="divisible"):
+        zigzag_permutation(30, 4)
+    perm = zigzag_permutation(32, 4)
+    assert sorted(perm.tolist()) == list(range(32))
+    # device 0's slice (first 8 entries) = chunks 0 and 7
+    assert perm[:8].tolist() == [0, 1, 2, 3, 28, 29, 30, 31]
