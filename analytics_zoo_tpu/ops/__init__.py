@@ -5,4 +5,5 @@ from .attention import (full_attention, ring_attention_local, sharded_attention,
                         zigzag_ring_attention_local)
 
 __all__ = ["full_attention", "ring_attention_local", "sharded_attention",
-           "ulysses_attention_local"]
+           "ulysses_attention_local", "zigzag_permutation",
+           "zigzag_ring_attention_local"]

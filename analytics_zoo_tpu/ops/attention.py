@@ -397,6 +397,19 @@ def _zigzag_vjp_bwd(axis_name, block_q, block_k, res, g):
 _zigzag_ring_flash.defvjp(_zigzag_vjp_fwd, _zigzag_vjp_bwd)
 
 
+def _zigzag_ok(t: int, sp: int) -> bool:
+    """Whether the zigzag layout applies: global T divides into 2·sp chunks
+    AND each half-chunk tiles by the (env-default) flash blocks — otherwise
+    the caller should stay on the plain ring (which clamps/falls back)."""
+    from .flash_attention import default_blocks
+
+    if t % (2 * sp):
+        return False
+    c = t // (2 * sp)
+    env_q, env_k = default_blocks()
+    return c % min(env_q, c) == 0 and c % min(env_k, c) == 0
+
+
 def zigzag_ring_attention_local(q, k, v, *, axis_name: str = "sp",
                                 causal: bool = True,
                                 block_q: Optional[int] = None,
@@ -501,7 +514,14 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
                          "known: auto, full, flash, ring, zigzag, ulysses")
     sp = mesh.shape[seq_axis]
     if strategy == "auto":
-        strategy = "ring" if sp > 1 else "full"
+        if sp > 1:
+            # causal: the zigzag layout halves the causal ring's idle time
+            # when the shape supports it (divisibility + flash tiling); the
+            # zigzag branch additionally falls back to ring off TPU
+            strategy = ("zigzag" if causal and _zigzag_ok(q.shape[1], sp)
+                        else "ring")
+        else:
+            strategy = "full"
     if strategy == "flash":
         if sp > 1:
             raise ValueError(
@@ -536,6 +556,8 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
 
         if not causal:
             strategy = "ring"         # balanced already; zigzag buys nothing
+        elif not _zigzag_ok(q.shape[1], sp):
+            strategy = "ring"         # documented fallback: shape unsuitable
         elif (jax.default_backend() != "tpu"
               and os.environ.get("ZOO_FORCE_ZIGZAG") != "1"):
             # interpret-mode pallas off TPU is orders slower than the jnp

@@ -224,3 +224,21 @@ def test_zigzag_permutation_validates_and_inverts():
     assert sorted(perm.tolist()) == list(range(32))
     # device 0's slice (first 8 entries) = chunks 0 and 7
     assert perm[:8].tolist() == [0, 1, 2, 3, 28, 29, 30, 31]
+
+
+def test_zigzag_unsuitable_shapes_fall_back_to_ring(mesh6, monkeypatch):
+    """Documented fallback: explicit strategy='zigzag' (and 'auto') must fall
+    to ring when T doesn't divide by 2*sp or half-chunks don't tile —
+    never raise at trace time."""
+    monkeypatch.setenv("ZOO_FORCE_ZIGZAG", "1")
+    B, T, H, D = 2, 40, 2, 8              # 40 % (2*4) = 0 but c=5 tiles fine;
+    rng = np.random.default_rng(6)        # use T=36: 36 % 8 != 0 -> ring
+    for T in (36, 40):
+        q, k, v = (jnp.asarray(rng.normal(size=(B, T, H, D)).astype("f4"))
+                   for _ in range(3))
+        ref = full_attention(q, k, v, causal=True)
+        for strat in ("zigzag", "auto"):
+            out = jax.jit(lambda a, b, c_: sharded_attention(
+                a, b, c_, mesh6, strategy=strat, causal=True))(q, k, v)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-5)
