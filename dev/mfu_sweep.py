@@ -37,12 +37,21 @@ def main() -> int:
     ap.add_argument("--trace", action="store_true",
                     help="xprof-trace the winning config")
     ap.add_argument("--out", default="MFU_SWEEP.json")
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="exit 2 instead of falling back to CPU when no "
+                         "accelerator is reachable (watcher mode: a CPU "
+                         "interpret-mode sweep would burn the 1-core box "
+                         "for nothing)")
     args = ap.parse_args()
 
     from bench import (_accelerator_alive, _enable_persistent_compile_cache,
                        run_transformer_mfu)
 
     if not _accelerator_alive():
+        if args.require_tpu:
+            print("[sweep] accelerator unreachable and --require-tpu set",
+                  file=sys.stderr)
+            return 2
         # a wedged tunnel hangs in-process jax.devices() forever; fall back
         # to CPU so the harness itself stays testable (interpret-mode pallas
         # — numbers are meaningless, use a tiny grid)

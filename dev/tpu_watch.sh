@@ -63,6 +63,15 @@ PYEOF
     >/tmp/tpu_watch_serving.out 2>&1 \
     && echo "[watch] serving_bench done: $(tail -1 /tmp/tpu_watch_serving.out)" \
     || echo "[watch] serving_bench failed (see /tmp/tpu_watch_serving.out)"
+  # one-time MFU sweep (VERDICT r3 #2): reduced grid, only after a bench
+  # capture landed and only until a sweep artifact exists
+  if [ ! -f MFU_SWEEP.json ]; then
+    echo "[watch $(date -u +%H:%M:%S)] running dev/mfu_sweep.py (reduced grid)"
+    timeout 2400 python dev/mfu_sweep.py --require-tpu --batches 8 16 32 \
+      --blocks 128x128 256x256 512x256 >/tmp/tpu_watch_mfu.out 2>&1 \
+      && echo "[watch] mfu sweep done: $(tail -1 /tmp/tpu_watch_mfu.out)" \
+      || echo "[watch] mfu sweep skipped/failed (see /tmp/tpu_watch_mfu.out)"
+  fi
 }
 
 echo "[watch] started $(date -u) repo=$REPO probe_every=${PROBE_EVERY}s"
