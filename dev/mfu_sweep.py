@@ -84,11 +84,12 @@ def main() -> int:
                       file=sys.stderr)
                 continue
             row = {"batch": b, "block_q": bq, "block_k": bk,
-                   "mfu": r["mfu"], "tokens_per_sec": r["tokens_per_sec"],
+                   "remat": r["remat"], "mfu": r["mfu"],
+                   "tokens_per_sec": r["tokens_per_sec"],
                    "device": r["device_kind"]}
             rows.append(row)
-            print(f"b={b:>3} blocks={blocks:>8} mfu={r['mfu']:.4f} "
-                  f"tok/s={r['tokens_per_sec']:,.0f}")
+            print(f"b={b:>3} blocks={blocks:>8} remat={int(r['remat'])} "
+                  f"mfu={r['mfu']:.4f} tok/s={r['tokens_per_sec']:,.0f}")
             if best is None or r["mfu"] > best["mfu"]:
                 best = row
 
