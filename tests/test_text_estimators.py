@@ -166,3 +166,40 @@ def test_ner_pad_mode_masks_training_and_decode(zoo_ctx, np_rng):
     pred = model.predict_tags([words[:16], chars[:16]])
     assert (pred[:, -3:] == 0).all()            # padding decodes to tag 0
     assert (pred[:, :-3] == tags[:16, :-3]).mean() > 0.4
+
+
+def test_bert_ner_trains_under_bf16_policy(np_rng):
+    """TPU realism: the text heads must train and predict under the bf16
+    compute policy (params f32, activations bf16) without dtype crashes or
+    NaNs — CPU tests otherwise only ever exercise f32."""
+    from analytics_zoo_tpu.common import (PrecisionConfig, RuntimeConfig,
+                                          init_zoo_context, reset_zoo_context)
+
+    reset_zoo_context()
+    try:
+        init_zoo_context(RuntimeConfig(
+            precision=PrecisionConfig(compute_dtype="bfloat16")))
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.nn.module import compute_dtype
+
+        assert compute_dtype() == jnp.bfloat16    # the policy actually engaged
+        ids = np_rng.integers(1, 50, size=(64, T)).astype("int32")
+        tags = (ids % 3).astype("int32")
+        model = BERTNER(num_entities=3, vocab=50, hidden_size=32, n_block=1,
+                        n_head=2, seq_len=T)
+        first, last = _fit_twice(model, ids, tags, BERTNER.loss, epochs=4)
+        assert np.isfinite(last) and last < first, (first, last)
+        assert model.predict_tags(ids[:8]).shape == (8, T)
+        # CRF dynamic programs cast to f32 internally; prove the BiLSTM-CRF
+        # tagger also trains and Viterbi-decodes under the bf16 policy
+        words, chars = _word_char_batch(np_rng, n=48)
+        ner = NER(num_entities=3, word_vocab_size=VOCAB,
+                  char_vocab_size=CHAR_VOCAB, word_length=W, word_emb_dim=8,
+                  char_emb_dim=4, tagger_lstm_dim=8)
+        nf, nl = _fit_twice(ner, [words, chars], (words % 3).astype("int32"),
+                            ner.loss, epochs=8, lr=0.02)
+        assert np.isfinite(nl) and nl < nf, (nf, nl)
+        assert ner.predict_tags([words[:4], chars[:4]]).shape == (4, T)
+    finally:
+        reset_zoo_context()
