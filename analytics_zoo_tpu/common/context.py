@@ -137,6 +137,12 @@ def get_zoo_context(auto_init: bool = True) -> ZooContext:
 
 
 def reset_zoo_context() -> None:
+    """Drop the current context AND restore the default precision policy —
+    ZooContext.__init__ engages the config's policy globally (set_policy), so
+    leaving it behind would leak e.g. bfloat16 compute into later f32 code."""
     global _CURRENT
+    from ..nn.module import set_policy
+
     with _CONTEXT_LOCK:
         _CURRENT = None
+    set_policy(param_dtype="float32", compute_dtype="float32")

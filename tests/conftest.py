@@ -54,3 +54,33 @@ def rng():
 @pytest.fixture()
 def np_rng():
     return np.random.default_rng(0)
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_sessionfinish(session, exitstatus):
+    """Shutdown-hang watchdog: full-suite runs have intermittently printed
+    their summary and then hung forever in ``threading._shutdown`` joining a
+    leaked non-daemon thread (observed twice on 2026-07-30; the leaker is
+    intermittent and so far unidentified). The daemon timer is armed
+    UNCONDITIONALLY (free on a clean exit — the process is gone before it
+    fires) so even a thread leaked during fixture teardown after this hook
+    can't wedge CI: worst case is a 60s delay with the CORRECT exit status.
+    trylast puts the hook after the runner's fixture finalization, so the
+    rogue-thread report doesn't false-positive on healthy server fixtures."""
+    import faulthandler
+    import os
+    import sys
+    import threading
+
+    watchdog = threading.Timer(60.0, lambda: os._exit(int(exitstatus)))
+    watchdog.daemon = True
+    watchdog.start()
+    rogue = [t for t in threading.enumerate()
+             if t is not threading.main_thread()
+             and not t.daemon and t.is_alive()
+             and t is not watchdog]
+    if rogue:
+        print(f"\n[conftest] non-daemon threads alive at session end: "
+              f"{[t.name for t in rogue]} — dumping stacks; exit watchdog "
+              f"armed (60s)", file=sys.stderr)
+        faulthandler.dump_traceback(file=sys.stderr)

@@ -193,7 +193,7 @@ def test_bert_ner_trains_under_bf16_policy(np_rng):
         assert model.predict_tags(ids[:8]).shape == (8, T)
         # CRF dynamic programs cast to f32 internally; prove the BiLSTM-CRF
         # tagger also trains and Viterbi-decodes under the bf16 policy
-        words, chars = _word_char_batch(np_rng, n=48)
+        words, chars = _word_char_batch(np_rng, n=64)
         ner = NER(num_entities=3, word_vocab_size=VOCAB,
                   char_vocab_size=CHAR_VOCAB, word_length=W, word_emb_dim=8,
                   char_emb_dim=4, tagger_lstm_dim=8)
