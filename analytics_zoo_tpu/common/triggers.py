@@ -10,7 +10,7 @@ of the compiled step function (no data-dependent control flow under ``jit``).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 
 @dataclass
@@ -20,8 +20,25 @@ class TrainerState:
     epoch: int = 0            # completed epochs
     iteration: int = 0        # completed global steps
     records_processed: int = 0
-    last_loss: float = float("inf")
     last_score: float = float("-inf")
+    # float OR a 0-d device array (set lazily by the epoch epilogue): a
+    # device->host transfer costs a full network round trip on remote-chip
+    # topologies, so the scalar is only materialized when something reads
+    # the ``last_loss`` property. Excluded from repr/compare so neither
+    # forces a device sync (and array-vs-float equality can't blow up).
+    _last_loss: object = field(default=float("inf"), repr=False, compare=False)
+
+    @property
+    def last_loss(self) -> float:
+        v = self._last_loss
+        if not isinstance(v, float):
+            v = float(v)             # host transfer happens here, once
+            self._last_loss = v
+        return v
+
+    @last_loss.setter
+    def last_loss(self, v) -> None:
+        self._last_loss = v
 
 
 class Trigger:

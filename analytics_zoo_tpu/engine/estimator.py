@@ -317,6 +317,22 @@ class Estimator:
                 if self.val_summary:
                     self.val_summary.add_scalars(self.trainer_state.iteration, results)
                 logger.info("epoch %d validation: %s", self.trainer_state.epoch, results)
+        # fit() returning means training FINISHED: epochs only dispatch work
+        # (epoch-final losses stay lazy device scalars — one host transfer per
+        # epoch would cost a full network RTT on remote-chip topologies), so
+        # block once here. Otherwise a caller could observe fit() "done" while
+        # this rank's collectives are still in flight — e.g. checkpointing or
+        # exiting the process mid-psum, wedging every peer rank. A one-element
+        # host transfer backs up block_until_ready because through the axon
+        # tunnel the latter can return before the device is actually done
+        # (same workaround as bench.py's _sync).
+        jax.block_until_ready(self.train_state)
+        leaves = jax.tree_util.tree_leaves(self.train_state)
+        if leaves:
+            try:
+                jax.device_get(jnp.ravel(leaves[0])[:1])
+            except TypeError:   # exotic non-indexable leaf: barrier above stands
+                pass
         return self
 
     def _run_epoch(self, train_set: FeatureSet, batch_size: int,
@@ -374,7 +390,11 @@ class Estimator:
         cfg = self.config
         ts = self.trainer_state
         if loss is not None:
-            ts.last_loss = float(loss)
+            # lazy: a 0-d device array; TrainerState materializes it on read.
+            # Eagerly float()-ing here costs one full tunnel/network RTT per
+            # epoch on remote-chip topologies — with device-cached scanned
+            # epochs that RTT dominates the whole epoch wall time.
+            ts.last_loss = loss
             # always record the epoch-final loss so short runs still get scalars
             if self.train_summary:
                 dt = time.perf_counter() - t0

@@ -315,6 +315,21 @@ class InferenceModel:
             self.summary.add_batch(n, time.perf_counter() - t0)
         return result
 
+    # ------------------------------------------------------- device-level access
+
+    def device_apply(self):
+        """``(apply_fn, params, state)`` — the exact computation ``predict``
+        compiles, with params/state already device-resident.
+
+        Public escape hatch for AOT export and device-resident benchmarking
+        (serving_bench.py times int8-vs-bf16 through this so the measurement
+        cannot silently decouple from the real predict path): after
+        ``quantize_int8`` the returned ``apply_fn``/``params`` are the
+        quantized ones."""
+        if self._apply is None:
+            raise RuntimeError("no model loaded (call load/load_zoo first)")
+        return self._apply, self._params, self._state
+
     # ------------------------------------------------------------------- warmup
 
     def warm_up(self, example_inputs) -> None:

@@ -406,7 +406,7 @@ def _zigzag_ok(t: int, sp: int) -> bool:
     if t % (2 * sp):
         return False
     c = t // (2 * sp)
-    env_q, env_k = default_blocks()
+    env_q, env_k = default_blocks(c, c)
     return c % min(env_q, c) == 0 and c % min(env_k, c) == 0
 
 
@@ -427,8 +427,8 @@ def zigzag_ring_attention_local(q, k, v, *, axis_name: str = "sp",
     if not _HAS_PALLAS:
         raise ValueError("zigzag ring needs pallas (use strategy='ring' "
                          "for the jnp fallback)")
-    env_q, env_k = default_blocks()
     c = q.shape[1] // 2
+    env_q, env_k = default_blocks(c, c)
     b_q = min(env_q if block_q is None else block_q, c)
     b_k = min(env_k if block_k is None else block_k, c)
     if c % b_q or c % b_k:
@@ -451,7 +451,7 @@ def ring_attention_local(q, k, v, *, axis_name: str = "sp", causal: bool = False
     """
     from .flash_attention import _HAS_PALLAS, default_blocks
 
-    env_q, env_k = default_blocks()
+    env_q, env_k = default_blocks(q.shape[1], k.shape[1])
     b_q = min(env_q if block_q is None else block_q, q.shape[1])
     b_k = min(env_k if block_k is None else block_k, k.shape[1])
     tiles_ok = q.shape[1] % b_q == 0 and k.shape[1] % b_k == 0

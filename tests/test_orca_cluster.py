@@ -307,9 +307,15 @@ def test_two_process_distributed_fit_failfast_and_resume(tmp_path):
     assert r0["param_digest"] == pytest.approx(r1["param_digest"], rel=1e-5)
     assert r0["loss"] < 0.5, r0             # the linear task actually trains
 
-    # --- leg 2: rank 1 hard-exits mid-job -> fail-fast tears down rank 0
+    # --- leg 2: rank 1 hard-exits mid-job -> fail-fast tears down rank 0.
+    # fail after epoch 2, not 1: rank 1 cannot finish epoch-2 collectives
+    # until rank 0 has participated in epoch 2, which happens only after
+    # rank 0's epoch-1 checkpoint save completed — so under any scheduler
+    # timing the resume leg is guaranteed a checkpoint on disk (with
+    # fail-after-1, a loaded box can kill rank 0 mid-first-save)
     out2, rcs2, launcher2 = run(7913, "fail", "ckpt_shared",
-                                env={"ZOO_FAIL_RANK": "1"})
+                                env={"ZOO_FAIL_RANK": "1",
+                                     "ZOO_FAIL_AFTER_EPOCHS": "2"})
     assert rcs2[1] == 17, (rcs2, worker_log(launcher2, 1))
     assert rcs2[0] != 0, "surviving rank must be torn down, not left hanging"
     assert not (out2 / "result-0.json").exists()
