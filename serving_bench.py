@@ -15,22 +15,43 @@ and writes the same object to SERVING_BENCH.json.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 import time
-import urllib.request
-
 import numpy as np
 
 from bench import _accelerator_alive, _wait_for_accelerator  # shared probe logic
 
 
 
-N_CLIENTS = 16
-REQUESTS_PER_CLIENT = 40
+N_CLIENTS = int(os.environ.get("ZOO_SERVING_BENCH_CLIENTS", "16"))
+REQUESTS_PER_CLIENT = int(os.environ.get("ZOO_SERVING_BENCH_REQUESTS", "40"))
 FEATURES = 256
 HIDDEN = 1024
 CLASSES = 128
+
+
+def measure_dispatch_rtt_ms(n: int = 20) -> float:
+    """Median latency of a trivial dispatch+sync (1-element add).
+
+    Through the axon tunnel every dispatch pays a network round trip that can
+    reach ~100ms when the tunnel is degraded; on a local chip this is <1ms.
+    Recording it lets the artifact separate framework cost from tunnel cost:
+    the HTTP closed-loop throughput is capped at
+    ``mean_batch × in_flight / rtt`` regardless of model speed."""
+    import jax
+    import jax.numpy as jnp
+
+    one = jnp.ones((1,), jnp.float32)
+    f = jax.jit(lambda a: a + 1.0)
+    float(f(one)[0])  # compile
+    samples = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        float(f(one)[0])
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return round(float(np.median(samples)), 3)
 
 
 def build_model():
@@ -50,20 +71,26 @@ def build_model():
     x = rng.normal(size=(256, FEATURES)).astype(np.float32)
     y = np.eye(CLASSES, dtype=np.float32)[rng.integers(0, CLASSES, 256)]
     model.fit(x, y, batch_size=64, nb_epoch=1)
-    return InferenceModel(max_batch_size=N_CLIENTS * 2).load(model)
+    return InferenceModel(max_batch_size=max(64, N_CLIENTS * 2)).load(model)
 
 
-def run_bench() -> dict:
+def run_bench(im=None, n_clients: int = N_CLIENTS,
+              requests_per_client: int = REQUESTS_PER_CLIENT,
+              max_delay_ms: float = 2.0) -> dict:
     from analytics_zoo_tpu.serving import FrontEndApp, ServingConfig
 
-    im = build_model()
+    if im is None:
+        im = build_model()
+    # never coalesce past the model's own batch ceiling — a bigger micro-batch
+    # would be chunked into multiple serial dispatches inside predict(),
+    # paying one tunnel RTT per chunk and defeating the amortization
+    coalesce = min(n_clients * 2, im.max_batch_size)
     app = FrontEndApp(ServingConfig(), port=0, model=im,
-                      max_batch=N_CLIENTS * 2, max_delay_ms=2.0).start()
+                      max_batch=coalesce, max_delay_ms=max_delay_ms).start()
     rng = np.random.default_rng(1)
     payloads = [json.dumps({"instances": [
         {"input": rng.normal(size=FEATURES).astype(np.float32).tolist()}
-    ]}).encode() for _ in range(N_CLIENTS)]
-    url = f"http://127.0.0.1:{app.port}/predict"
+    ]}).encode() for _ in range(n_clients)]
 
     import http.client
 
@@ -81,7 +108,7 @@ def run_bench() -> dict:
     # warm every bucketed executable the micro-batcher can hit — otherwise
     # first-use XLA compiles land inside the measured window
     rng_w = np.random.default_rng(2)
-    for b in (1, 2, 4, 8, 16, 32, N_CLIENTS * 2):
+    for b in (1, 2, 4, 8, 16, 32, coalesce):
         im.predict(rng_w.normal(size=(b, FEATURES)).astype(np.float32))
     warm = http.client.HTTPConnection("127.0.0.1", app.port, timeout=60)
     for p in payloads[:2]:
@@ -96,7 +123,7 @@ def run_bench() -> dict:
         # persistent connection per client (HTTP/1.1 keep-alive) — the
         # realistic load-test shape; reconnect on error
         conn = http.client.HTTPConnection("127.0.0.1", app.port, timeout=60)
-        for _ in range(REQUESTS_PER_CLIENT):
+        for _ in range(requests_per_client):
             try:
                 ms = one_request(conn, payloads[idx])
             except Exception as e:
@@ -112,7 +139,7 @@ def run_bench() -> dict:
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=client, args=(i,))
-               for i in range(N_CLIENTS)]
+               for i in range(n_clients)]
     for t in threads:
         t.start()
     for t in threads:
@@ -134,7 +161,7 @@ def run_bench() -> dict:
         "unit": "req/s",
         "requests": n,
         "failed_requests": len(failures),
-        "clients": N_CLIENTS,
+        "clients": n_clients,
         "wall_seconds": round(wall, 3),
         "p50_ms": round(float(np.percentile(lat, 50)), 2),
         "p95_ms": round(float(np.percentile(lat, 95)), 2),
@@ -145,9 +172,25 @@ def run_bench() -> dict:
     }
 
 
-INT8_HIDDEN = 4096
-INT8_BATCH = 2048
-INT8_ITERS = 30
+INT8_HIDDEN = int(os.environ.get("ZOO_INT8_BENCH_HIDDEN", "0"))  # 0 = auto
+INT8_BATCH = int(os.environ.get("ZOO_INT8_BENCH_BATCH", "0"))
+INT8_ITERS = max(1, int(os.environ.get("ZOO_INT8_BENCH_ITERS", "30")))
+
+
+def _int8_bench_shape() -> tuple:
+    """(hidden, batch): big enough that the matmuls dominate the device loop.
+
+    At 2048×4096 the elementwise/quant overhead caps the int8 gain at ~1.08×
+    on a v5e; at 8192×8192 the MXU path is the bulk of the time, which is
+    what the reference's OpenVINO int8 claim is about. The CPU fallback keeps
+    the small shape (8192³ matmuls would take hours on the 1-core box)."""
+    if INT8_HIDDEN and INT8_BATCH:
+        return INT8_HIDDEN, INT8_BATCH
+    import jax
+
+    big = jax.default_backend() != "cpu"
+    return (INT8_HIDDEN or (8192 if big else 4096),
+            INT8_BATCH or (8192 if big else 2048))
 
 
 def run_int8_bench() -> dict:
@@ -158,29 +201,71 @@ def run_int8_bench() -> dict:
     from analytics_zoo_tpu.nn import Sequential
     from analytics_zoo_tpu.nn import layers as L
 
+    hidden, batch = _int8_bench_shape()
+
     def build():
         m = Sequential([
-            L.Dense(INT8_HIDDEN, activation="relu", input_shape=(INT8_HIDDEN,)),
-            L.Dense(INT8_HIDDEN, activation="relu"),
+            L.Dense(hidden, activation="relu", input_shape=(hidden,)),
+            L.Dense(hidden, activation="relu"),
             L.Dense(CLASSES, activation="softmax"),
         ])
         m.compile(optimizer="adam", loss="categorical_crossentropy")
         rng = np.random.default_rng(0)
-        xw = rng.normal(size=(64, INT8_HIDDEN)).astype(np.float32)
+        xw = rng.normal(size=(64, hidden)).astype(np.float32)
         yw = np.eye(CLASSES, dtype=np.float32)[rng.integers(0, CLASSES, 64)]
         m.fit(xw, yw, batch_size=64, nb_epoch=1)
         return m
 
     model = build()
     x = np.random.default_rng(3).normal(
-        size=(INT8_BATCH, INT8_HIDDEN)).astype(np.float32)
+        size=(batch, hidden)).astype(np.float32)
 
-    def measure(im):
+    def measure_dispatch(im):
+        """Per-``predict`` wall time: includes host↔device transfer of the
+        (B, H) input and (B, C) output every call — through the axon tunnel
+        that transfer+RTT dominates, so this is the *serving-path* number,
+        not the compute number. Few iterations suffice: transfer+RTT is the
+        bulk of every call, and at the TPU shape each call moves ~256 MB."""
+        n = min(INT8_ITERS, 5) if x.nbytes > 2 ** 26 else INT8_ITERS
         im.predict(x)                       # compile + warm
         t0 = time.perf_counter()
-        for _ in range(INT8_ITERS):
+        for _ in range(n):
             out = im.predict(x)
-        return (time.perf_counter() - t0) / INT8_ITERS, out
+        return (time.perf_counter() - t0) / n, out, n
+
+    def measure_device(im):
+        """Device-resident compute time: the input lives in HBM and the
+        iterations chain inside ONE compiled program (``fori_loop`` with a
+        non-eliminable data dependency between steps), closed by a single
+        host sync. This isolates the MXU int8-vs-bf16 question from tunnel
+        RTT and PCIe/tunnel transfer — the number the reference's OpenVINO
+        "up to 2× int8 speedup" claim is about."""
+        import jax
+        import jax.numpy as jnp
+
+        apply, params, state = im.device_apply()
+        xd = jax.device_put(jnp.asarray(x))
+
+        def loop(params, state, x0):
+            def body(_, carry):
+                xc, acc = carry
+                y = apply(params, state, xc)
+                # serialize iterations: next input depends on this output by
+                # an amount too small to change values but opaque to DCE
+                eps = jnp.max(y).astype(jnp.float32) * 1e-30
+                return (x0 + eps, acc + eps)
+
+            _, acc = jax.lax.fori_loop(0, INT8_ITERS, body,
+                                       (x0, jnp.float32(0)))
+            return acc
+
+        # AOT-compile so warmup doesn't execute the full loop, then one warm
+        # run (device-resident, cheap) before the timed one
+        compiled = jax.jit(loop).lower(params, state, xd).compile()
+        float(compiled(params, state, xd))
+        t0 = time.perf_counter()
+        float(compiled(params, state, xd))
+        return (time.perf_counter() - t0) / INT8_ITERS
 
     # the baseline is the bf16 MXU path — the honest comparison point
     # (f32 would flatter the int8 speedup 2×)
@@ -189,11 +274,13 @@ def run_int8_bench() -> dict:
     prev = compute_dtype()
     set_policy(compute_dtype="bfloat16")
     try:
-        im_f = InferenceModel(max_batch_size=INT8_BATCH).load(model)
-        t_float, out_f = measure(im_f)
-        im_q = InferenceModel(max_batch_size=INT8_BATCH).load(model)
+        im_f = InferenceModel(max_batch_size=batch).load(model)
+        t_float, out_f, n_disp = measure_dispatch(im_f)
+        dev_float = measure_device(im_f)
+        im_q = InferenceModel(max_batch_size=batch).load(model)
         im_q.quantize_int8()
-        t_int8, out_q = measure(im_q)
+        t_int8, out_q, _ = measure_dispatch(im_q)
+        dev_int8 = measure_device(im_q)
     finally:
         set_policy(compute_dtype=prev)
     out_f = np.asarray(out_f, np.float32)
@@ -201,10 +288,16 @@ def run_int8_bench() -> dict:
 
     agree = float((out_f.argmax(-1) == out_q.argmax(-1)).mean())
     return {
-        "speedup_vs_bf16": round(t_float / t_int8, 3),
-        "bf16_ms": round(t_float * 1e3, 3),
-        "int8_ms": round(t_int8 * 1e3, 3),
-        "batch": INT8_BATCH, "hidden": INT8_HIDDEN, "iters": INT8_ITERS,
+        # headline = device compute (what int8-on-MXU is about); the
+        # dispatch_* rows keep the end-to-end predict() cost incl. transfer
+        "speedup_vs_bf16": round(dev_float / dev_int8, 3),
+        "bf16_ms": round(dev_float * 1e3, 3),
+        "int8_ms": round(dev_int8 * 1e3, 3),
+        "dispatch_speedup_vs_bf16": round(t_float / t_int8, 3),
+        "dispatch_bf16_ms": round(t_float * 1e3, 3),
+        "dispatch_int8_ms": round(t_int8 * 1e3, 3),
+        "batch": batch, "hidden": hidden, "iters": INT8_ITERS,
+        "dispatch_iters": n_disp,
         "argmax_agreement": agree,
         "max_prob_diff": round(float(np.max(np.abs(out_f - out_q))), 5),
     }
@@ -218,8 +311,30 @@ if __name__ == "__main__":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    result = run_bench()
+    im = build_model()
+    result = run_bench(im)
     result["platform"] = "tpu" if on_accel else "cpu"
+    try:
+        result["dispatch_rtt_ms"] = measure_dispatch_rtt_ms()
+    except Exception as e:
+        print(f"[serving_bench] rtt probe failed: {e}", file=sys.stderr)
+        result["dispatch_rtt_ms"] = None
+    # closed-loop throughput is capped at mean_batch × in_flight / rtt; when
+    # the tunnel RTT is large (remote chip), a second configuration with more
+    # concurrent clients + a wider coalescing window shows the micro-batcher
+    # amortizing the RTT — the deployment-relevant number for a remote
+    # accelerator. On a local chip (rtt <~2ms) the default config already
+    # saturates and the extra run is skipped.
+    try:
+        rtt = result.get("dispatch_rtt_ms") or 0.0
+        if rtt > 5.0:
+            pip = run_bench(im, n_clients=64, requests_per_client=20,
+                            max_delay_ms=max(10.0, min(50.0, rtt / 2)))
+            pip.pop("metric", None)
+            result["pipelined"] = pip
+    except Exception as e:
+        print(f"[serving_bench] pipelined entry failed: {e}", file=sys.stderr)
+        result["pipelined"] = None
     try:
         result["int8"] = run_int8_bench()
     except Exception as e:  # additive entry; never break the artifact
