@@ -69,7 +69,14 @@ class TransformerLM(Layer, KerasNet):
         params["ln_f"] = lnf
         return params, {}
 
-    def apply(self, params, state, x, *, training=False, rng=None):
+    def apply_features(self, params, x, *, training=False, rng=None):
+        """Hidden states BEFORE the LM head: (B, T, hidden).
+
+        Pair with :func:`analytics_zoo_tpu.ops.fused_ce.fused_softmax_xent`
+        (``fused_softmax_xent(h, params["logits_kernel"], labels)``) to train
+        without ever materializing the (B, T, vocab) logits — at vocab 32k
+        the f32 logits are 1 GB per 8k tokens, which is what pushes big
+        batches into rematerialization."""
         ids = jnp.asarray(x, jnp.int32)
         h = jnp.take(params["token_embeddings"], ids, axis=0)
         h = h + params["pos_embeddings"][: ids.shape[1]][None]
@@ -89,6 +96,10 @@ class TransformerLM(Layer, KerasNet):
                 h, _ = blk.apply(params[f"block{i}"], {}, h, training=training,
                                  rng=rngs[i])
         h, _ = self.ln_f.apply(params["ln_f"], {}, h)
+        return h
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        h = self.apply_features(params, x, training=training, rng=rng)
         logits = h @ jnp.asarray(params["logits_kernel"], h.dtype)
         return logits, state
 
