@@ -71,7 +71,10 @@ def build_model():
     x = rng.normal(size=(256, FEATURES)).astype(np.float32)
     y = np.eye(CLASSES, dtype=np.float32)[rng.integers(0, CLASSES, 256)]
     model.fit(x, y, batch_size=64, nb_epoch=1)
-    return InferenceModel(max_batch_size=max(64, N_CLIENTS * 2)).load(model)
+    # batch ceiling 256: headroom so the pipelined leg (and any env-raised
+    # client count) coalesces its whole in-flight set into one dispatch —
+    # predict() must never chunk a coalesced micro-batch
+    return InferenceModel(max_batch_size=max(256, N_CLIENTS * 2)).load(model)
 
 
 def run_bench(im=None, n_clients: int = N_CLIENTS,
@@ -108,7 +111,8 @@ def run_bench(im=None, n_clients: int = N_CLIENTS,
     # warm every bucketed executable the micro-batcher can hit — otherwise
     # first-use XLA compiles land inside the measured window
     rng_w = np.random.default_rng(2)
-    for b in (1, 2, 4, 8, 16, 32, coalesce):
+    from analytics_zoo_tpu.inference.inference_model import _buckets
+    for b in [b for b in _buckets(im.max_batch_size) if b <= coalesce] + [coalesce]:
         im.predict(rng_w.normal(size=(b, FEATURES)).astype(np.float32))
     warm = http.client.HTTPConnection("127.0.0.1", app.port, timeout=60)
     for p in payloads[:2]:
@@ -328,6 +332,12 @@ if __name__ == "__main__":
     try:
         rtt = result.get("dispatch_rtt_ms") or 0.0
         if rtt > 5.0:
+            # closed-loop ceiling is in_flight / RTT once the batcher
+            # coalesces everything in flight, but the Python HTTP+batcher
+            # host path tops out well before that: measured on the 75 ms
+            # tunnel, 64 clients give ~466 req/s at p99 ~225 ms, 128 give
+            # ~445 at p99 420 ms, 256 give ~561 at p99 1.4 s — 64 is the
+            # throughput/latency sweet spot
             pip = run_bench(im, n_clients=64, requests_per_client=20,
                             max_delay_ms=max(10.0, min(50.0, rtt / 2)))
             pip.pop("metric", None)
