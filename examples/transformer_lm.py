@@ -23,6 +23,19 @@ def main():
     logits = model.predict(ids[:4, :-1])
     print("logits:", logits.shape)  # (4, seq, vocab)
 
+    # memory-constrained variant: train WITHOUT materializing (B, T, vocab)
+    # logits — apply_features + the fused chunked cross-entropy
+    # (ops/fused_ce.py; the LM-head analog of flash attention)
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops.fused_ce import fused_softmax_xent
+
+    params = model.estimator.train_state["params"]
+    h = model.apply_features(params, jnp.asarray(ids[:4, :-1]))
+    loss = fused_softmax_xent(h, params["logits_kernel"].astype(h.dtype),
+                              jnp.asarray(ids[:4, 1:]), chunk=64)
+    print("fused-CE loss (no logits tensor):", float(loss))
+
 
 if __name__ == "__main__":
     main()

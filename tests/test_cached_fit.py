@@ -94,3 +94,31 @@ def test_cached_shuffled_trains():
     x, y = _data()
     res = est.evaluate((x, y), batch_size=64, metrics=("accuracy",))
     assert res["sparse_categorical_accuracy"] > 0.5
+
+
+def test_epoch_loss_is_lazy_and_fit_blocks():
+    """The epoch epilogue must NOT materialize the loss scalar (on a
+    remote-chip transport that costs one full network RTT per epoch inside
+    the timed path); TrainerState.last_loss converts on first read, and
+    fit() returning implies the final state is actually computed."""
+    from analytics_zoo_tpu.nn.optimizers import Adam
+
+    x, y = _data()
+    est = Estimator(_mlp(), optimizer=Adam(lr=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    config=TrainConfig(log_every_n_steps=10 ** 9,
+                                       cache_on_device=True,
+                                       scan_block_steps=10))
+    est.fit((x, y), batch_size=64, epochs=2)
+    ts = est.trainer_state
+    stored = ts._last_loss
+    assert not isinstance(stored, float), (
+        "epoch epilogue eagerly materialized the loss — re-introducing one "
+        "host round trip per epoch")
+    # fit() already blocked on the train state, so the device value is final
+    val = ts.last_loss
+    assert isinstance(val, float) and np.isfinite(val)
+    assert isinstance(ts._last_loss, float)   # memoized after first read
+    # repr must not expose the loss at all (printing the property would
+    # force a device sync; printing the slot would embed an array repr)
+    assert "last_loss" not in repr(est.trainer_state)
