@@ -74,6 +74,16 @@ PYEOF
   fi
 }
 
+host_busy() {
+  # a capture taken during a test-suite / build storm measures host
+  # contention, not the framework (the device window itself is robust, but
+  # the CPU-baseline subprocess and warmups aren't) — defer unless the
+  # freshest capture is REALLY old
+  local load
+  load=$(cut -d' ' -f1 /proc/loadavg)
+  awk -v l="$load" -v t="${LOAD_MAX:-2.0}" 'BEGIN{exit !(l > t)}'
+}
+
 echo "[watch] started $(date -u) repo=$REPO probe_every=${PROBE_EVERY}s"
 while true; do
   if probe; then
@@ -81,7 +91,11 @@ while true; do
     [ -f "$STAMP" ] && last=$(cat "$STAMP")
     age=$(( $(date +%s) - last ))
     if [ "$age" -gt "$REFRESH_S" ]; then
-      capture
+      if host_busy && [ "$age" -lt $(( REFRESH_S * 4 )) ]; then
+        echo "[watch $(date -u +%H:%M:%S)] tunnel up but host busy (load $(cut -d' ' -f1 /proc/loadavg)) — defer"
+      else
+        capture
+      fi
     else
       echo "[watch $(date -u +%H:%M:%S)] tunnel up; capture is ${age}s old — skip"
     fi
