@@ -183,3 +183,33 @@ def test_int8_imported_graph_falls_back_to_weight_only(zoo_ctx, np_rng):
     assert im.is_quantized
     x = np_rng.normal(size=(4, 64)).astype("float32")
     np.testing.assert_allclose(im.predict(x), x @ w, atol=0.05)
+
+
+def test_device_apply_matches_predict_incl_int8(zoo_ctx, np_rng):
+    """device_apply() is the public device-resident escape hatch (AOT export,
+    serving_bench's int8-vs-bf16 loop): it must expose exactly the predict
+    computation, before AND after quantize_int8 rewires apply/params."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops.int8 import is_quantized
+
+    model, x = _fitted_model(np_rng)
+    im = InferenceModel(max_batch_size=64).load(model)
+    apply_fn, params, state = im.device_apply()
+    got = np.asarray(apply_fn(params, state, jnp.asarray(x)))
+    np.testing.assert_allclose(got, im.predict(x), rtol=1e-5, atol=1e-5)
+
+    im.quantize_int8(min_elements=1)
+    q_apply, q_params, q_state = im.device_apply()
+    # really rewired: some leaf now carries the packed {'q','scale'} form
+    import jax
+
+    packed = jax.tree_util.tree_leaves(q_params, is_leaf=is_quantized)
+    assert any(is_quantized(l) for l in packed)
+    got_q = np.asarray(q_apply(q_params, q_state, jnp.asarray(x)))
+    np.testing.assert_allclose(got_q, im.predict(x), rtol=1e-5, atol=1e-5)
+
+
+def test_device_apply_requires_loaded_model(zoo_ctx):
+    with pytest.raises(RuntimeError):
+        InferenceModel().device_apply()
