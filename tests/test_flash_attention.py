@@ -237,3 +237,21 @@ def test_default_blocks_env_knobs(monkeypatch):
     monkeypatch.setenv("ZOO_FLASH_BLOCK_Q", "256")
     monkeypatch.setenv("ZOO_FLASH_BLOCK_K", "512")
     assert default_blocks() == (256, 512)
+
+
+def test_default_blocks_adaptive(monkeypatch):
+    """Tile adaptivity is a 4× kernel lever (LONGCTX_BENCH.json): largest
+    power-of-two ≤512 dividing the sequence; env always wins; unknown or
+    non-dividing lengths keep the 128 fallback (callers then fall back to
+    full attention exactly as before)."""
+    from analytics_zoo_tpu.ops.flash_attention import default_blocks
+
+    monkeypatch.delenv("ZOO_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.delenv("ZOO_FLASH_BLOCK_K", raising=False)
+    assert default_blocks(2048, 2048) == (512, 512)
+    assert default_blocks(512, 1024) == (512, 512)
+    assert default_blocks(256, 384) == (256, 128)   # 384 = 3·128
+    assert default_blocks(16384, None) == (512, 128)
+    assert default_blocks(300, 300) == (128, 128)   # non-dividing: fallback
+    monkeypatch.setenv("ZOO_FLASH_BLOCK_Q", "1024")
+    assert default_blocks(2048, 2048) == (1024, 512)  # env wins per-axis
