@@ -80,11 +80,30 @@ class MultiHeadAttention(Layer):
         if mesh is not None and self.attn_strategy != "full":
             o = sharded_attention(q, k, v, mesh, strategy=self.attn_strategy,
                                   causal=self.causal)
+        elif self._flash_single_device(t):
+            # no mesh context: an explicit 'flash' still means the kernel
+            # (it falls back internally when pallas is unavailable or the
+            # tiles don't divide), and 'auto' prefers it on TPU at the
+            # lengths where it measurably wins (LONGCTX_BENCH.json: faster
+            # than XLA full attention from 4k up, equal at 2k, and the only
+            # option past 16k where the (H, T, T) scores OOM)
+            from ...ops.flash_attention import flash_attention
+
+            o = flash_attention(q, k, v, self.causal)
         else:
             o = full_attention(q, k, v, causal=self.causal)
         o = o.reshape(b, t, self.hidden_size)
         return o @ jnp.asarray(params["out_kernel"], x.dtype) + jnp.asarray(
             params["out_bias"], x.dtype), state
+
+    def _flash_single_device(self, t: int) -> bool:
+        if self.attn_strategy == "flash":
+            return True
+        if self.attn_strategy == "auto":
+            from ...ops.attention import prefer_flash_single_device
+
+            return prefer_flash_single_device(t)
+        return False
 
     def _mesh(self):
         try:

@@ -500,6 +500,15 @@ def ulysses_attention_local(q, k, v, *, axis_name: str = "sp",
     return a2a(o, 1, 2)
 
 
+def prefer_flash_single_device(t: int) -> bool:
+    """Auto-dispatch rule shared by the layer (mesh-less) and
+    :func:`sharded_attention` (sp==1) paths, so both resolve identically:
+    on TPU the pallas kernel beats XLA full attention from 4k up, matches
+    it at 2k at the model level (LONGCTX_BENCH.json, MFU_SWEEP.json), and
+    is the only option once the (H, T, T) score tensor would OOM."""
+    return jax.default_backend() == "tpu" and t >= 2048
+
+
 def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
                       causal: bool = False, seq_axis: str = "sp",
                       batch_axes=("dp", "fsdp"), head_axis: str = "tp"):
@@ -521,7 +530,7 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
             strategy = ("zigzag" if causal and _zigzag_ok(q.shape[1], sp)
                         else "ring")
         else:
-            strategy = "full"
+            strategy = "flash" if prefer_flash_single_device(q.shape[1])                 else "full"
     if strategy == "flash":
         if sp > 1:
             raise ValueError(
