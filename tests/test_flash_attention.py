@@ -255,3 +255,16 @@ def test_default_blocks_adaptive(monkeypatch):
     assert default_blocks(300, 300) == (128, 128)   # non-dividing: fallback
     monkeypatch.setenv("ZOO_FLASH_BLOCK_Q", "1024")
     assert default_blocks(2048, 2048) == (1024, 512)  # env wins per-axis
+
+
+def test_prefer_flash_single_device_rule(monkeypatch):
+    """Shared auto-dispatch rule (layer mesh-less path == sharded sp==1 path):
+    flash on TPU from 2k tokens, full elsewhere."""
+    import analytics_zoo_tpu.ops.attention as A
+
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "tpu")
+    assert A.prefer_flash_single_device(2048)
+    assert A.prefer_flash_single_device(65536)
+    assert not A.prefer_flash_single_device(512)
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "cpu")
+    assert not A.prefer_flash_single_device(65536)
