@@ -89,6 +89,12 @@ class Estimator:
         self.tx = with_clipping(self._base_tx, self.config.gradient_clip_norm,
                                 self.config.gradient_clip_value)
         self.mesh = mesh if mesh is not None else get_zoo_context().mesh
+        # models that carry their own placement strategy (e.g.
+        # PipelinedTransformerLM's stage-over-pp layout) expose
+        # ``param_spec(path, leaf) -> PartitionSpec``; an explicit
+        # param_sharding argument still wins
+        if param_sharding is None:
+            param_sharding = getattr(model, "param_spec", None)
         self.param_sharding = param_sharding
         self.train_state: Optional[Dict[str, Any]] = None
         self.trainer_state = TrainerState()

@@ -270,12 +270,7 @@ class InferenceModel:
                 return b
         return self.max_batch_size
 
-    def predict(self, inputs, batch_first: bool = True):
-        """Thread-safe bounded-concurrency predict (doPredict parity).
-
-        ``inputs``: ndarray or list/tuple of ndarrays (multi-input models).
-        Requests larger than ``max_batch_size`` are chunked.
-        """
+    def _validate_inputs(self, inputs):
         if self._apply is None:
             raise RuntimeError("no model loaded (call load/load_zoo first)")
         multi = isinstance(inputs, (list, tuple))
@@ -283,37 +278,99 @@ class InferenceModel:
         n = arrs[0].shape[0]
         if any(a.shape[0] != n for a in arrs):
             raise ValueError("all inputs must share the batch dimension")
+        return arrs, multi, n
 
+    def _dispatch_chunks(self, arrs, multi, n):
+        """Pad each ≤max_batch chunk to its bucket and ENQUEUE the executable
+        — returns ``[(device_result, valid_count), ...]`` without waiting.
+        JAX dispatch is asynchronous, so the device (or the tunnel to it)
+        starts working immediately; only fetching blocks."""
+        dispatched = []
+        for lo in range(0, n, self.max_batch_size):
+            hi = min(lo + self.max_batch_size, n)
+            bucket = self._bucket(hi - lo)
+            padded = [_pad_to(a[lo:hi], bucket) for a in arrs]
+            x = padded if multi else padded[0]
+            key = (bucket,) + tuple((a.shape[1:], str(a.dtype))
+                                    for a in padded)
+            with timing("inference.forward"):
+                y = self._executable(key)(self._params, self._state, x)
+            dispatched.append((y, hi - lo))
+        return dispatched
+
+    @staticmethod
+    def _gather_chunks(dispatched):
+        outs = [jax.tree_util.tree_map(
+                    lambda a: np.asarray(jax.device_get(a))[:m], y)
+                for y, m in dispatched]
+        if len(outs) == 1:
+            return outs[0]
+        return jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *outs)
+
+    def predict(self, inputs, batch_first: bool = True):
+        """Thread-safe bounded-concurrency predict (doPredict parity).
+
+        ``inputs``: ndarray or list/tuple of ndarrays (multi-input models).
+        Requests larger than ``max_batch_size`` are chunked.
+        """
+        arrs, multi, n = self._validate_inputs(inputs)
         t0 = time.perf_counter()
         with self._sem:
             with self._lock:
                 self._borrowed += 1
                 self.borrowed_peak = max(self.borrowed_peak, self._borrowed)
             try:
-                outs = []
-                for lo in range(0, n, self.max_batch_size):
-                    hi = min(lo + self.max_batch_size, n)
-                    bucket = self._bucket(hi - lo)
-                    padded = [_pad_to(a[lo:hi], bucket) for a in arrs]
-                    x = padded if multi else padded[0]
-                    key = (bucket,) + tuple((a.shape[1:], str(a.dtype))
-                                            for a in padded)
-                    with timing("inference.forward"):
-                        y = self._executable(key)(self._params, self._state, x)
-                    y = jax.tree_util.tree_map(
-                        lambda a: np.asarray(jax.device_get(a))[:hi - lo], y)
-                    outs.append(y)
+                result = self._gather_chunks(
+                    self._dispatch_chunks(arrs, multi, n))
             finally:
                 with self._lock:
                     self._borrowed -= 1
-        if len(outs) == 1:
-            result = outs[0]
-        else:
-            result = jax.tree_util.tree_map(
-                lambda *xs: np.concatenate(xs, axis=0), *outs)
         if self.summary is not None:
             self.summary.add_batch(n, time.perf_counter() - t0)
         return result
+
+    def predict_async(self, inputs):
+        """Dispatch a predict WITHOUT waiting; returns ``fetch() -> result``.
+
+        The XLA execution (and its host→device transfer) is enqueued before
+        this returns; ``fetch()`` blocks only on the device→host result
+        transfer. On a remote accelerator this is what lets a caller overlap
+        the round-trip of batch N with assembling/dispatching batch N+1 —
+        the serving engine's double-buffered dispatch rides this.
+
+        The concurrency semaphore is held from dispatch until ``fetch()``
+        completes (an in-flight request IS a borrowed replica); every
+        returned ``fetch`` must therefore be called exactly once.
+        """
+        arrs, multi, n = self._validate_inputs(inputs)
+        t0 = time.perf_counter()
+        self._sem.acquire()
+        with self._lock:
+            self._borrowed += 1
+            self.borrowed_peak = max(self.borrowed_peak, self._borrowed)
+        try:
+            dispatched = self._dispatch_chunks(arrs, multi, n)
+        except BaseException:
+            with self._lock:
+                self._borrowed -= 1
+            self._sem.release()
+            raise
+        done = threading.Event()  # fetch-once guard (idempotent release)
+
+        def fetch():
+            try:
+                return self._gather_chunks(dispatched)
+            finally:
+                if not done.is_set():
+                    done.set()
+                    with self._lock:
+                        self._borrowed -= 1
+                    self._sem.release()
+                    if self.summary is not None:
+                        self.summary.add_batch(n, time.perf_counter() - t0)
+
+        return fetch
 
     # ------------------------------------------------------- device-level access
 

@@ -530,7 +530,8 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
             strategy = ("zigzag" if causal and _zigzag_ok(q.shape[1], sp)
                         else "ring")
         else:
-            strategy = "flash" if prefer_flash_single_device(q.shape[1])                 else "full"
+            strategy = ("flash" if prefer_flash_single_device(q.shape[1])
+                        else "full")
     if strategy == "flash":
         if sp > 1:
             raise ValueError(

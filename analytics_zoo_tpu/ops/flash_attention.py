@@ -28,8 +28,17 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 NEG_INF = -1e30
+
+#: Flash-aware rematerialization policy: under ``jax.checkpoint`` save ONLY the
+#: flash kernel's output + log-sum-exp (tagged in ``_flash_attention_fwd_res``),
+#: so the backward pass reuses the kernel's saved statistics — the attention
+#: recompute (the expensive O(T^2) part of plain remat) disappears while the
+#: cheap projections/layernorms/MLP still recompute for the memory win.
+FLASH_REMAT_POLICY = jax.checkpoint_policies.save_only_these_names(
+    "flash_out", "flash_lse")
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -395,6 +404,13 @@ def _flash_attention_fwd_res(q, k, v, causal, block_q, block_k, interpret):
         return out, None
     out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
                           block_k=block_k, interpret=interpret)
+    # checkpoint_name is identity outside jax.checkpoint; under a
+    # save_only_these_names policy (FLASH_REMAT_POLICY) these tags make the
+    # kernel's output + log-sum-exp the SAVED residuals, so a rematerialized
+    # backward reuses them instead of re-running the O(T^2) flash forward —
+    # only the cheap projections/elementwise around it recompute.
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 

@@ -41,6 +41,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _chunk_logits(h_c, kernel):
@@ -63,7 +64,11 @@ def _prepare(h, labels, chunk):
     H = h.shape[-1]
     hf, lf = h.reshape(-1, H), labels.reshape(-1)
     n = hf.shape[0]
-    chunk = min(chunk, n) if n else 1
+    if n == 0:
+        raise ValueError(
+            "fused_softmax_xent: zero tokens (h has an empty leading shape); "
+            "the mean over n=0 tokens is undefined")
+    chunk = min(chunk, n)
     pad = (-n) % chunk
     if pad:
         hf = jnp.concatenate([hf, jnp.zeros((pad, H), hf.dtype)])
@@ -124,8 +129,10 @@ def _vjp_bwd(chunk, res, g):
     dW, dh3 = jax.lax.scan(
         step, jnp.zeros(kernel.shape, jnp.float32), (h3, l3, valid3))
     dh = dh3.reshape(-1, h.shape[-1])[:n].reshape(h.shape)
-    return (dh.astype(h.dtype), dW.astype(kernel.dtype),
-            jnp.zeros_like(labels))
+    # Integer primals take a float0 symbolic-zero cotangent per JAX convention
+    # (a zeros_like int array only works while nothing extracts this grad).
+    dlabels = np.zeros(np.shape(labels), dtype=jax.dtypes.float0)
+    return (dh.astype(h.dtype), dW.astype(kernel.dtype), dlabels)
 
 
 fused_softmax_xent.defvjp(_vjp_fwd, _vjp_bwd)

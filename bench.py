@@ -283,8 +283,11 @@ def run_ncf(platform: str | None = None, train_epochs: int = TRAIN_EPOCHS) -> di
                 epochs=est.trainer_state.epoch + measured_epochs)
         _sync()
         dt2 = time.perf_counter() - t0
-        plausible = [d for d in (dt, dt2) if d > 0.2]
+        windows = [dt, dt2]
+        plausible = [d for d in windows if d > 0.2]
         dt = min(plausible) if plausible else dt
+    else:
+        windows = [dt]
     samples_per_sec = measured_steps * BATCH / dt
     return {
         "samples_per_sec": round(samples_per_sec, 1),
@@ -292,6 +295,12 @@ def run_ncf(platform: str | None = None, train_epochs: int = TRAIN_EPOCHS) -> di
         "n_chips": n_chips,
         "measured_steps": measured_steps,
         "measured_seconds": round(dt, 3),
+        # timing provenance: every timed window, so a reader can tell a
+        # single-window reading from a best-of-2 selection (measured_seconds
+        # is the window actually reported)
+        "window_seconds": [round(d, 3) for d in windows],
+        "timing_policy": ("best_of_%d_windows" % len(windows)
+                         if len(windows) > 1 else "single_window"),
         "epochs": train_epochs,
         "hr@10": round(hr10, 4),
         "final_loss": float(est.trainer_state.last_loss),

@@ -1,0 +1,91 @@
+"""Probe: transformer_lm MFU at a fixed batch under each remat mode.
+
+Usage: python dev/remat_probe.py [batch] [mode ...]
+Measures the same step as bench.run_transformer_mfu (bf16 policy, flash
+attention, adam-bf16) so numbers are directly comparable to BENCH_r0N.json
+batch_sweep rows.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def measure(b, remat, seq_len=2048, hidden=1024, n_block=8, n_head=8,
+            vocab=32768, budget_s=6.0, fused_ce=False):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from analytics_zoo_tpu.models.transformer import TransformerLM, lm_loss
+
+    model = TransformerLM(vocab=vocab, hidden_size=hidden, n_block=n_block,
+                          n_head=n_head, seq_len=seq_len,
+                          attn_strategy="flash", remat=remat)
+    params, _ = model.build(jax.random.PRNGKey(0))
+    tx = optax.adam(1e-3, mu_dtype=jnp.bfloat16)
+    opt_state = tx.init(params)
+
+    if fused_ce:
+        from analytics_zoo_tpu.ops.fused_ce import fused_softmax_xent
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, ids, labels):
+        def loss_of(p):
+            if fused_ce:
+                h = model.apply_features(p, ids)
+                return fused_softmax_xent(h, p["logits_kernel"], labels)
+            logits, _ = model.apply(p, {}, ids)
+            return lm_loss(labels, logits)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (b, seq_len)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, ids, labels)
+    float(loss)
+
+    n_steps, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < budget_s or n_steps < 10:
+        for _ in range(10):
+            params, opt_state, loss = step(params, opt_state, ids, labels)
+        float(loss)
+        n_steps += 10
+    dt = time.perf_counter() - t0
+
+    tokens = b * seq_len
+    flops_per_step = (6 * 12 * hidden * hidden * n_block * tokens
+                      + 6 * n_block * b * seq_len * seq_len * hidden
+                      + 6 * tokens * hidden * vocab)
+    peak = 197e12
+    return {"batch": b, "remat": remat,
+            "mfu": round(flops_per_step * n_steps / dt / peak, 4),
+            "tokens_per_sec": round(n_steps * tokens / dt, 1),
+            "steps": n_steps, "seconds": round(dt, 2)}
+
+
+if __name__ == "__main__":
+    from analytics_zoo_tpu.nn.module import set_policy
+
+    set_policy(compute_dtype="bfloat16")
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    modes = sys.argv[2:] or ["full", "flash", "dots"]
+    for mode in modes:
+        fused = mode.endswith("+ce")
+        m = mode[:-3] if fused else mode
+        m = False if m == "none" else m
+        try:
+            r = measure(b, m, fused_ce=fused)
+            r["fused_ce"] = fused
+        except Exception as e:
+            r = {"batch": b, "remat": mode, "error": str(e)[:200]}
+        print(json.dumps(r), flush=True)
