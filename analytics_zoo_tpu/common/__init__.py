@@ -1,18 +1,30 @@
-"""Core runtime: context/mesh bootstrap, config, summaries, triggers."""
+"""Core runtime: context/mesh bootstrap, config, summaries, triggers,
+resilience (retry/backoff, circuit breaking, heartbeats) and chaos testing."""
 
+from .chaos import (ChaosSchedule, WorkerKilled, chaos_point, get_chaos,
+                    install_chaos, uninstall_chaos)
 from .config import (MeshConfig, PrecisionConfig, RuntimeConfig, TrainConfig,
                      apply_env_overrides)
 from .context import (ZooContext, build_mesh, get_zoo_context, init_zoo_context,
                       reset_zoo_context)
+from .resilience import (CircuitBreaker, CircuitOpenError,
+                         DeadlineExceededError, Heartbeat, HealthRegistry,
+                         ResilienceError, RetryAbortedError,
+                         RetryExhaustedError, RetryPolicy)
 from .summary import (EventWriter, TrainSummary, ValidationSummary, read_scalars,
                       timing)
 from .triggers import (EveryEpoch, MaxEpoch, MaxIteration, MaxScore, MinLoss,
                        SeveralIteration, Trigger, TrainerState)
 
 __all__ = [
-    "EventWriter", "EveryEpoch", "MaxEpoch", "MaxIteration", "MaxScore",
-    "MeshConfig", "MinLoss", "PrecisionConfig", "RuntimeConfig", "SeveralIteration",
-    "TrainConfig", "TrainSummary", "Trigger", "TrainerState", "ValidationSummary",
-    "ZooContext", "apply_env_overrides", "build_mesh", "get_zoo_context",
-    "init_zoo_context", "read_scalars", "reset_zoo_context", "timing",
+    "ChaosSchedule", "CircuitBreaker", "CircuitOpenError",
+    "DeadlineExceededError", "EventWriter", "EveryEpoch", "Heartbeat",
+    "HealthRegistry", "MaxEpoch", "MaxIteration", "MaxScore",
+    "MeshConfig", "MinLoss", "PrecisionConfig", "ResilienceError",
+    "RetryAbortedError", "RetryExhaustedError", "RetryPolicy", "RuntimeConfig",
+    "SeveralIteration", "TrainConfig", "TrainSummary", "Trigger",
+    "TrainerState", "ValidationSummary", "WorkerKilled", "ZooContext",
+    "apply_env_overrides", "build_mesh", "chaos_point", "get_chaos",
+    "get_zoo_context", "init_zoo_context", "install_chaos", "read_scalars",
+    "reset_zoo_context", "timing", "uninstall_chaos",
 ]

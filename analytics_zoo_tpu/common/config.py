@@ -121,6 +121,13 @@ class TrainConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every_n_iters: Optional[int] = None  # None => every epoch
     retry_times: int = 5                    # bigdl.failure.retryTimes parity
+    retry_backoff_s: float = 0.0            # base backoff between checkpoint
+                                            # rollback retries (exponential,
+                                            # capped at retry_max_backoff_s)
+    retry_max_backoff_s: float = 30.0
+    retry_deadline_s: Optional[float] = None  # overall retry-budget wall time
+    graceful_shutdown: bool = True          # SIGTERM during fit => save a
+                                            # final checkpoint, exit(143)
     log_every_n_steps: int = 50
     donate_state: bool = True               # donate params/opt-state buffers to the step
     shuffle: bool = True                    # per-epoch example shuffle; turn OFF for

@@ -18,6 +18,8 @@ Parity map (reference → here):
 from __future__ import annotations
 
 import logging
+import signal
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -27,8 +29,10 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..common.chaos import chaos_point
 from ..common.config import TrainConfig
 from ..common.context import get_zoo_context
+from ..common.resilience import ResilienceError, RetryPolicy
 from ..common.summary import TrainSummary, ValidationSummary
 from ..common.triggers import (EveryEpoch, MaxEpoch, SeveralIteration, Trigger,
                                TrainerState)
@@ -40,6 +44,11 @@ from ..nn.optimizers import get_optimizer, with_clipping
 from . import checkpoint as ckpt
 
 logger = logging.getLogger("analytics_zoo_tpu.estimator")
+
+
+class _GracefulStop(BaseException):
+    """Raised inside the epoch loop when SIGTERM requested a clean exit.
+    BaseException so the retry-from-checkpoint handler cannot absorb it."""
 
 
 def _overlay(base: dict, donated: dict) -> dict:
@@ -265,7 +274,13 @@ class Estimator:
 
         ``data``: FeatureSet or (x, y) arrays. ``batch_size`` is global.
         The loop structure mirrors InternalDistriOptimizer.train
-        (Topology.scala:1086-1269) including retry-from-checkpoint.
+        (Topology.scala:1086-1269) including retry-from-checkpoint — the
+        retry budget is policy-driven (TrainConfig.retry_times /
+        retry_backoff_s / retry_deadline_s through a
+        :class:`~analytics_zoo_tpu.common.resilience.RetryPolicy`), and with
+        ``config.graceful_shutdown`` a SIGTERM mid-fit saves one final
+        checkpoint before exiting with status 143 — the preemption-safe
+        teardown a supervisor (k8s, borg) expects.
         """
         cfg = self.config
         batch_size = batch_size or cfg.batch_size
@@ -293,36 +308,78 @@ class Estimator:
                     self.trainer_state.epoch = meta["epoch"]
                     logger.info("resumed from %s (iter %d)", latest, meta["iteration"])
 
-        retries = 0
-        while not end_trigger(self.trainer_state):
-            try:
-                self._run_epoch(train_set, batch_size, checkpoint_trigger)
-            except (KeyboardInterrupt, ValueError, TypeError):
-                raise
-            except Exception as e:  # retry-from-checkpoint (Topology.scala:1181-1263)
-                retries += 1
-                if not cfg.checkpoint_dir or retries > cfg.retry_times:
+        # retry-from-checkpoint budget (Topology.scala:1181-1263), now policy-
+        # driven: retry_times attempts with exponential backoff between
+        # rollbacks and an optional overall deadline. The policy is the shared
+        # resilience primitive; the rollback side effects stay here.
+        retry_policy = RetryPolicy(
+            max_attempts=cfg.retry_times + 1, base_delay_s=cfg.retry_backoff_s,
+            max_delay_s=cfg.retry_max_backoff_s,
+            deadline_s=cfg.retry_deadline_s, jitter=0.1, seed=seed)
+        tracker = retry_policy.tracker()
+        self._sigterm = False
+        prev_handler = None
+        handler_installed = (cfg.graceful_shutdown
+                             and threading.current_thread()
+                             is threading.main_thread())
+        if handler_installed:
+            prev_handler = signal.signal(
+                signal.SIGTERM,
+                lambda *_: setattr(self, "_sigterm", True))
+        try:
+            while not end_trigger(self.trainer_state):
+                try:
+                    self._run_epoch(train_set, batch_size, checkpoint_trigger)
+                except (KeyboardInterrupt, ValueError, TypeError):
                     raise
-                latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
-                if latest is None:
-                    raise
-                logger.warning("step failed (%s); retry %d/%d from %s",
-                               e, retries, cfg.retry_times, latest)
-                restored, meta = ckpt.load_checkpoint(latest, self.train_state)
-                self.train_state = self._place_state(restored)
-                self.trainer_state.iteration = meta["iteration"]
-                self.trainer_state.epoch = meta["epoch"]
-                continue
+                except Exception as e:  # retry-from-checkpoint
+                    if not cfg.checkpoint_dir:
+                        raise
+                    latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+                    if latest is None:
+                        raise
+                    try:
+                        delay = tracker.record_failure(e)
+                    except ResilienceError:
+                        # budget exhausted / deadline passed: surface the
+                        # ORIGINAL failure (reference semantics — callers see
+                        # what actually broke, with the policy error chained)
+                        raise e
+                    logger.warning("step failed (%s); retry %d/%d from %s "
+                                   "in %.2fs", e, tracker.attempts,
+                                   cfg.retry_times, latest, delay)
+                    if delay > 0:
+                        (retry_policy.sleep or time.sleep)(delay)
+                    restored, meta = ckpt.load_checkpoint(latest, self.train_state)
+                    self.train_state = self._place_state(restored)
+                    self.trainer_state.iteration = meta["iteration"]
+                    self.trainer_state.epoch = meta["epoch"]
+                    continue
 
-            if validation_data is not None and validation_metrics:
-                results = self.evaluate(validation_data, batch_size=batch_size,
-                                        metrics=validation_metrics)
-                # the FIRST metric is the primary score (max() would pick an
-                # error metric like mse when mixed with accuracies)
-                self.trainer_state.last_score = next(iter(results.values()))
-                if self.val_summary:
-                    self.val_summary.add_scalars(self.trainer_state.iteration, results)
-                logger.info("epoch %d validation: %s", self.trainer_state.epoch, results)
+                if validation_data is not None and validation_metrics:
+                    results = self.evaluate(validation_data, batch_size=batch_size,
+                                            metrics=validation_metrics)
+                    # the FIRST metric is the primary score (max() would pick an
+                    # error metric like mse when mixed with accuracies)
+                    self.trainer_state.last_score = next(iter(results.values()))
+                    if self.val_summary:
+                        self.val_summary.add_scalars(self.trainer_state.iteration,
+                                                     results)
+                    logger.info("epoch %d validation: %s",
+                                self.trainer_state.epoch, results)
+        except _GracefulStop:
+            # SIGTERM: persist one final checkpoint so the replacement run
+            # resumes exactly here, then exit 143 (128+SIGTERM) — the
+            # conventional graceful-termination status
+            jax.block_until_ready(self.train_state)
+            if cfg.checkpoint_dir:
+                self._save(cfg.checkpoint_dir)
+                logger.warning("SIGTERM: final checkpoint saved at iter %d; "
+                               "exiting", self.trainer_state.iteration)
+            raise SystemExit(143)
+        finally:
+            if handler_installed:
+                signal.signal(signal.SIGTERM, prev_handler)
         # fit() returning means training FINISHED: epochs only dispatch work
         # (epoch-final losses stay lazy device scalars — one host transfer per
         # epoch would cost a full network RTT on remote-chip topologies), so
@@ -372,6 +429,8 @@ class Estimator:
                 yield buf.pop(0)
 
         for global_batch in prefetched():
+            self._check_interrupt()
+            chaos_point("estimator.step")
             self.train_state, loss = self._train_step(self.train_state, global_batch)
             ts.iteration += 1
             seen += batch_size
@@ -462,6 +521,8 @@ class Estimator:
         seen = 0
         loss = None
         for b in range(n_blocks):
+            self._check_interrupt()
+            chaos_point("estimator.step")
             sel = idx[b * block * batch_size:(b + 1) * block * batch_size]
             idx_mat = sel.reshape(block, batch_size)
             self.train_state, losses = self._scan_block(
@@ -486,6 +547,8 @@ class Estimator:
                 self._save(cfg.checkpoint_dir)
         # trailing steps (< one block): per-batch path, gathering on device
         for s in range(n_blocks * block, n_steps):
+            self._check_interrupt()
+            chaos_point("estimator.step")
             sel = idx[s * batch_size:(s + 1) * batch_size]
             db = jax.tree_util.tree_map(lambda a: jnp.take(a, sel, axis=0),
                                         self._device_data)
@@ -496,6 +559,12 @@ class Estimator:
                     and cfg.checkpoint_dir):
                 self._save(cfg.checkpoint_dir)
         self._finish_epoch(t0, seen, loss)
+
+    def _check_interrupt(self):
+        """SIGTERM lands between device steps (a step is never torn mid-
+        collective; peers on other ranks don't wedge mid-psum)."""
+        if getattr(self, "_sigterm", False):
+            raise _GracefulStop()
 
     @staticmethod
     def _trigger_crossed(trigger: Trigger, ts: TrainerState, block: int) -> bool:

@@ -356,16 +356,21 @@ class InferenceModel:
                 self._borrowed -= 1
             self._sem.release()
             raise
-        done = threading.Event()  # fetch-once guard (idempotent release)
+        released = [False]  # fetch-once guard; check-and-set under self._lock
 
         def fetch():
             try:
                 return self._gather_chunks(dispatched)
             finally:
-                if not done.is_set():
-                    done.set()
-                    with self._lock:
+                # atomic test-and-set: two concurrent fetch() calls must not
+                # both release the semaphore / decrement _borrowed, or the
+                # concurrency bound silently inflates
+                with self._lock:
+                    first = not released[0]
+                    released[0] = True
+                    if first:
                         self._borrowed -= 1
+                if first:
                     self._sem.release()
                     if self.summary is not None:
                         self.summary.add_batch(n, time.perf_counter() - t0)

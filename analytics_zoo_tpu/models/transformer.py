@@ -25,7 +25,18 @@ from .common.zoo_model import register_model
 
 @register_model("TransformerLM")
 class TransformerLM(Layer, KerasNet):
-    """Decoder-only transformer over int token ids (B, T) → logits (B, T, V)."""
+    """Decoder-only transformer over int token ids (B, T) → logits (B, T, V).
+
+    .. note:: **remat policy remap.** ``remat=True`` now means ``'flash'``
+       (checkpoint with the flash-attention save policy: the kernel's
+       out/lse are pinned so backward never re-runs the O(T²) attention
+       forward — strictly faster than full recompute wherever flash runs).
+       Callers wanting the minimum-memory classic behavior — recompute
+       EVERYTHING in backward, and the only correct choice when attention
+       took the non-flash path — must now pass ``remat='full'`` explicitly.
+       ``remat='dots'`` additionally saves matmul outputs (less recompute,
+       more memory). See ``_remat_policy`` for the exact policies.
+    """
 
     def __init__(self, vocab: int, hidden_size: int = 256, n_block: int = 4,
                  n_head: int = 8, seq_len: int = 512,
@@ -222,12 +233,23 @@ class PipelinedTransformerLM(Layer, KerasNet):
         """``(path, leaf) -> PartitionSpec`` for Estimator(param_sharding=...):
         stacked block leaves shard their leading block axis over ``pp``
         (each device holds exactly its stage's weights, the GPipe layout);
-        everything else is replicated."""
+        everything else is replicated.
+
+        Matches the exact top-level ``'blocks'`` key — a substring test would
+        also capture unrelated params that merely mention "blocks" in a
+        nested name and mis-shard them."""
         from jax.sharding import PartitionSpec as P
 
-        pstr = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                        for p in path)
-        if "blocks" in pstr and getattr(leaf, "ndim", 0) >= 1:
+        top = path[0] if path else None
+        top_key = getattr(top, "key", getattr(top, "idx", None)) \
+            if top is not None else None
+        if top_key == "blocks" and getattr(leaf, "ndim", 0) >= 1:
+            _, pp = self._pp_mesh()
+            if pp > 1 and self.n_block % pp:
+                raise ValueError(
+                    f"n_block={self.n_block} is not divisible by the mesh's "
+                    f"pp={pp}: pipeline stages must hold equal block counts. "
+                    f"Choose n_block as a multiple of pp (or shrink pp).")
             return P("pp")
         return P()
 

@@ -351,11 +351,17 @@ class _Store:
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
+        from ..common.chaos import chaos_point
+
         store: _Store = self.server.store  # type: ignore[attr-defined]
         try:
             while True:
                 req = recv_msg(self.request)
                 cmd = req[0]
+                # deterministic fault site: a "fail" rule severs this client's
+                # connection mid-protocol (the except below closes it); a
+                # "delay" rule models a slow broker reply
+                chaos_point("broker.handle", tag=cmd)
                 if cmd == "XADD":
                     resp = store.xadd(req[1], req[2])
                 elif cmd == "XGROUPCREATE":

@@ -18,8 +18,8 @@ import argparse
 import socket
 import subprocess
 import sys
-import time
 
+from ..common.resilience import ResilienceError, RetryPolicy
 from .broker import recv_msg, send_msg
 
 
@@ -36,6 +36,22 @@ def _alive(host: str, port: int) -> bool:
         return False
 
 
+class _NotYet(Exception):
+    """Condition not met yet (retried under a RetryPolicy deadline)."""
+
+
+def _await_condition(check, wait_s: float) -> bool:
+    """Poll ``check`` (raises _NotYet until satisfied) under the shared
+    retry machinery: fixed 0.1s cadence, overall deadline ``wait_s``."""
+    policy = RetryPolicy(max_attempts=None, base_delay_s=0.1, multiplier=1.0,
+                         jitter=0.0, deadline_s=wait_s, retryable=(_NotYet,))
+    try:
+        policy.call(check)
+        return True
+    except ResilienceError:
+        return False
+
+
 def do_start(args) -> int:
     if _alive(args.host, args.port):
         print(f"broker already running on {args.host}:{args.port}")
@@ -47,16 +63,21 @@ def do_start(args) -> int:
     proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL,
                             start_new_session=True)
-    deadline = time.time() + args.wait
-    while time.time() < deadline:
-        if _alive(args.host, args.port):
+
+    def up():
+        if proc.poll() is not None:
+            raise RuntimeError(f"broker exited rc={proc.returncode}")
+        if not _alive(args.host, args.port):
+            raise _NotYet()
+
+    try:
+        if _await_condition(up, args.wait):
             print(f"broker started on {args.host}:{args.port} (pid {proc.pid})"
                   + (f", persisting to {args.aof}" if args.aof else ""))
             return 0
-        if proc.poll() is not None:
-            print(f"broker exited rc={proc.returncode}", file=sys.stderr)
-            return 1
-        time.sleep(0.1)
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
     print("broker did not come up in time", file=sys.stderr)
     return 1
 
@@ -69,12 +90,14 @@ def do_stop(args) -> int:
         _call(args.host, args.port, "SHUTDOWN")
     except (OSError, ConnectionError):
         pass
-    deadline = time.time() + args.wait
-    while time.time() < deadline:
-        if not _alive(args.host, args.port):
-            print("broker stopped")
-            return 0
-        time.sleep(0.1)
+
+    def down():
+        if _alive(args.host, args.port):
+            raise _NotYet()
+
+    if _await_condition(down, args.wait):
+        print("broker stopped")
+        return 0
     print("broker still answering after SHUTDOWN", file=sys.stderr)
     return 1
 

@@ -10,10 +10,12 @@ from __future__ import annotations
 import socket
 import threading
 import uuid
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common.chaos import chaos_point
+from ..common.resilience import RetryPolicy
 from .broker import recv_msg, send_msg
 from .schema import decode_payload, encode_payload
 
@@ -21,32 +23,102 @@ INPUT_STREAM = "serving_stream"
 RESULT_PREFIX = "result:"
 
 
-class _Conn:
-    """One broker connection; a lock serialises request/response pairs."""
+def default_conn_policy() -> RetryPolicy:
+    """Reconnect-with-backoff policy for broker connections: a broker bounce
+    (cluster-serving-restart) is survived transparently; a genuinely dead
+    broker surfaces as RetryExhaustedError within a few seconds."""
+    return RetryPolicy(max_attempts=6, base_delay_s=0.05, max_delay_s=1.0,
+                       attempt_timeout_s=5.0,
+                       retryable=(ConnectionError, OSError))
 
-    def __init__(self, host: str, port: int, timeout: Optional[float] = None):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
+
+class _Conn:
+    """One broker connection; a lock serialises request/response pairs.
+
+    With ``policy=None`` (the default) this is a bare eager connection whose
+    failures propagate — protocol-level tests and probes want that. With a
+    :class:`RetryPolicy`, the socket connects lazily and every ``call``
+    transparently reconnects-with-backoff on connection failures; ``abort``
+    (e.g. an engine's stop flag) ends the retry loop early. ``tag`` names the
+    connection at the ``conn.call`` chaos site so fault schedules can target
+    one role (engine source vs. client input) deterministically.
+    """
+
+    def __init__(self, host: str, port: int, timeout: Optional[float] = None,
+                 policy: Optional[RetryPolicy] = None,
+                 abort: Optional[Callable[[], bool]] = None,
+                 tag: Optional[str] = None):
+        self.host, self.port = host, port
+        self.policy = policy
+        self.abort = abort
+        self.tag = tag
+        self.timeout = (timeout if timeout is not None
+                        else policy.attempt_timeout_s if policy else None)
         self.lock = threading.Lock()
+        self.sock: Optional[socket.socket] = None
+        if policy is None:  # eager single-attempt connect (legacy semantics)
+            self._connect()
+
+    def _connect(self):
+        self.sock = socket.create_connection((self.host, self.port),
+                                             timeout=self.timeout)
+        if self.policy is not None:
+            # policy-managed conns: the connect timeout guards unreachable
+            # hosts, but replies to blocking ops (XREADGROUP block_ms, HGET
+            # timeouts) can legitimately take longer than any connect would,
+            # so reads stay blocking and failures come from the peer closing.
+            # Policy-less conns keep the legacy semantics: the caller's
+            # timeout bounds EVERY socket op, recv included (a probe against
+            # a wedged half-up broker must fail fast, not hang)
+            self.sock.settimeout(None)
+
+    def _drop(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+    def _attempt(self, req: List[Any]) -> Any:
+        try:
+            chaos_point("conn.call", tag=self.tag)
+            if self.sock is None:
+                self._connect()
+            send_msg(self.sock, req)
+            return recv_msg(self.sock)
+        except (ConnectionError, OSError):
+            self._drop()  # next attempt reconnects from scratch
+            raise
 
     def call(self, *req) -> Any:
         with self.lock:
-            send_msg(self.sock, list(req))
-            return recv_msg(self.sock)
+            if self.policy is None:
+                return self._attempt(list(req))
+            return self.policy.call(self._attempt, list(req),
+                                    abort=self.abort)
 
     def close(self):
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        # deliberately lock-free: closing from another thread must be able to
+        # unblock a call() stuck in recv (it raises and is NOT retried once
+        # the owner aborts/closes)
+        self._drop()
 
 
 class InputQueue:
-    """Producer side: enqueue named tensors for the serving job."""
+    """Producer side: enqueue named tensors for the serving job.
+
+    Connections reconnect-with-backoff under ``policy`` (at-least-once: an
+    XADD retried across a reconnect may duplicate the record; the serving
+    result hash is keyed by uri, so duplicates cost compute, not correctness).
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6380,
-                 stream: str = INPUT_STREAM):
+                 stream: str = INPUT_STREAM,
+                 policy: Optional[RetryPolicy] = None):
         self.stream = stream
-        self._conn = _Conn(host, port)
+        self._conn = _Conn(host, port, policy=policy or default_conn_policy(),
+                           tag="client.input")
 
     def enqueue(self, uri: Optional[str] = None, **data) -> str:
         """Enqueue one record. ``data``: name → ndarray (or scalars/str).
@@ -70,8 +142,10 @@ class InputQueue:
 class OutputQueue:
     """Consumer side: fetch results by uri or drain everything available."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 6380):
-        self._conn = _Conn(host, port)
+    def __init__(self, host: str = "127.0.0.1", port: int = 6380,
+                 policy: Optional[RetryPolicy] = None):
+        self._conn = _Conn(host, port, policy=policy or default_conn_policy(),
+                           tag="client.output")
         self._known: List[str] = []
 
     def register(self, uri: str) -> None:

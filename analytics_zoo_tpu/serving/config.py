@@ -23,6 +23,20 @@ class ServingConfig:
     top_n: Optional[int] = None          # postprocessing topN
     int8: bool = False                   # OpenVINO-int8 capability
     log_dir: Optional[str] = None        # InferenceSummary TB dir
+    # --- resilience (common.resilience wiring) ---
+    infer_workers: int = 1               # model-worker threads; dead ones are
+                                         # respawned by the engine supervisor
+    heartbeat_timeout_s: float = 60.0    # stage heartbeat staleness => dead in
+                                         # /healthz. Beats happen between
+                                         # batches, so the floor must exceed
+                                         # the longest single predict — first
+                                         # XLA compile on a real chip is
+                                         # 20-40s; 60 keeps warmup healthy
+    http_max_inflight: int = 64          # load shedding: beyond this, /predict
+                                         # answers 503 + Retry-After
+    breaker_failure_threshold: int = 5   # broker-path failures in the window
+                                         # that open the frontend's circuit
+    breaker_reset_timeout_s: float = 2.0 # open->half-open probe delay
 
     @classmethod
     def from_yaml(cls, path: str) -> "ServingConfig":
@@ -52,4 +66,9 @@ class ServingConfig:
         flat["top_n"] = int(tn) if tn is not None else None
         flat["int8"] = bool(raw.get("int8", model.get("int8", False)))
         flat["log_dir"] = raw.get("log_dir")
+        for key in ("infer_workers", "heartbeat_timeout_s",
+                    "http_max_inflight", "breaker_failure_threshold",
+                    "breaker_reset_timeout_s"):
+            if key in raw:
+                flat[key] = type(getattr(cls, key))(raw[key])
         return cls(**flat)

@@ -22,6 +22,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common.chaos import WorkerKilled, chaos_point
+from ..common.resilience import (HealthRegistry, RetryAbortedError,
+                                 RetryPolicy)
 from ..inference import InferenceModel, InferenceSummary
 from .client import INPUT_STREAM, RESULT_PREFIX, _Conn
 from .config import ServingConfig
@@ -38,9 +41,14 @@ class ClusterServing:
     """
 
     def __init__(self, model=None, config: Optional[ServingConfig] = None,
-                 group: str = "serving"):
+                 group: str = "serving",
+                 registry: Optional[HealthRegistry] = None):
         self.config = config or ServingConfig()
         self.group = group
+        # liveness registry: every stage thread registers + beats; the
+        # supervisor respawns dead model workers; /healthz reads status()
+        self.registry = registry if registry is not None else HealthRegistry(
+            default_timeout_s=self.config.heartbeat_timeout_s)
         self.summary = (InferenceSummary(self.config.log_dir, "serving")
                         if self.config.log_dir else None)
         if isinstance(model, InferenceModel):
@@ -61,6 +69,10 @@ class ClusterServing:
             self.model.quantize_int8()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # model-worker threads are tracked by slot so the supervisor can
+        # respawn a dead one in place (reference: Flink task restarts)
+        self._infer_threads: Dict[int, threading.Thread] = {}
+        self.workers_respawned = 0
         # bounded hand-off queues = operator-chain backpressure
         self._infer_q: "queue.Queue" = queue.Queue(maxsize=8)
         self._sink_q: "queue.Queue" = queue.Queue(maxsize=32)
@@ -70,47 +82,50 @@ class ClusterServing:
 
     # ------------------------------------------------------------------ stages
 
-    def _connect(self) -> Optional[_Conn]:
-        """Connect to the broker, retrying until up or the job stops."""
-        while not self._stop.is_set():
-            try:
-                return _Conn(self.config.queue_host, self.config.queue_port)
-            except OSError:
-                logger.warning("broker unreachable; retrying")
-                time.sleep(0.2)
-        return None
+    def _connect(self, tag: str = "engine") -> _Conn:
+        """A broker connection that reconnects-with-backoff on every failure
+        and retries until the job stops (then raises RetryAbortedError out of
+        the in-flight ``call``). Connection is lazy: the loops come up even
+        while the broker is still starting."""
+        policy = RetryPolicy(max_attempts=None, base_delay_s=0.05,
+                             max_delay_s=0.5, attempt_timeout_s=5.0,
+                             retryable=(ConnectionError, OSError))
+        return _Conn(self.config.queue_host, self.config.queue_port,
+                     policy=policy, abort=self._stop.is_set, tag=tag)
 
     def _source_loop(self):
-        conn = self._connect()
+        conn = self._connect("engine.source")
+        hb = self.registry.register("serving.source")
         cfg = self.config
-        while not self._stop.is_set() and conn is not None:
-            try:
-                entries = conn.call("XREADGROUP", INPUT_STREAM, self.group,
-                                    cfg.batch_size, cfg.batch_timeout_ms)
-            except (OSError, ConnectionError):
-                conn.close()
-                conn = self._connect()
-                continue
-            if not entries:
-                if cfg.batch_timeout_ms <= 0:
-                    time.sleep(0.005)  # non-blocking poll: avoid busy spin
-                continue
-            batch, bad = [], []
-            for _id, payload in entries:
+        try:
+            while not self._stop.is_set():
+                hb.beat()
                 try:
-                    batch.append((_id, payload["uri"],
-                                  decode_payload(payload["data"])))
-                except Exception as e:  # malformed record: report, keep running
-                    logger.exception("malformed record %s", _id)
-                    uri = payload.get("uri") if isinstance(payload, dict) else None
-                    bad.append((_id, uri, {"error": f"malformed payload: {e}"}))
-            if bad:
-                self._sink_q.put(bad)
-            if batch:
-                with self._inflight_lock:
-                    self._inflight += 1
-                self._infer_q.put(batch)
-        if conn is not None:
+                    entries = conn.call("XREADGROUP", INPUT_STREAM, self.group,
+                                        cfg.batch_size, cfg.batch_timeout_ms)
+                except RetryAbortedError:
+                    break          # job stopping
+                if not entries:
+                    if cfg.batch_timeout_ms <= 0:
+                        time.sleep(0.005)  # non-blocking poll: avoid busy spin
+                    continue
+                batch, bad = [], []
+                for _id, payload in entries:
+                    try:
+                        batch.append((_id, payload["uri"],
+                                      decode_payload(payload["data"])))
+                    except Exception as e:  # malformed record: report, keep running
+                        logger.exception("malformed record %s", _id)
+                        uri = payload.get("uri") if isinstance(payload, dict) else None
+                        bad.append((_id, uri, {"error": f"malformed payload: {e}"}))
+                if bad:
+                    self._sink_q.put(bad)
+                if batch:
+                    with self._inflight_lock:
+                        self._inflight += 1
+                    self._infer_q.put(batch)
+        finally:
+            hb.stop()
             conn.close()
 
     def _collate(self, batch: List[Tuple[str, str, Dict[str, np.ndarray]]]):
@@ -122,27 +137,51 @@ class ClusterServing:
             arrays.append(np.stack([rec[name] for _, _, rec in batch], axis=0))
         return arrays[0] if len(arrays) == 1 else arrays
 
-    def _infer_loop(self):
-        while not self._stop.is_set():
-            try:
-                batch = self._infer_q.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            ids = [i for i, _, _ in batch]
-            uris = [u for _, u, _ in batch]
-            try:
-                x = self._collate(batch)
-                y = self.model.predict(x)
-                outs = self._postprocess(y)
-                self._sink_q.put([(i, u, {"value": o})
-                                  for i, u, o in zip(ids, uris, outs)])
-            except Exception as e:  # one bad record must not kill the job
-                logger.exception("inference batch failed")
-                self._sink_q.put([(i, u, {"error": str(e)})
-                                  for i, u in zip(ids, uris)])
-            finally:
+    def _infer_loop(self, widx: int = 0):
+        """One model worker. Registers a heartbeat; a (simulated or real)
+        death mid-batch re-queues the batch it held — nothing is acked until
+        the sink writes results, so no request can be lost — and the
+        supervisor respawns the worker slot."""
+        hb = self.registry.register(f"serving.infer.{widx}")
+        try:
+            while not self._stop.is_set():
+                hb.beat()
+                try:
+                    batch = self._infer_q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                ids = [i for i, _, _ in batch]
+                uris = [u for _, u, _ in batch]
+                try:
+                    chaos_point("serving.infer", tag=widx)
+                    x = self._collate(batch)
+                    y = self.model.predict(x)
+                    outs = self._postprocess(y)
+                    self._sink_q.put([(i, u, {"value": o})
+                                      for i, u, o in zip(ids, uris, outs)])
+                except WorkerKilled:
+                    # simulated hard death: hand the un-sunk batch back (it is
+                    # still unacked broker-side) and die; the supervisor
+                    # respawns this slot and the batch is re-processed. The
+                    # re-queue rides a side thread: a blocking put on the
+                    # bounded queue would keep THIS thread alive, and the
+                    # supervisor's is_alive() check would never fire
+                    threading.Thread(target=self._infer_q.put, args=(batch,),
+                                     daemon=True,
+                                     name=f"serving-requeue-{widx}").start()
+                    logger.warning("infer worker %d killed mid-batch; "
+                                   "re-queued %d records", widx, len(batch))
+                    return
+                except Exception as e:  # one bad record must not kill the job
+                    logger.exception("inference batch failed")
+                    self._sink_q.put([(i, u, {"error": str(e)})
+                                      for i, u in zip(ids, uris)])
+                # a re-queued batch stays in flight, so the decrement lives
+                # here (after sinking) rather than in a finally
                 with self._inflight_lock:
                     self._inflight -= 1
+        finally:
+            hb.stop()
 
     def _postprocess(self, y) -> List[Any]:
         """Split batch back into per-record results; apply topN
@@ -163,47 +202,62 @@ class ClusterServing:
         return out
 
     def _sink_loop(self):
-        conn = self._connect()
-        # keep draining after _stop so results already computed still land
-        while conn is not None:
-            try:
-                results = self._sink_q.get(timeout=0.1)
-            except queue.Empty:
-                if self._stop.is_set():
-                    break
-                continue
-            done_ids = []
-            for entry_id, uri, value in results:
-                while True:
-                    try:
+        conn = self._connect("engine.sink")
+        hb = self.registry.register("serving.sink")
+        try:
+            # keep draining after _stop so results already computed still land
+            while True:
+                hb.beat()
+                try:
+                    results = self._sink_q.get(timeout=0.1)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        break
+                    continue
+                try:
+                    done_ids = []
+                    for entry_id, uri, value in results:
+                        # the connection's policy retries across reconnects; a
+                        # RetryAbortedError means stopping AND broker gone
                         if uri is not None:
                             conn.call("HSET", RESULT_PREFIX + uri,
                                       encode_payload(value))
                         self.served += 1
                         done_ids.append(entry_id)
-                        break
-                    except (OSError, ConnectionError):
-                        conn.close()
-                        conn = self._connect()
-                        if conn is None:  # stopping and broker gone: give up
-                            return
-            # results are durably written: release the broker's pending
-            # entries (Redis XACK after the sink commits — at-least-once).
-            # Retried across reconnects like HSET: a dropped ack would leave
-            # the entries pending forever and redeliver them on every restart
-            while done_ids:
-                try:
-                    conn.call("XACK", INPUT_STREAM, self.group, done_ids)
-                    done_ids = []
-                except (OSError, ConnectionError):
-                    conn.close()
-                    conn = self._connect()
-                    if conn is None:
-                        return
-        if conn is not None:
+                    # results are durably written: release the broker's pending
+                    # entries (Redis XACK after the sink commits —
+                    # at-least-once). Retried across reconnects like HSET: a
+                    # dropped ack would leave the entries pending forever and
+                    # redeliver them on every restart
+                    if done_ids:
+                        conn.call("XACK", INPUT_STREAM, self.group, done_ids)
+                except RetryAbortedError:
+                    break          # stopping and broker gone: give up
+        finally:
+            hb.stop()
             conn.close()
 
     # ----------------------------------------------------------------- control
+
+    def _spawn_infer_worker(self, widx: int) -> threading.Thread:
+        t = threading.Thread(target=self._infer_loop, args=(widx,),
+                             daemon=True, name=f"serving-infer-{widx}")
+        self._infer_threads[widx] = t
+        t.start()
+        return t
+
+    def _supervise_loop(self):
+        """Respawn dead model workers (the Flink task-restart analog). A
+        worker whose thread died — chaos kill, OOM in user code — comes back
+        in the same slot; its half-processed batch was re-queued unacked, so
+        the respawned worker (or a surviving peer) re-delivers it."""
+        while not self._stop.is_set():
+            for widx, t in list(self._infer_threads.items()):
+                if not t.is_alive() and not self._stop.is_set():
+                    logger.warning("respawning dead infer worker %d", widx)
+                    self.workers_respawned += 1
+                    self._spawn_infer_worker(widx)
+            self._stop.wait(0.05)
 
     def start(self) -> "ClusterServing":
         """Start the pipeline (non-blocking; threads are daemons)."""
@@ -212,16 +266,21 @@ class ClusterServing:
         # (FlinkRedisSource.scala:44 xgroupCreate parity): a fresh job sees
         # only traffic from now on; a restarted job (same group) resumes its
         # preserved cursor, picking up records enqueued while it was down.
-        conn = self._connect()
-        if conn is not None:
+        conn = self._connect("engine.control")
+        try:
             conn.call("XGROUPCREATE", INPUT_STREAM, self.group, "$")
+        except RetryAbortedError:
+            pass
+        finally:
             conn.close()
         for name, fn in (("source", self._source_loop),
-                         ("infer", self._infer_loop),
-                         ("sink", self._sink_loop)):
+                         ("sink", self._sink_loop),
+                         ("supervisor", self._supervise_loop)):
             t = threading.Thread(target=fn, daemon=True, name=f"serving-{name}")
             t.start()
             self._threads.append(t)
+        for widx in range(max(1, self.config.infer_workers)):
+            self._threads.append(self._spawn_infer_worker(widx))
         return self
 
     def run(self):  # pragma: no cover - interactive entry (ClusterServing.run)
@@ -244,8 +303,10 @@ class ClusterServing:
         while time.time() < deadline and busy():
             time.sleep(0.01)
         self._stop.set()
-        for t in self._threads:
+        # _infer_threads may hold respawned workers not in _threads
+        for t in list(self._threads) + list(self._infer_threads.values()):
             t.join(timeout=2.0)
         self._threads.clear()
+        self._infer_threads.clear()
         if self.summary is not None:
             self.summary.close()

@@ -15,8 +15,15 @@ Two serving modes:
 
 Routes:
     GET  /                 -> liveness ("welcome to analytics zoo web serving")
+    GET  /healthz          -> health registry status (503 when a component is dead)
     POST /predict          -> {"instances":[{name: tensor-as-nested-list, ...}]}
     GET  /metrics          -> timing stats JSON (+ batching stats in direct mode)
+
+Resilience: requests beyond ``max_inflight`` are shed with HTTP 503 +
+``Retry-After`` (bounded work queue — under overload the frontend answers
+instantly instead of letting every client time out); repeated broker-path
+failures open a :class:`CircuitBreaker` so a dead broker fails fast instead of
+tying one thread per doomed request for the full timeout.
 """
 
 from __future__ import annotations
@@ -30,6 +37,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..common.resilience import (CircuitBreaker, CircuitOpenError,
+                                 HealthRegistry, ResilienceError)
 from ..inference.summary import timing, timing_stats
 from .client import InputQueue, OutputQueue
 from .config import ServingConfig
@@ -54,13 +63,29 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _respond_shed(self, retry_after_s: float, reason: str) -> None:
+        data = json.dumps({"error": reason}).encode("utf-8")
+        self.send_response(503)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("Retry-After", str(max(1, int(retry_after_s + 0.5))))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):
+        app: "FrontEndApp" = self.server.app  # type: ignore[attr-defined]
         if self.path == "/metrics":
-            app: "FrontEndApp" = self.server.app  # type: ignore[attr-defined]
             stats = dict(timing_stats())
             if app._batcher is not None:
                 stats["batching"] = app._batcher.stats()
+            stats["shed_requests"] = app.shed_requests
             self._respond(200, stats)
+        elif self.path == "/healthz":
+            if app.registry is None:
+                self._respond(200, {"status": "ok", "components": {}})
+                return
+            status = app.registry.status()
+            self._respond(200 if status["status"] == "ok" else 503, status)
         else:
             self._respond(200, {"message":
                                 "welcome to analytics zoo web serving"})
@@ -70,6 +95,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(404, {"error": f"no route {self.path}"})
             return
         app: "FrontEndApp" = self.server.app  # type: ignore[attr-defined]
+        if not app._admit():
+            # bounded queue full: shed instead of queueing unbounded work
+            app.shed_requests += 1
+            self._respond_shed(1.0, "server overloaded, request shed")
+            return
         try:
             n = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(n) or b"{}")
@@ -82,10 +112,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(200, {"predictions": preds})
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             self._respond(400, {"error": str(e)})
+        except CircuitOpenError as e:
+            self._respond_shed(e.retry_after_s, str(e))
         except TimeoutError as e:
             self._respond(504, {"error": str(e)})
+        except ResilienceError as e:   # broker unreachable after retries
+            self._respond_shed(1.0, str(e))
         except Exception as e:  # pragma: no cover
             self._respond(500, {"error": str(e)})
+        finally:
+            app._release()
 
 
 class _Server(ThreadingHTTPServer):
@@ -101,9 +137,25 @@ class FrontEndApp:
     def __init__(self, config: Optional[ServingConfig] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  timeout_s: float = 30.0, model=None,
-                 max_batch: int = 32, max_delay_ms: float = 2.0):
+                 max_batch: int = 32, max_delay_ms: float = 2.0,
+                 max_inflight: Optional[int] = None,
+                 registry: Optional[HealthRegistry] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.config = config or ServingConfig()
         self.timeout_s = timeout_s
+        self.registry = registry             # backs /healthz (None => always ok)
+        # load shedding: at most max_inflight concurrently admitted /predict
+        # requests; excess answers 503 + Retry-After immediately
+        self._admission = threading.Semaphore(
+            max_inflight if max_inflight is not None
+            else self.config.http_max_inflight)
+        self.shed_requests = 0
+        # broker-path breaker: consecutive failures (timeouts, dead broker)
+        # open it and /predict fails fast until a half-open probe succeeds
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            failure_threshold=self.config.breaker_failure_threshold,
+            reset_timeout_s=self.config.breaker_reset_timeout_s,
+            name="serving-frontend")
         self._server = _Server((host, port), _Handler)
         self._server.app = self  # type: ignore[attr-defined]
         self._batcher = None
@@ -125,6 +177,13 @@ class FrontEndApp:
     @property
     def port(self) -> int:
         return self._server.server_address[1]
+
+    # -- load shedding --------------------------------------------------------
+    def _admit(self) -> bool:
+        return self._admission.acquire(blocking=False)
+
+    def _release(self) -> None:
+        self._admission.release()
 
     @contextlib.contextmanager
     def _output(self):
@@ -155,12 +214,32 @@ class FrontEndApp:
                 out.append(val.tolist() if isinstance(val, np.ndarray)
                            else [np.asarray(v).tolist() for v in val])
             return out
-        uris = [self._input.enqueue(None, **tensors) for tensors in parsed]
-        out = []
-        with self._output() as oq:
-            for uri in uris:
-                val = oq.query(uri, timeout_s=timeout_s)
-                out.append(val.tolist() if isinstance(val, np.ndarray) else val)
+        # queue mode: the whole broker round trip rides the circuit breaker —
+        # when the broker/engine is down, requests fail fast (503 upstream)
+        # instead of each burning a thread for the full timeout
+        if not self.breaker.allow():
+            raise CircuitOpenError(self.breaker.name,
+                                   self.breaker.retry_after_s())
+        try:
+            uris = [self._input.enqueue(None, **tensors) for tensors in parsed]
+            out = []
+            with self._output() as oq:
+                for uri in uris:
+                    val = oq.query(uri, timeout_s=timeout_s)
+                    out.append(val.tolist() if isinstance(val, np.ndarray)
+                               else val)
+        except (TimeoutError, ConnectionError, OSError, ResilienceError):
+            self.breaker.record_failure()
+            raise
+        except BaseException:
+            # application-level error (e.g. a serving-error result raised by
+            # oq.query): the broker round trip itself WORKED. Must still be
+            # recorded as breaker success — allow() consumed a half-open probe
+            # slot, and leaving it unpaired would wedge the breaker half-open
+            # (probes exhausted, no outcome) refusing all traffic forever
+            self.breaker.record_success()
+            raise
+        self.breaker.record_success()
         return out
 
     def start(self) -> "FrontEndApp":

@@ -17,6 +17,7 @@ import logging
 import signal
 import threading
 
+from ..common.resilience import HealthRegistry
 from .broker import start_broker
 from .config import ServingConfig
 from .engine import ClusterServing
@@ -72,10 +73,14 @@ def main(argv=None) -> int:
         ap.error("pass --model <bundle>, --config with model/path, or --demo")
 
     broker = start_broker("127.0.0.1", args.broker_port, aof_path=args.aof)
+    # one registry spans the stack: engine stage/worker heartbeats feed the
+    # frontend's /healthz, so an orchestrator probes the whole pipeline
+    registry = HealthRegistry(default_timeout_s=cfg.heartbeat_timeout_s)
     serving = ClusterServing(_demo_model() if args.demo and not cfg.model_path
-                             else None, config=cfg)
+                             else None, config=cfg, registry=registry)
     serving.start()
-    app = FrontEndApp(cfg, host=args.host, port=args.http_port)
+    app = FrontEndApp(cfg, host=args.host, port=args.http_port,
+                      registry=registry)
 
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):

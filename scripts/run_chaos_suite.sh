@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Run the deterministic fault-injection suite (tests marked `chaos`) on the
+# CPU backend with a hard wall-clock cap, independently of tier-1.
+#
+#   scripts/run_chaos_suite.sh            # whole chaos marker set
+#   scripts/run_chaos_suite.sh -k broker  # usual pytest filters pass through
+#
+# CHAOS_SUITE_TIMEOUT (seconds, default 600) bounds the run even if a
+# resilience regression wedges a retry loop — the suite must never hang CI.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${CHAOS_SUITE_TIMEOUT:-600}"
+exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests -q -m chaos -p no:cacheprovider "$@"

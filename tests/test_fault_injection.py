@@ -5,6 +5,13 @@ mid-training and a successor resumes from its checkpoints).
 
 Also covers the in-process retry path: a poisoned batch raises inside the epoch
 loop and fit() must roll back to the last checkpoint and continue.
+
+The ``chaos``-marked tests drive the unified resilience layer through the
+deterministic fault-injection harness (common/chaos.py): broker-connection
+drops, serving model-worker kills mid-stream, TaskPool dead-worker
+resubmission, circuit-breaker transitions, HTTP load shedding, and
+SIGTERM-triggered graceful final checkpoints — all on seeded schedules, no
+real flakiness, no sleeps as synchronization.
 """
 
 import os
@@ -154,3 +161,289 @@ def test_retry_exhaustion_raises(tmp_path):
     est._train_step = always_fails
     with pytest.raises(RuntimeError, match="permanent failure"):
         est.fit(FeatureSet.from_numpy(x, y), batch_size=32, epochs=3)
+
+
+# ===========================================================================
+# chaos-driven resilience tests
+# ===========================================================================
+
+def _square(x):
+    return x * x
+
+
+class _Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def add(self, k):
+        self.n += k
+        return self.n
+
+    def value(self):
+        return self.n
+
+
+def _fitted_model():
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+
+    model = Sequential([L.Dense(16, activation="relu", input_shape=(8,)),
+                        L.Dense(4, activation="softmax")])
+    model.compile(optimizer="adam", loss="categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    model.fit(x, y, batch_size=16, nb_epoch=1)
+    return model, x
+
+
+@pytest.mark.chaos
+def test_broker_connection_drop_recovery(zoo_ctx):
+    """A dropped broker connection mid-traffic reconnects with backoff and no
+    enqueued record is lost or duplicated (the drop fires before the send)."""
+    from analytics_zoo_tpu.common.chaos import ChaosSchedule
+    from analytics_zoo_tpu.serving import InputQueue, start_broker
+
+    broker = start_broker()
+    sched = ChaosSchedule(seed=3).fail("conn.call", at=4, exc=ConnectionError,
+                                       tag="client.input")
+    try:
+        with sched:
+            iq = InputQueue(port=broker.port, stream="chaos_drop")
+            uris = [iq.enqueue(None, x=np.float32(i)) for i in range(10)]
+        assert len(set(uris)) == 10
+        assert len(iq) == 10          # every record landed exactly once
+        assert sched.occurrences("conn.call", tag="client.input") >= 11
+        iq.close()
+    finally:
+        broker.shutdown()
+
+
+@pytest.mark.chaos
+def test_task_pool_dead_worker_resubmission_and_actor_respawn(zoo_ctx):
+    """Hard-kill (os._exit) of a TaskPool worker at a scheduled task: every
+    in-flight future still resolves (idempotent resubmission to the revived
+    worker), and an actor homed there is re-instantiated with its
+    ``on_respawn`` state callback applied."""
+    from analytics_zoo_tpu.common.chaos import ChaosSchedule
+    from analytics_zoo_tpu.orca import TaskPool
+
+    sched = ChaosSchedule(seed=11).kill("task_pool.worker", at=2, tag=1,
+                                        exit_code=137)
+    restored = []
+
+    def push_state_back(handle):
+        restored.append(True)
+        handle.add(5)            # re-push the externally-tracked value
+
+    with sched:
+        pool = TaskPool(2, respawn=True, heartbeat_interval_s=0.1)
+    with pool:
+        c = pool.actor(_Counter, worker=1, on_respawn=push_state_back)
+        assert c.add(5).result(timeout=60) == 5     # worker-1 occurrence 1
+        futs = [pool.submit(_square, i) for i in range(8)]
+        # round robin puts tasks 1,3,5,7 on worker 1; its next execution
+        # (occurrence 2) os._exits 137 BEFORE running the task, so the task
+        # and everything queued behind it must be resubmitted post-revive
+        assert [f.result(timeout=120) for f in futs] == \
+            [i * i for i in range(8)]
+        assert pool.workers_respawned >= 1
+        assert restored, "on_respawn callback never ran"
+        # constructor replay (start=0) + on_respawn add(5) == pre-kill state
+        assert c.value().result(timeout=60) == 5
+
+
+@pytest.mark.chaos
+def test_circuit_breaker_transitions_chaos_driven(zoo_ctx):
+    """Closed -> open on scheduled downstream failures, fail-fast while open,
+    half-open probe after the reset timeout, closed on probe success."""
+    from analytics_zoo_tpu.common.chaos import ChaosSchedule, chaos_point
+    from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
+                                                     CircuitOpenError)
+
+    now = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=5.0,
+                        clock=lambda: now["t"], name="chaos-breaker")
+    sched = ChaosSchedule(seed=5).fail("downstream", at=(1, 2),
+                                       exc=ConnectionError)
+    with sched:
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                br.call(chaos_point, "downstream")
+        assert br.state == CircuitBreaker.OPEN
+        with pytest.raises(CircuitOpenError) as ei:
+            br.call(chaos_point, "downstream")
+        assert ei.value.retry_after_s == pytest.approx(5.0)
+        # open circuit never reached the downstream: occurrence count frozen
+        assert sched.occurrences("downstream") == 2
+        now["t"] += 5.0
+        assert br.state == CircuitBreaker.HALF_OPEN
+        br.call(chaos_point, "downstream")          # probe (n=3): no fault
+        assert br.state == CircuitBreaker.CLOSED
+
+
+@pytest.mark.chaos
+def test_http_load_shedding_503_with_retry_after(zoo_ctx):
+    """With the admission bound saturated by an in-flight request, the next
+    /predict is shed instantly with 503 + Retry-After; after the slot frees,
+    requests flow again. Event-synchronised — no sleeps."""
+    import json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from analytics_zoo_tpu.serving import FrontEndApp, ServingConfig
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def blocking_predict(batch):
+        entered.set()
+        assert release.wait(30), "test never released the predict"
+        return np.zeros((np.asarray(batch).shape[0], 2), np.float32)
+
+    app = FrontEndApp(ServingConfig(), port=0, model=blocking_predict,
+                      max_batch=4, max_delay_ms=1.0, max_inflight=1).start()
+
+    def post():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{app.port}/predict",
+            data=json.dumps({"instances": [{"x": [1.0, 2.0]}]}).encode(),
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=30)
+
+    try:
+        first = {}
+
+        def slow_client():
+            with post() as r:
+                first["status"] = r.status
+
+        t = threading.Thread(target=slow_client, daemon=True)
+        t.start()
+        assert entered.wait(30), "first request never reached the model"
+        # admission slot is held by the blocked request: shed immediately
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post()
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert json.loads(ei.value.read())["error"]
+        release.set()
+        t.join(timeout=30)
+        assert first["status"] == 200
+        with post() as r:                 # slot free again: admitted
+            assert r.status == 200
+        assert app.shed_requests == 1
+    finally:
+        release.set()
+        app.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_drill_end_to_end_zero_loss(zoo_ctx):
+    """Acceptance drill: ONE seeded schedule kills a serving model worker
+    mid-stream, drops a broker connection under the engine source, and
+    hard-kills a TaskPool worker — and the system completes end-to-end with
+    zero lost requests/tasks (unacked batch re-queued and re-processed,
+    in-flight tasks resubmitted)."""
+    from analytics_zoo_tpu.common.chaos import ChaosSchedule
+    from analytics_zoo_tpu.orca import TaskPool
+    from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                           OutputQueue, ServingConfig,
+                                           start_broker)
+
+    model, x = _fitted_model()
+    sched = (ChaosSchedule(seed=7)
+             .kill("serving.infer", at=2, tag=0)                 # thread kill
+             .fail("conn.call", at=5, exc=ConnectionError,
+                   tag="engine.source")                          # conn drop
+             .kill("task_pool.worker", at=2, tag=0, exit_code=137))
+    broker = start_broker()
+    with sched:
+        cfg = ServingConfig(batch_size=4, queue_port=broker.port,
+                            infer_workers=2)
+        job = ClusterServing(model, cfg, group="chaos-drill").start()
+        pool = TaskPool(2, respawn=True, heartbeat_interval_s=0.1)
+        try:
+            iq = InputQueue(port=broker.port)
+            oq = OutputQueue(port=broker.port)
+            futs = [pool.submit(_square, i) for i in range(8)]
+            uris = [iq.enqueue(None, input=x[i]) for i in range(20)]
+            want = model.predict(x[:20])
+            for i, uri in enumerate(uris):        # zero lost requests
+                got = oq.query(uri, timeout_s=60)
+                np.testing.assert_allclose(got, want[i], rtol=1e-4, atol=1e-5)
+            assert [f.result(timeout=120) for f in futs] == \
+                [i * i for i in range(8)]         # zero lost tasks
+            # the scheduled faults actually fired and were recovered from
+            assert job.workers_respawned >= 1, "serving worker never respawned"
+            assert pool.workers_respawned >= 1, "pool worker never respawned"
+            assert sched.occurrences("conn.call", tag="engine.source") >= 5
+            iq.close(); oq.close()
+        finally:
+            pool.shutdown()
+            job.stop()
+    broker.shutdown()
+
+
+SIGTERM_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+
+    from analytics_zoo_tpu.common.chaos import ChaosSchedule, install_chaos
+    from analytics_zoo_tpu.common.config import TrainConfig
+    from analytics_zoo_tpu.data.featureset import FeatureSet
+    from analytics_zoo_tpu.engine.estimator import Estimator
+    from analytics_zoo_tpu.nn import layers as L
+    from analytics_zoo_tpu.nn.topology import Sequential
+
+    ckpt_dir = sys.argv[1]
+    # slow every step down deterministically so SIGTERM lands mid-training
+    install_chaos(ChaosSchedule().delay("estimator.step", at=None,
+                                        seconds=0.05))
+    model = Sequential([L.Dense(8, activation="relu", input_shape=(4,)),
+                        L.Dense(1)])
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((512, 4)).astype("float32")
+    y = x.sum(axis=1, keepdims=True).astype("float32")
+    est = Estimator(model, optimizer="adam", loss="mse",
+                    config=TrainConfig(checkpoint_dir=ckpt_dir,
+                                       checkpoint_every_n_iters=4))
+    est.fit(FeatureSet.from_numpy(x, y), batch_size=64, epochs=100000)
+    print("FINISHED", flush=True)   # must never be reached
+""")
+
+
+@pytest.mark.chaos
+def test_sigterm_graceful_final_checkpoint(tmp_path):
+    """SIGTERM mid-fit triggers one final checkpoint save and exit(143) — the
+    preemption-safe teardown — instead of dying checkpoint-less."""
+    script = tmp_path / "sigterm_worker.py"
+    script.write_text(SIGTERM_WORKER.format(repo=REPO))
+    ckpt = tmp_path / "ckpt"
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(ckpt)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    try:
+        from analytics_zoo_tpu.engine import checkpoint as ck
+
+        # first checkpoint on disk <=> fit is inside the epoch loop (handler
+        # installed); only then is SIGTERM guaranteed the graceful path
+        deadline = time.time() + 120
+        while ck.latest_checkpoint(str(ckpt)) is None:
+            assert proc.poll() is None, proc.stderr.read().decode()[-2000:]
+            assert time.time() < deadline, "no checkpoint before deadline"
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        out = proc.stdout.read().decode()
+        assert rc == 143, (rc, proc.stderr.read().decode()[-2000:])
+        assert "FINISHED" not in out          # training was interrupted
+        assert ck.latest_checkpoint(str(ckpt)) is not None
+    finally:
+        if proc.poll() is None:
+            proc.kill()

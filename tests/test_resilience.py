@@ -1,0 +1,254 @@
+"""Unit tests for the resilience layer (common/resilience.py) and the
+deterministic chaos harness (common/chaos.py).
+
+All timing-sensitive behavior runs on injected clocks/sleeps — no wall-clock
+waits, no sleeps-as-synchronization.
+"""
+
+import pickle
+
+import pytest
+
+from analytics_zoo_tpu.common.chaos import (ChaosSchedule, WorkerKilled,
+                                            chaos_point, get_chaos)
+from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
+                                                 CircuitOpenError,
+                                                 DeadlineExceededError,
+                                                 HealthRegistry,
+                                                 RetryAbortedError,
+                                                 RetryExhaustedError,
+                                                 RetryPolicy)
+
+
+class FakeTime:
+    """Clock + sleep pair: sleep advances the clock."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def clock(self):
+        return self.now
+
+    def sleep(self, dt):
+        self.sleeps.append(dt)
+        self.now += dt
+
+
+# ---------------------------------------------------------------- RetryPolicy
+
+def test_retry_succeeds_after_transient_failures():
+    ft = FakeTime()
+    policy = RetryPolicy(max_attempts=5, base_delay_s=0.1, jitter=0.0,
+                         sleep=ft.sleep, clock=ft.clock)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert policy.call(flaky) == "ok"
+    assert len(calls) == 3
+    # exponential: 0.1, then 0.2
+    assert ft.sleeps == pytest.approx([0.1, 0.2])
+
+
+def test_retry_exhaustion_chains_last_error():
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+    with pytest.raises(RetryExhaustedError) as ei:
+        policy.call(lambda: (_ for _ in ()).throw(ConnectionError("down")))
+    assert isinstance(ei.value.__cause__, ConnectionError)
+
+
+def test_non_retryable_propagates_immediately():
+    policy = RetryPolicy(max_attempts=5, retryable=(ConnectionError,))
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        policy.call(bad)
+    assert len(calls) == 1
+
+
+def test_retryable_predicate():
+    policy = RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                         retryable=lambda e: "retry me" in str(e))
+    with pytest.raises(RetryExhaustedError):
+        policy.call(lambda: (_ for _ in ()).throw(RuntimeError("retry me")))
+    with pytest.raises(RuntimeError, match="not me"):
+        policy.call(lambda: (_ for _ in ()).throw(RuntimeError("not me")))
+
+
+def test_deadline_exceeded():
+    ft = FakeTime()
+    policy = RetryPolicy(max_attempts=None, base_delay_s=1.0, multiplier=1.0,
+                         jitter=0.0, deadline_s=2.5, sleep=ft.sleep,
+                         clock=ft.clock)
+    with pytest.raises(DeadlineExceededError):
+        policy.call(lambda: (_ for _ in ()).throw(ConnectionError("down")))
+    # 2 sleeps of 1.0 fit inside 2.5; the 3rd would cross the deadline
+    assert ft.sleeps == pytest.approx([1.0, 1.0])
+
+
+def test_abort_gates_retries_not_first_attempt():
+    stop = {"set": False}
+    policy = RetryPolicy(max_attempts=None, base_delay_s=0.0, jitter=0.0)
+
+    # abort already true: the first attempt still runs (and can succeed)
+    stop["set"] = True
+    assert policy.call(lambda: "fine", abort=lambda: stop["set"]) == "fine"
+
+    calls = []
+
+    def failing():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    with pytest.raises(RetryAbortedError):
+        policy.call(failing, abort=lambda: stop["set"])
+    assert len(calls) == 1  # tried once, then aborted instead of retrying
+
+
+def test_jitter_is_deterministic_under_seed():
+    a = list(RetryPolicy(max_attempts=5, seed=42).delays())
+    b = list(RetryPolicy(max_attempts=5, seed=42).delays())
+    c = list(RetryPolicy(max_attempts=5, seed=43).delays())
+    assert a == b
+    assert a != c
+
+
+def test_unbounded_delays_generator_is_lazy():
+    import itertools
+
+    ds = list(itertools.islice(RetryPolicy(max_attempts=None, jitter=0.0,
+                                           base_delay_s=0.1,
+                                           max_delay_s=0.4).delays(), 5))
+    assert ds == pytest.approx([0.1, 0.2, 0.4, 0.4, 0.4])
+
+
+# -------------------------------------------------------------- CircuitBreaker
+
+def test_breaker_closed_to_open_to_half_open_to_closed():
+    ft = FakeTime()
+    br = CircuitBreaker(failure_threshold=3, window=10, reset_timeout_s=5.0,
+                        clock=ft.clock)
+    assert br.state == CircuitBreaker.CLOSED
+    for _ in range(3):
+        assert br.allow()
+        br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    assert br.retry_after_s() == pytest.approx(5.0)
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: "x")
+
+    ft.now += 5.0                      # reset timeout passes
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.allow()                  # the single probe slot
+    assert not br.allow()              # second concurrent probe refused
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow()
+
+
+def test_breaker_half_open_failure_reopens():
+    ft = FakeTime()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=2.0,
+                        clock=ft.clock)
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    ft.now += 2.0
+    assert br.allow()                  # half-open probe
+    br.record_failure()                # probe fails
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    ft.now += 1.0
+    assert not br.allow()              # timer restarted at the probe failure
+    ft.now += 1.0
+    assert br.allow()
+
+
+def test_breaker_window_slides():
+    br = CircuitBreaker(failure_threshold=3, window=3)
+    # old failures age out of the window as successes arrive
+    for _ in range(2):
+        br.record_failure()
+    for _ in range(3):
+        br.record_success()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED  # only 1 failure in the window
+
+
+# -------------------------------------------------------------- HealthRegistry
+
+def test_health_registry_alive_dead_status():
+    ft = FakeTime()
+    reg = HealthRegistry(default_timeout_s=2.0, clock=ft.clock)
+    hb = reg.register("worker-0")
+    reg.register("worker-1", timeout_s=10.0)
+    assert reg.alive("worker-0") and reg.alive("worker-1")
+    assert reg.healthy()
+
+    ft.now += 3.0
+    assert not reg.alive("worker-0")      # default 2s timeout passed
+    assert reg.alive("worker-1")          # custom 10s timeout not yet
+    assert reg.dead() == ["worker-0"]
+    status = reg.status()
+    assert status["status"] == "unhealthy"
+    assert status["components"]["worker-0"]["alive"] is False
+
+    hb.beat()
+    assert reg.alive("worker-0")
+    assert reg.status()["status"] == "ok"
+
+    hb.stop()
+    assert "worker-0" not in reg.components()
+    assert reg.alive("worker-0") is False
+
+
+def test_health_registry_unknown_component_not_alive():
+    reg = HealthRegistry()
+    assert not reg.alive("ghost")
+    assert reg.healthy()                  # no components = vacuously healthy
+
+
+# ----------------------------------------------------------------- chaos
+
+def test_chaos_occurrence_counting_and_fail():
+    sched = ChaosSchedule(seed=1).fail("site.a", at=2, exc=ConnectionError)
+    with sched:
+        chaos_point("site.a")                       # n=1: no-op
+        with pytest.raises(ConnectionError):
+            chaos_point("site.a")                   # n=2: fires
+        chaos_point("site.a")                       # n=3: no-op again
+    assert get_chaos() is None
+    chaos_point("site.a")                           # uninstalled: free no-op
+
+
+def test_chaos_tags_count_independently():
+    sched = ChaosSchedule().kill("w", at=2, tag=1)
+    with sched:
+        chaos_point("w", tag=0)
+        chaos_point("w", tag=0)          # tag 0 untouched at its n=2
+        chaos_point("w", tag=1)
+        with pytest.raises(WorkerKilled):
+            chaos_point("w", tag=1)      # tag 1 dies at ITS n=2
+
+
+def test_chaos_every_occurrence_rule_and_pickle_reset():
+    sched = ChaosSchedule().fail("s", at=None, exc=TimeoutError)
+    with sched:
+        for _ in range(3):
+            with pytest.raises(TimeoutError):
+                chaos_point("s")
+    assert sched.occurrences("s") == 3
+    clone = pickle.loads(pickle.dumps(sched))
+    assert clone.occurrences("s") == 0   # counters are process-local
+    with clone:
+        with pytest.raises(TimeoutError):
+            chaos_point("s")
