@@ -301,6 +301,17 @@ class FeatureSet:
         if batch_size % self.process_count:
             raise ValueError(
                 f"global batch {batch_size} not divisible by {self.process_count} hosts")
+        if not shuffle and self.process_count == 1:
+            # sequential single-host epoch: every batch is a CONTIGUOUS row
+            # range, so yield slice VIEWS instead of paying a full fancy-index
+            # gather per batch (the serving/eval input path reads each row
+            # exactly once — a copy would only burn DRAM bandwidth). Consumers
+            # treat batches as read-only (they are device_put/stacked next).
+            for b in range(self.num_batches(batch_size, drop_remainder)):
+                lo = b * batch_size
+                hi = min(lo + batch_size, self._n_total)
+                yield _tree_map(lambda a: a[lo:hi], self.data)
+            return
         if self.host_shard:
             # data is already THIS host's shard (FeatureSet.from_host_shard):
             # every host walks its local permutation in lockstep, yielding

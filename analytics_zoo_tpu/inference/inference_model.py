@@ -130,6 +130,11 @@ class InferenceModel:
         # pool metrics (InferenceModel.scala keeps originalModel + clones count)
         self.borrowed_peak = 0
         self._borrowed = 0
+        # bucket-cache accounting: ``compiles`` counts executables built (one
+        # per distinct bucketed shape — flat under steady traffic = XLA never
+        # recompiles mid-stream), ``cache_hits`` counts dict-lookup dispatches
+        self.compile_count = 0
+        self.cache_hit_count = 0
 
     # ------------------------------------------------------------------ loading
 
@@ -262,7 +267,18 @@ class InferenceModel:
                 if exe is None:
                     exe = jax.jit(self._apply)
                     self._compiled[key] = exe
+                    self.compile_count += 1
+                    return exe
+        self.cache_hit_count += 1
         return exe
+
+    def compile_stats(self) -> Dict[str, int]:
+        """Bucket-cache counters (surfaced at /metrics and by the bench):
+        ``compiled_shapes``/``compiles`` bound by the bucket ladder,
+        ``cache_hits`` = dispatches served by a dict lookup."""
+        return {"compiled_shapes": len(self._compiled),
+                "compiles": self.compile_count,
+                "cache_hits": self.cache_hit_count}
 
     def _bucket(self, n: int) -> int:
         for b in _buckets(self.max_batch_size):

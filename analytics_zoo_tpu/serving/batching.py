@@ -8,6 +8,14 @@ blocks; one batcher thread drains the queue up to ``max_batch`` (waiting at
 most ``max_delay_ms`` for stragglers), stacks compatible records into ONE
 device batch, and fans results back out. The XLA executable therefore sees a
 large MXU-efficient batch even when every client sends batch-1 requests.
+
+Shape bucketing: a drained group's size depends on traffic timing, so raw
+group sizes would make XLA specialise a fresh executable per size — compile
+stalls in the middle of the measured window. With ``bucket_pad`` (default)
+every stacked batch is zero-padded up to the nearest power-of-two bucket
+(capped at ``max_batch``) before ``predict_fn`` and the pad rows discarded on
+fan-out, so at most ``log2(max_batch)+1`` distinct batch shapes ever reach
+the engine and mid-traffic dispatch is a compiled-cache dict lookup.
 """
 
 from __future__ import annotations
@@ -39,10 +47,11 @@ class MicroBatcher:
     """
 
     def __init__(self, predict_fn: Callable, max_batch: int = 32,
-                 max_delay_ms: float = 2.0):
+                 max_delay_ms: float = 2.0, bucket_pad: bool = True):
         self.predict_fn = predict_fn
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.bucket_pad = bucket_pad
         self._q: "queue.Queue[_Slot]" = queue.Queue()
         self._stop = threading.Event()
         # observability: batching efficiency for /metrics and the bench
@@ -53,6 +62,11 @@ class MicroBatcher:
         self.batches_run = 0
         self.max_batch_seen = 0
         self.batch_sizes = collections.deque(maxlen=1000)
+        self.padded_rows = 0
+        # every (bucket, per-record signature) that reached predict_fn: with
+        # bucket_pad this stays <= len(buckets) per tensor signature, which is
+        # exactly the "no mid-traffic recompile" property /metrics watches
+        self.batch_shapes_seen = set()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-microbatcher")
         self._thread.start()
@@ -116,16 +130,33 @@ class MicroBatcher:
             for group in groups.values():
                 self._run_group(group)
 
+    def _bucket(self, n: int) -> int:
+        """Nearest power-of-two at or above ``n``, capped at ``max_batch``."""
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.max_batch)
+
     def _run_group(self, group: List[_Slot]):
-        self.records_in += len(group)
+        k = len(group)
+        self.records_in += k
         self.batches_run += 1
-        self.max_batch_seen = max(self.max_batch_seen, len(group))
-        self.batch_sizes.append(len(group))
+        self.max_batch_seen = max(self.max_batch_seen, k)
+        self.batch_sizes.append(k)
         try:
             names = list(group[0].tensors)
             arrays = [np.stack([s.tensors[n] for s in group]) for n in names]
+            bucket = self._bucket(k) if self.bucket_pad else k
+            if bucket > k:
+                arrays = [np.pad(a, [(0, bucket - k)] + [(0, 0)] * (a.ndim - 1))
+                          for a in arrays]
+                self.padded_rows += bucket - k
+            self.batch_shapes_seen.add(
+                tuple((bucket,) + a.shape[1:] + (str(a.dtype),)
+                      for a in arrays))
             x = arrays[0] if len(arrays) == 1 else arrays
             y = self.predict_fn(x)
+            # pad rows (indices >= k) are simply never fanned back out
             if isinstance(y, (list, tuple)):
                 for i, s in enumerate(group):
                     s.result = [np.asarray(o[i]) for o in y]
@@ -148,6 +179,9 @@ class MicroBatcher:
             "batches": self.batches_run,
             "mean_batch_size": (float(np.mean(sizes)) if sizes else 0.0),
             "max_batch_size": self.max_batch_seen,
+            "queue_depth": self._q.qsize(),
+            "padded_rows": self.padded_rows,
+            "distinct_batch_shapes": len(self.batch_shapes_seen),
         }
 
     def close(self):

@@ -4,13 +4,18 @@ Parity: the reference fronts serving with Redis: clients ``XADD`` requests onto 
 stream, the Flink source consumes via a consumer group (``xgroupCreate`` +
 ``xreadGroup`` — /root/reference/zoo/.../serving/engine/FlinkRedisSource.scala:
 44-59), and results land in per-request hashes read by ``OutputQueue``
-(client.py:277-300). This broker provides exactly those primitives over a
-length-prefixed-JSON TCP protocol:
+(client.py:277-300). This broker provides exactly those primitives over the
+versioned wire protocol of wire.py — tensor-bearing payloads ride binary
+zero-copy frames (raw buffers read with ``recv_into``, optionally through a
+negotiated same-host shared-memory ring), control messages stay
+length-prefixed JSON, and both interoperate on one connection
+(docs/serving_protocol.md):
 
     XADD stream payload              -> id
     XREADGROUP stream group n block  -> [(id, payload), ...]   (each entry to ONE consumer)
     HSET key mapping / HGET key / HDEL key
-    LEN stream / PING / SHUTDOWN
+    LEN stream / PING / SHUTDOWN / INFO
+    SHMOPEN name size                -> "OK"    (same-host zero-copy rings)
 
 It runs in-process (``start_broker()`` returns a served port) or standalone
 (``python -m analytics_zoo_tpu.serving.broker --port 6380``).
@@ -33,39 +38,16 @@ import argparse
 import collections
 import json
 import os
-import socket
 import socketserver
-import struct
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-_HDR = struct.Struct(">I")
-MAX_MSG = 512 * 1024 * 1024
-
-
-def send_msg(sock: socket.socket, obj: Any) -> None:
-    data = json.dumps(obj).encode("utf-8")
-    sock.sendall(_HDR.pack(len(data)) + data)
-
-
-def recv_msg(sock: socket.socket) -> Any:
-    hdr = _recv_exact(sock, _HDR.size)
-    (n,) = _HDR.unpack(hdr)
-    if n > MAX_MSG:
-        raise ValueError(f"message of {n} bytes exceeds limit")
-    return json.loads(_recv_exact(sock, n).decode("utf-8"))
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+from .schema import json_default, json_revive
+# wire-protocol primitives live in wire.py; re-exported here because the
+# historical import surface for the framing helpers is this module
+from .wire import (MAX_MSG, VERSION as WIRE_VERSION,  # noqa: F401
+                   _recv_exact, recv_msg, send_msg, wire_stats)
 
 
 class _Store:
@@ -111,9 +93,11 @@ class _Store:
     REWRITE_EVERY_OPS = 200_000
 
     def _log(self, *rec: Any) -> None:
-        """Append one mutation; fsync before the caller acks the client."""
+        """Append one mutation; fsync before the caller acks the client.
+        Binary-frame payloads carry raw ndarrays — ``json_default`` tags them
+        so they ride the line-JSON log (revived bit-exact on replay)."""
         if self._aof is not None:
-            self._aof.write(json.dumps(list(rec)) + "\n")
+            self._aof.write(json.dumps(list(rec), default=json_default) + "\n")
             self._aof.flush()
             os.fsync(self._aof.fileno())
             self._ops_since_rewrite += 1
@@ -143,9 +127,11 @@ class _Store:
                             if i not in live:
                                 ghost[i] = payload
                 for i in sorted(ghost, key=lambda e: int(e.split("-")[0])):
-                    f.write(json.dumps(["P", stream, i, ghost[i]]) + "\n")
+                    f.write(json.dumps(["P", stream, i, ghost[i]],
+                                       default=json_default) + "\n")
                 for entry_id, payload in entries:
-                    f.write(json.dumps(["A", stream, entry_id, payload]) + "\n")
+                    f.write(json.dumps(["A", stream, entry_id, payload],
+                                       default=json_default) + "\n")
             for (stream, group), cur in self.cursors.items():
                 f.write(json.dumps(["G", stream, group, 0]) + "\n")
                 f.write(json.dumps(["R", stream, group, cur, []]) + "\n")
@@ -155,7 +141,8 @@ class _Store:
                                         self.cursors[(stream, group)],
                                         list(ents)]) + "\n")
             for key, mapping in self.hashes.items():
-                f.write(json.dumps(["H", key, mapping]) + "\n")
+                f.write(json.dumps(["H", key, mapping],
+                                   default=json_default) + "\n")
             f.flush()
             os.fsync(f.fileno())
         if self._aof is not None:
@@ -178,7 +165,7 @@ class _Store:
                 if not line:
                     continue
                 try:
-                    rec = json.loads(line)
+                    rec = json_revive(json.loads(line))
                 except json.JSONDecodeError:
                     continue  # torn final write from the crash: ignore
                 op = rec[0]
@@ -354,9 +341,10 @@ class _Handler(socketserver.BaseRequestHandler):
         from ..common.chaos import chaos_point
 
         store: _Store = self.server.store  # type: ignore[attr-defined]
+        shm_ch = None   # per-connection shared-memory ring (client-created)
         try:
             while True:
-                req = recv_msg(self.request)
+                req = recv_msg(self.request, shm=shm_ch)
                 cmd = req[0]
                 # deterministic fault site: a "fail" rule severs this client's
                 # connection mid-protocol (the except below closes it); a
@@ -384,6 +372,29 @@ class _Handler(socketserver.BaseRequestHandler):
                     resp = store.slen(req[1])
                 elif cmd == "PING":
                     resp = "PONG"
+                elif cmd == "SHMOPEN":
+                    # same-host zero-copy negotiation: attach the client's
+                    # ring; any failure leaves this connection on the socket
+                    # path (the client falls back on a non-"OK" reply)
+                    try:
+                        from .shm import ShmChannel
+
+                        new_ch = ShmChannel.attach(req[1], int(req[2]))
+                    except Exception as e:
+                        resp = {"error": f"shm attach failed: {e}"}
+                    else:
+                        if shm_ch is not None:
+                            shm_ch.close()
+                        shm_ch = new_ch
+                        resp = "OK"
+                elif cmd == "INFO":
+                    with store.lock:
+                        streams = {s: len(e) for s, e in store.streams.items()}
+                        n_hashes = len(store.hashes)
+                    resp = {"wire_version": WIRE_VERSION,
+                            "streams": streams, "hashes": n_hashes,
+                            "shm_attached": shm_ch is not None,
+                            "wire": wire_stats()}
                 elif cmd == "SHUTDOWN":
                     send_msg(self.request, "OK")
                     threading.Thread(target=self.server.shutdown,
@@ -391,9 +402,12 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
                 else:
                     resp = {"error": f"unknown command {cmd!r}"}
-                send_msg(self.request, resp)
+                send_msg(self.request, resp, shm=shm_ch)
         except (ConnectionError, OSError):
             return
+        finally:
+            if shm_ch is not None:
+                shm_ch.close()
 
 
 class QueueBroker(socketserver.ThreadingTCPServer):

@@ -10,11 +10,17 @@ re-delivers in-flight ones.
     python -m analytics_zoo_tpu.serving.cli stop    --port 6380
     python -m analytics_zoo_tpu.serving.cli restart --port 6380 --aof /var/zoo/serving.aof
     python -m analytics_zoo_tpu.serving.cli status  --port 6380
+    python -m analytics_zoo_tpu.serving.cli info    --port 6380
+
+``info`` prints the broker's data-plane gauges (wire protocol version,
+per-stream depths, bytes on wire by frame kind, shm attachment) as JSON —
+the operator-side view of the binary zero-copy data plane.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import socket
 import subprocess
 import sys
@@ -115,10 +121,22 @@ def do_status(args) -> int:
     return 0 if up else 3
 
 
+def do_info(args) -> int:
+    try:
+        info = _call(args.host, args.port, "INFO")
+    except (OSError, ConnectionError, ValueError) as e:
+        print(f"broker on {args.host}:{args.port} unreachable: {e}",
+              file=sys.stderr)
+        return 3
+    print(json.dumps(info, indent=1, sort_keys=True))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="cluster-serving lifecycle (start/stop/restart/status)")
-    ap.add_argument("action", choices=["start", "stop", "restart", "status"])
+    ap.add_argument("action",
+                    choices=["start", "stop", "restart", "status", "info"])
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6380)
     ap.add_argument("--aof", default=None,
@@ -126,8 +144,8 @@ def main(argv=None) -> int:
     ap.add_argument("--wait", type=float, default=10.0,
                     help="seconds to wait for start/stop to take effect")
     args = ap.parse_args(argv)
-    return {"start": do_start, "stop": do_stop,
-            "restart": do_restart, "status": do_status}[args.action](args)
+    return {"start": do_start, "stop": do_stop, "restart": do_restart,
+            "status": do_status, "info": do_info}[args.action](args)
 
 
 if __name__ == "__main__":  # pragma: no cover

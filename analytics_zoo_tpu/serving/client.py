@@ -16,11 +16,25 @@ import numpy as np
 
 from ..common.chaos import chaos_point
 from ..common.resilience import RetryPolicy
-from .broker import recv_msg, send_msg
-from .schema import decode_payload, encode_payload
+from .shm import MIN_SHM_BUFFER_BYTES, ShmChannel, shm_enabled
+from .wire import WireError, recv_msg, send_msg
+from .schema import decode_payload
 
 INPUT_STREAM = "serving_stream"
 RESULT_PREFIX = "result:"
+
+_LOOPBACK_HOSTS = ("127.0.0.1", "localhost", "::1")
+
+
+def _array_bytes(obj) -> int:
+    """Total ndarray payload bytes in a request (shm-negotiation trigger)."""
+    if isinstance(obj, np.ndarray):
+        return obj.nbytes
+    if isinstance(obj, dict):
+        return sum(_array_bytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(_array_bytes(v) for v in obj)
+    return 0
 
 
 def default_conn_policy() -> RetryPolicy:
@@ -47,11 +61,17 @@ class _Conn:
     def __init__(self, host: str, port: int, timeout: Optional[float] = None,
                  policy: Optional[RetryPolicy] = None,
                  abort: Optional[Callable[[], bool]] = None,
-                 tag: Optional[str] = None):
+                 tag: Optional[str] = None, shm_mode: str = "lazy"):
         self.host, self.port = host, port
         self.policy = policy
         self.abort = abort
         self.tag = tag
+        # same-host zero-copy ring: "eager" negotiates right after connect
+        # (bulk-receiving roles — the engine source/sink), "lazy" only once a
+        # request actually carries a large tensor, "off" never
+        self.shm_mode = shm_mode if shm_enabled() else "off"
+        self._shm: Optional[ShmChannel] = None
+        self._shm_failed = False
         self.timeout = (timeout if timeout is not None
                         else policy.attempt_timeout_s if policy else None)
         self.lock = threading.Lock()
@@ -71,8 +91,41 @@ class _Conn:
             # timeout bounds EVERY socket op, recv included (a probe against
             # a wedged half-up broker must fail fast, not hang)
             self.sock.settimeout(None)
+        if self.shm_mode == "eager":
+            self._negotiate_shm()
+
+    def _negotiate_shm(self):
+        """Offer the broker a shared-memory ring (SHMOPEN). Any failure —
+        remote host, segment creation denied, old broker — marks this
+        connection socket-only until the next reconnect."""
+        if self._shm is not None or self._shm_failed or self.shm_mode == "off":
+            return
+        if self.host not in _LOOPBACK_HOSTS:
+            self._shm_failed = True
+            return
+        try:
+            ch = ShmChannel.create()
+        except Exception:
+            self._shm_failed = True
+            return
+        try:
+            send_msg(self.sock, ["SHMOPEN", ch.name, ch.size])
+            if recv_msg(self.sock) == "OK":
+                self._shm = ch
+                return
+        except (ConnectionError, OSError):
+            ch.close()
+            raise          # connection-level failure: let the retry layer act
+        except Exception:
+            pass
+        ch.close()
+        self._shm_failed = True
 
     def _drop(self):
+        if self._shm is not None:
+            self._shm.close()
+            self._shm = None
+        self._shm_failed = False     # a fresh connection may renegotiate
         if self.sock is not None:
             try:
                 self.sock.close()
@@ -85,10 +138,19 @@ class _Conn:
             chaos_point("conn.call", tag=self.tag)
             if self.sock is None:
                 self._connect()
-            send_msg(self.sock, req)
-            return recv_msg(self.sock)
+            if (self._shm is None and not self._shm_failed
+                    and self.shm_mode == "lazy"
+                    and _array_bytes(req) >= MIN_SHM_BUFFER_BYTES):
+                self._negotiate_shm()
+            send_msg(self.sock, req, shm=self._shm)
+            return recv_msg(self.sock, shm=self._shm)
         except (ConnectionError, OSError):
             self._drop()  # next attempt reconnects from scratch
+            raise
+        except WireError:
+            # protocol-level corruption: the socket may hold half a frame and
+            # can never resync — reusing it would misparse every later reply
+            self._drop()
             raise
 
     def call(self, *req) -> Any:
@@ -122,13 +184,17 @@ class InputQueue:
 
     def enqueue(self, uri: Optional[str] = None, **data) -> str:
         """Enqueue one record. ``data``: name → ndarray (or scalars/str).
-        Returns the record uri (auto-generated when not given)."""
+        Returns the record uri (auto-generated when not given).
+
+        Tensors ride the binary zero-copy frame protocol raw — no npy/base64/
+        JSON encode step; large batches transfer through the same-host shm
+        ring when the broker negotiated one."""
         if not data:
             raise ValueError("enqueue needs at least one named tensor")
         uri = uri or uuid.uuid4().hex
-        payload = {"uri": uri, "data": encode_payload(
-            {k: np.asarray(v) if not isinstance(v, (str, bytes)) else v
-             for k, v in data.items()})}
+        payload = {"uri": uri, "data":
+                   {k: np.asarray(v) if not isinstance(v, (str, bytes)) else v
+                    for k, v in data.items()}}
         self._conn.call("XADD", self.stream, payload)
         return uri
 

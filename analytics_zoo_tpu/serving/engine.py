@@ -28,7 +28,7 @@ from ..common.resilience import (HealthRegistry, RetryAbortedError,
 from ..inference import InferenceModel, InferenceSummary
 from .client import INPUT_STREAM, RESULT_PREFIX, _Conn
 from .config import ServingConfig
-from .schema import decode_payload, encode_payload
+from .schema import decode_payload
 
 logger = logging.getLogger("analytics_zoo_tpu.serving")
 
@@ -86,12 +86,17 @@ class ClusterServing:
         """A broker connection that reconnects-with-backoff on every failure
         and retries until the job stops (then raises RetryAbortedError out of
         the in-flight ``call``). Connection is lazy: the loops come up even
-        while the broker is still starting."""
+        while the broker is still starting. The bulk-transfer roles (source
+        reads request batches, sink writes result batches) negotiate the
+        same-host shared-memory ring eagerly so large batches never cross
+        the loopback socket."""
         policy = RetryPolicy(max_attempts=None, base_delay_s=0.05,
                              max_delay_s=0.5, attempt_timeout_s=5.0,
                              retryable=(ConnectionError, OSError))
+        bulk = tag in ("engine.source", "engine.sink")
         return _Conn(self.config.queue_host, self.config.queue_port,
-                     policy=policy, abort=self._stop.is_set, tag=tag)
+                     policy=policy, abort=self._stop.is_set, tag=tag,
+                     shm_mode="eager" if bulk else "lazy")
 
     def _source_loop(self):
         conn = self._connect("engine.source")
@@ -218,10 +223,10 @@ class ClusterServing:
                     done_ids = []
                     for entry_id, uri, value in results:
                         # the connection's policy retries across reconnects; a
-                        # RetryAbortedError means stopping AND broker gone
+                        # RetryAbortedError means stopping AND broker gone.
+                        # Result tensors ride raw binary frames (no npy/base64)
                         if uri is not None:
-                            conn.call("HSET", RESULT_PREFIX + uri,
-                                      encode_payload(value))
+                            conn.call("HSET", RESULT_PREFIX + uri, value)
                         self.served += 1
                         done_ids.append(entry_id)
                     # results are durably written: release the broker's pending
@@ -282,6 +287,17 @@ class ClusterServing:
         for widx in range(max(1, self.config.infer_workers)):
             self._threads.append(self._spawn_infer_worker(widx))
         return self
+
+    def stats(self) -> Dict[str, Any]:
+        """Engine-side observability: records served, worker respawns, and
+        the per-bucket compiled-executable cache counters of the model (the
+        dispatch path is a dict lookup — ``compiles`` staying flat under
+        traffic is the no-mid-traffic-recompile property)."""
+        out: Dict[str, Any] = {"served": self.served,
+                               "workers_respawned": self.workers_respawned}
+        if hasattr(self.model, "compile_stats"):
+            out.update(self.model.compile_stats())
+        return out
 
     def run(self):  # pragma: no cover - interactive entry (ClusterServing.run)
         self.start()

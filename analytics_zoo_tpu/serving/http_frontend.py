@@ -42,6 +42,7 @@ from ..common.resilience import (CircuitBreaker, CircuitOpenError,
 from ..inference.summary import timing, timing_stats
 from .client import InputQueue, OutputQueue
 from .config import ServingConfig
+from .wire import wire_stats
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -77,7 +78,15 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             stats = dict(timing_stats())
             if app._batcher is not None:
+                # micro-batcher efficiency: mean/max batch, batches_run,
+                # live queue depth, pad overhead, distinct batch shapes
                 stats["batching"] = app._batcher.stats()
+            engine = app.engine_stats()
+            if engine:
+                # recompile-count gauges: `compiles` flat under traffic means
+                # every dispatch was a compiled-cache dict lookup
+                stats["engine"] = engine
+            stats["wire"] = wire_stats()    # bytes-on-wire / frame-kind gauges
             stats["shed_requests"] = app.shed_requests
             self._respond(200, stats)
         elif self.path == "/healthz":
@@ -140,10 +149,15 @@ class FrontEndApp:
                  max_batch: int = 32, max_delay_ms: float = 2.0,
                  max_inflight: Optional[int] = None,
                  registry: Optional[HealthRegistry] = None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 engine_stats=None):
         self.config = config or ServingConfig()
         self.timeout_s = timeout_s
         self.registry = registry             # backs /healthz (None => always ok)
+        self._model = model
+        # queue-backed stacks pass the ClusterServing job's ``stats`` here so
+        # /metrics carries the engine's compile-cache gauges too
+        self._engine_stats = engine_stats
         # load shedding: at most max_inflight concurrently admitted /predict
         # requests; excess answers 503 + Retry-After immediately
         self._admission = threading.Semaphore(
@@ -177,6 +191,19 @@ class FrontEndApp:
     @property
     def port(self) -> int:
         return self._server.server_address[1]
+
+    def engine_stats(self) -> dict:
+        """Compile-cache gauges from whichever engine this frontend fronts:
+        a direct-mode model with ``compile_stats`` or an attached queue-mode
+        engine callback."""
+        if self._engine_stats is not None:
+            try:
+                return dict(self._engine_stats())
+            except Exception:
+                return {}
+        if hasattr(self._model, "compile_stats"):
+            return self._model.compile_stats()
+        return {}
 
     # -- load shedding --------------------------------------------------------
     def _admit(self) -> bool:

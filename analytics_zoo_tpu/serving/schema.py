@@ -1,9 +1,20 @@
-"""Payload encoding for queue transport.
+"""Payload encoding helpers for queue transport and persistence.
 
 Parity: /root/reference/pyzoo/zoo/serving/client.py:99-181 — the reference
 serialises ndarrays/images to Arrow record batches then base64 for Redis.
-Here tensors ride as raw ``.npy`` bytes (dtype+shape self-describing) base64'd
-into the JSON envelope — same wire-safety property, zero extra deps.
+
+The serving HOT PATH no longer goes through this module: tensors ride the
+binary zero-copy frame protocol (wire.py) as raw buffers. What remains here:
+
+* the legacy base64-JSON ndarray codec (``encode_payload``/``decode_payload``)
+  — still accepted from old/JSON-only clients, and ``decode_payload`` passes
+  already-decoded ndarrays (binary-frame payloads) straight through, so one
+  decode call serves both wire generations;
+* the append-only-file bridge (``json_default``/``json_revive``): the broker's
+  AOF is line-JSON for greppability and torn-write tolerance, so ndarray
+  payloads from binary frames are tagged ``{"__zoond__": <npy b64>}`` on the
+  way to disk and revived to real ndarrays on replay — binary-frame requests
+  survive a broker crash bit-exactly.
 """
 
 from __future__ import annotations
@@ -27,7 +38,8 @@ def decode_ndarray(s: str) -> np.ndarray:
 
 
 def encode_payload(data: Dict[str, Any]) -> Dict[str, Any]:
-    """ndarrays → tagged base64; scalars/strings pass through."""
+    """ndarrays → tagged base64; scalars/strings pass through. Legacy wire
+    format — the binary frame path (wire.py) sends raw arrays instead."""
     out: Dict[str, Any] = {}
     for k, v in data.items():
         if isinstance(v, np.ndarray):
@@ -41,6 +53,8 @@ def encode_payload(data: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def decode_payload(data: Dict[str, Any]) -> Dict[str, Any]:
+    """Decode a payload dict from EITHER wire generation: legacy tagged-base64
+    values are decoded; raw ndarrays (binary frames) pass through untouched."""
     out: Dict[str, Any] = {}
     for k, v in data.items():
         if isinstance(v, dict) and "__ndarray__" in v:
@@ -50,3 +64,43 @@ def decode_payload(data: Dict[str, Any]) -> Dict[str, Any]:
         else:
             out[k] = v
     return out
+
+
+# ---------------------------------------------------------------------------
+# AOF bridge: ndarray-bearing payloads <-> line-JSON mutation records
+# ---------------------------------------------------------------------------
+
+_AOF_TAG = "__zoond__"
+
+
+def json_default(o: Any):
+    """``json.dumps(..., default=json_default)`` hook: tag raw ndarrays (from
+    binary frames) so they survive the broker's line-JSON append-only log.
+    Dtype rides by NAME (not npy) so custom dtypes — bf16/fp8 via ml_dtypes —
+    replay bit-exact instead of degrading to raw void records."""
+    if isinstance(o, (np.ndarray, np.generic)):
+        arr = np.asarray(o)                 # keeps 0-d shape
+        if isinstance(arr, np.ndarray) and not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        return {_AOF_TAG: [arr.dtype.name, list(arr.shape),
+                           base64.b64encode(arr.tobytes()).decode("ascii")]}
+    raise TypeError(f"Object of type {type(o).__name__} is not JSON "
+                    f"serializable")
+
+
+def json_revive(obj: Any) -> Any:
+    """Inverse of :func:`json_default`, applied recursively to a replayed AOF
+    record. Legacy ``__ndarray__``-tagged dicts are left alone — they are the
+    payload a JSON-generation consumer expects to see."""
+    if isinstance(obj, dict):
+        if len(obj) == 1 and _AOF_TAG in obj:
+            from .wire import _dtype_from_name
+
+            name, shape, b64 = obj[_AOF_TAG]
+            raw = bytearray(base64.b64decode(b64.encode("ascii")))
+            return np.frombuffer(raw, dtype=_dtype_from_name(name)).reshape(
+                tuple(shape))
+        return {k: json_revive(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [json_revive(v) for v in obj]
+    return obj

@@ -55,8 +55,15 @@ def main(argv=None) -> int:
     ap.add_argument("--platform", default=None, choices=("cpu", "tpu"),
                     help="force the JAX backend (e.g. cpu when the TPU "
                          "tunnel/runtime is unavailable)")
+    ap.add_argument("--no-shm", action="store_true",
+                    help="disable the same-host shared-memory ring (tensor "
+                         "buffers then ride the socket as binary frames)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    if args.no_shm:
+        import os
+
+        os.environ["ZOO_SERVING_SHM"] = "0"
     if args.platform:
         import jax
 
@@ -79,8 +86,9 @@ def main(argv=None) -> int:
     serving = ClusterServing(_demo_model() if args.demo and not cfg.model_path
                              else None, config=cfg, registry=registry)
     serving.start()
+    # engine_stats feeds the frontend's /metrics recompile-count gauges
     app = FrontEndApp(cfg, host=args.host, port=args.http_port,
-                      registry=registry)
+                      registry=registry, engine_stats=serving.stats)
 
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):

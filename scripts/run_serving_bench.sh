@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Serving data-plane benchmark runner.
+#
+#   scripts/run_serving_bench.sh            # full artifact -> SERVING_BENCH.json
+#   scripts/run_serving_bench.sh --quick    # CI smoke: small CPU run that
+#                                           # asserts dispatch_rtt_ms under
+#                                           # $ZOO_SERVING_QUICK_RTT_MS (15),
+#                                           # 0 failed requests, and compiled
+#                                           # shapes bounded by the bucket
+#                                           # ladder; never writes the artifact
+#
+# SERVING_BENCH_TIMEOUT (seconds, default 900) caps the run so a wedged
+# accelerator tunnel can never hang CI.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${SERVING_BENCH_TIMEOUT:-900}"
+if [[ "${1:-}" == "--quick" ]]; then
+    exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+        python serving_bench.py --quick
+fi
+exec timeout -k 10 "$TIMEOUT" python serving_bench.py "$@"

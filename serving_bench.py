@@ -173,6 +173,77 @@ def run_bench(im=None, n_clients: int = N_CLIENTS,
         "mean_batch": round(stats["mean_batch_size"], 2),
         "max_batch": stats["max_batch_size"],
         "predict_calls": stats["batches"],
+        # shape-bucketing evidence: distinct batch shapes the batcher emitted
+        # and executables the engine compiled — both bounded by the bucket
+        # ladder under mixed-size traffic (no mid-stream XLA recompiles)
+        "distinct_batch_shapes": stats["distinct_batch_shapes"],
+        "padded_rows": stats["padded_rows"],
+        "compiled_shapes": im.compile_stats()["compiled_shapes"],
+    }
+
+
+def run_wire_bench(payload_mb: float = 1.0, iters: int = 15) -> dict:
+    """Data-plane microbench: one ``payload_mb`` tensor HSET+HGET round trip
+    through the broker under (a) the legacy base64-JSON envelope, (b) binary
+    frames over the socket, (c) binary frames with the same-host shm ring —
+    the artifact that shows the wire rebuild, independent of model/XLA time."""
+    from analytics_zoo_tpu.serving import start_broker
+    from analytics_zoo_tpu.serving.client import _Conn
+    from analytics_zoo_tpu.serving.schema import decode_payload, encode_payload
+    from analytics_zoo_tpu.serving.wire import wire_stats
+
+    n_elem = int(payload_mb * (1 << 20)) // 4
+    arr = np.random.default_rng(0).normal(size=(n_elem,)).astype(np.float32)
+    broker = start_broker()
+
+    def median_ms(fn):
+        fn()                                  # warm (incl. shm negotiation)
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn()
+            samples.append((time.perf_counter() - t0) * 1e3)
+        return round(float(np.median(samples)), 3)
+
+    try:
+        cj = _Conn("127.0.0.1", broker.port)
+
+        def legacy_json():
+            cj.call("HSET", "wj", encode_payload({"v": arr}))
+            decode_payload(cj.call("HGET", "wj", 0))
+
+        json_ms = median_ms(legacy_json)
+        cj.close()
+
+        cs = _Conn("127.0.0.1", broker.port, shm_mode="off")
+
+        def binary_socket():
+            cs.call("HSET", "wb", {"v": arr})
+            cs.call("HGET", "wb", 0)
+
+        socket_ms = median_ms(binary_socket)
+        cs.close()
+
+        shm0 = wire_stats()["shm_bytes"]
+        ch = _Conn("127.0.0.1", broker.port)
+
+        def binary_shm():
+            ch.call("HSET", "ws", {"v": arr})
+            ch.call("HGET", "ws", 0)
+
+        shm_ms = median_ms(binary_shm)
+        shm_used = wire_stats()["shm_bytes"] - shm0
+        ch.close()
+    finally:
+        broker.shutdown()
+    return {
+        "payload_mb": payload_mb, "iters": iters,
+        "json_rtt_ms": json_ms,
+        "binary_rtt_ms": socket_ms,
+        "binary_shm_rtt_ms": shm_ms,
+        "binary_speedup_vs_json": round(json_ms / socket_ms, 2),
+        "shm_speedup_vs_json": round(json_ms / shm_ms, 2),
+        "shm_ring_used": shm_used > 0,
     }
 
 
@@ -313,7 +384,45 @@ def run_int8_bench() -> dict:
     }
 
 
+QUICK_RTT_THRESHOLD_MS = float(os.environ.get("ZOO_SERVING_QUICK_RTT_MS",
+                                              "15"))
+
+
+def run_quick() -> int:
+    """CI smoke mode (scripts/run_serving_bench.sh --quick): a small HTTP run
+    plus the dispatch-RTT probe; asserts 0 failed requests, the dispatch RTT
+    under threshold, and the bucket invariant (compiled shapes bounded by the
+    bucket ladder). Never touches SERVING_BENCH.json."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    im = build_model()
+    result = run_bench(im, n_clients=4, requests_per_client=8)
+    result["dispatch_rtt_ms"] = measure_dispatch_rtt_ms(n=10)
+    result["wire"] = run_wire_bench(payload_mb=0.5, iters=5)
+    print(json.dumps(result))
+    from analytics_zoo_tpu.inference.inference_model import _buckets
+
+    failures = []
+    if result.get("failed_requests", 1):
+        failures.append(f"failed_requests={result.get('failed_requests')}")
+    rtt = result["dispatch_rtt_ms"]
+    if rtt is None or rtt >= QUICK_RTT_THRESHOLD_MS:
+        failures.append(f"dispatch_rtt_ms={rtt} >= {QUICK_RTT_THRESHOLD_MS}")
+    if result["compiled_shapes"] > len(_buckets(im.max_batch_size)):
+        failures.append(f"compiled_shapes={result['compiled_shapes']} exceeds "
+                        f"the bucket ladder")
+    if failures:
+        print(f"[serving_bench --quick] FAIL: {'; '.join(failures)}",
+              file=sys.stderr)
+        return 1
+    print("[serving_bench --quick] OK", file=sys.stderr)
+    return 0
+
+
 if __name__ == "__main__":
+    if "--quick" in sys.argv:
+        raise SystemExit(run_quick())
     on_accel = _wait_for_accelerator()
     if not on_accel:
         print("[serving_bench] accelerator unreachable; using cpu",
@@ -351,6 +460,12 @@ if __name__ == "__main__":
     except Exception as e:
         print(f"[serving_bench] pipelined entry failed: {e}", file=sys.stderr)
         result["pipelined"] = None
+    try:
+        # wire-protocol leg: legacy JSON vs binary vs binary+shm data plane
+        result["wire"] = run_wire_bench()
+    except Exception as e:
+        print(f"[serving_bench] wire entry failed: {e}", file=sys.stderr)
+        result["wire"] = None
     try:
         result["int8"] = run_int8_bench()
     except Exception as e:  # additive entry; never break the artifact

@@ -17,6 +17,8 @@ from analytics_zoo_tpu.serving import (ClusterServing, FrontEndApp, InputQueue,
                                        OutputQueue, ServingConfig, start_broker)
 from analytics_zoo_tpu.serving.schema import decode_payload, encode_payload
 
+pytestmark = pytest.mark.serving
+
 
 @pytest.fixture(scope="module")
 def broker():

@@ -22,6 +22,8 @@ from analytics_zoo_tpu.serving import (ClusterServing, InputQueue, OutputQueue,
                                        ServingConfig)
 from analytics_zoo_tpu.serving.client import INPUT_STREAM, RESULT_PREFIX, _Conn
 
+pytestmark = pytest.mark.serving
+
 
 def _free_port() -> int:
     with socket.socket() as s:

@@ -11,6 +11,8 @@ import urllib.request
 
 import pytest
 
+pytestmark = pytest.mark.serving
+
 
 @pytest.mark.slow
 def test_stack_boots_predicts_and_stops():

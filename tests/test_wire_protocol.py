@@ -1,0 +1,455 @@
+"""Binary zero-copy wire protocol (serving/wire.py + shm.py).
+
+Round-trip property tests across dtypes/shapes/trees, msgpack-codec checks,
+version negotiation + JSON interop on one connection, the same-host
+shared-memory ring, the per-bucket compiled-executable cache, and AOF replay
+of binary-frame payloads (crash durability for raw-tensor requests).
+"""
+
+import json
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving import wire
+from analytics_zoo_tpu.serving.shm import ShmChannel
+
+pytestmark = pytest.mark.serving
+
+
+def _roundtrip(obj, shm_pair=None):
+    """Send ``obj`` over a real socketpair (sender on a thread so payloads
+    larger than the kernel buffer don't deadlock) and receive it back."""
+    a, b = socket.socketpair()
+    b.settimeout(30)           # a failed sender must not hang the receiver
+    tx_shm = rx_shm = None
+    if shm_pair is not None:
+        tx_shm, rx_shm = shm_pair
+    err = []
+
+    def send():
+        try:
+            wire.send_msg(a, obj, shm=tx_shm)
+        except Exception as e:
+            err.append(e)
+            a.close()          # unblock the receiver immediately
+
+    t = threading.Thread(target=send)
+    t.start()
+    try:
+        out = wire.recv_msg(b, shm=rx_shm)
+    finally:
+        t.join(timeout=30)
+        a.close()
+        b.close()
+    assert not err, err
+    return out
+
+
+def _assert_tree_equal(got, want):
+    if isinstance(want, np.ndarray):
+        assert isinstance(got, np.ndarray), type(got)
+        assert got.dtype == want.dtype, (got.dtype, want.dtype)
+        assert got.shape == want.shape
+        np.testing.assert_array_equal(got, want)
+    elif isinstance(want, dict):
+        assert set(got) == set(want)
+        for k in want:
+            _assert_tree_equal(got[k], want[k])
+    elif isinstance(want, (list, tuple)):
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            _assert_tree_equal(g, w)
+    else:
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# frame round trips
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int8", "uint8",
+                                   "int32", "int64", "bool", "float16"])
+def test_roundtrip_dtypes(dtype):
+    rng = np.random.default_rng(0)
+    arr = (rng.normal(size=(7, 5)) * 10).astype(dtype)
+    out = _roundtrip({"x": arr})
+    _assert_tree_equal(out, {"x": arr})
+
+
+def test_roundtrip_bfloat16():
+    import ml_dtypes
+
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6).astype(
+        ml_dtypes.bfloat16)
+    out = _roundtrip({"x": arr})
+    _assert_tree_equal(out, {"x": arr})
+
+
+def test_roundtrip_empty_and_scalar_arrays():
+    want = {"empty": np.zeros((0, 4), np.float32),
+            "scalar": np.float32(3.5),
+            "zero_d": np.array(7, np.int64)}
+    out = _roundtrip(want)
+    np.testing.assert_array_equal(out["empty"], want["empty"])
+    assert out["empty"].shape == (0, 4)
+    assert out["scalar"].shape == () and float(out["scalar"]) == 3.5
+    assert out["zero_d"].shape == () and int(out["zero_d"]) == 7
+
+
+def test_roundtrip_nested_multi_input_tree():
+    rng = np.random.default_rng(1)
+    want = {
+        "uri": "abc-123",
+        "data": {
+            "ids": rng.integers(0, 100, size=(3,)).astype(np.int32),
+            "feats": [rng.normal(size=(3, 8)).astype(np.float32),
+                      rng.normal(size=(3, 2, 2)).astype(np.float64)],
+        },
+        "meta": {"n": 3, "tags": ["a", "b"], "ok": True, "none": None,
+                 "f": 1.25},
+    }
+    out = _roundtrip(want)
+    _assert_tree_equal(out, want)
+
+
+def test_roundtrip_large_payload_over_4mb():
+    rng = np.random.default_rng(2)
+    arr = rng.normal(size=(1024, 1200)).astype(np.float32)   # ~4.9 MB
+    assert arr.nbytes > 4 * 1024 * 1024
+    out = _roundtrip({"big": arr, "tail": np.arange(3, dtype=np.int8)})
+    np.testing.assert_array_equal(out["big"], arr)
+    np.testing.assert_array_equal(out["tail"], np.arange(3, dtype=np.int8))
+
+
+def test_roundtrip_noncontiguous_input():
+    base = np.arange(64, dtype=np.float32).reshape(8, 8)
+    view = base[::2, 1::3]                                    # strided view
+    out = _roundtrip({"v": view})
+    np.testing.assert_array_equal(out["v"], np.ascontiguousarray(view))
+
+
+def test_control_messages_stay_json_and_interop():
+    """Array-free payloads keep the legacy JSON body — a JSON-only peer can
+    read them (version negotiation is sniff-based)."""
+    a, b = socket.socketpair()
+    try:
+        wire.send_msg(a, ["PING", {"k": 1}])
+        hdr = b.recv(4)
+        n = int.from_bytes(hdr, "big")
+        body = b.recv(n)
+        assert body[0] != 0                  # not a binary frame
+        assert json.loads(body) == ["PING", {"k": 1}]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_unknown_version_rejected():
+    a, b = socket.socketpair()
+    try:
+        header = wire.pack({"t": None, "b": []})
+        body = wire.MAGIC + bytes([99, 0]) + len(header).to_bytes(4, "big") \
+            + header
+        a.sendall(len(body).to_bytes(4, "big") + body)
+        with pytest.raises(wire.WireError, match="version"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_big_endian_arrays_normalised_not_corrupted():
+    want = np.array([1.0, 2.0, -3.5], dtype=">f4")
+    out = _roundtrip({"x": want})
+    np.testing.assert_array_equal(out["x"], want.astype("<f4"))
+    assert out["x"].dtype == np.dtype("float32")
+
+
+def test_wire_error_drops_connection_for_resync():
+    """A protocol error mid-frame must tear the connection down — reusing a
+    half-read socket would misparse every later reply."""
+    from analytics_zoo_tpu.serving.client import _Conn
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    conns = []
+
+    def accept_and_corrupt():
+        s, _ = srv.accept()
+        conns.append(s)
+        wire.recv_msg(s)                       # consume the request
+        header = wire.pack({"t": None, "b": []})
+        body = wire.MAGIC + bytes([77, 0]) + len(header).to_bytes(4, "big") \
+            + header                            # bogus version 77
+        s.sendall(len(body).to_bytes(4, "big") + body)
+
+    t = threading.Thread(target=accept_and_corrupt, daemon=True)
+    t.start()
+    c = _Conn("127.0.0.1", port)
+    with pytest.raises(wire.WireError, match="version"):
+        c.call("PING")
+    assert c.sock is None                      # dropped, ready to reconnect
+    c.close()
+    srv.close()
+    for s in conns:
+        s.close()
+
+
+def test_corrupt_header_length_fails_fast():
+    a, b = socket.socketpair()
+    try:
+        # header_len claims more bytes than the outer frame holds
+        body = wire.MAGIC + bytes([wire.VERSION, 0]) \
+            + (10_000).to_bytes(4, "big")
+        a.sendall(len(body).to_bytes(4, "big") + body)
+        with pytest.raises(wire.WireError, match="exceeds frame"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_object_arrays_refused():
+    arr = np.empty(2, dtype=object)
+    arr[:] = [b"x", b"y"]
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(wire.WireError, match="object arrays"):
+            wire.send_msg(a, {"bad": arr})
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_stats_accounting():
+    before = wire.wire_stats()
+    arr = np.ones((128,), np.float32)
+    out = _roundtrip({"x": arr})
+    np.testing.assert_array_equal(out["x"], arr)
+    after = wire.wire_stats()
+    assert after["frames_binary"] >= before["frames_binary"] + 2  # send+recv
+    assert after["bytes_sent"] - before["bytes_sent"] >= arr.nbytes
+
+
+# ---------------------------------------------------------------------------
+# msgpack subset codec
+# ---------------------------------------------------------------------------
+
+def test_msgpack_codec_values():
+    cases = [None, True, False, 0, 1, 127, 128, -1, -32, -33, 2 ** 31,
+             -(2 ** 31) - 5, 2 ** 40, 1.5, -2.25, "", "héllo", "x" * 300,
+             b"", b"bytes", b"y" * 70000, [], [1, [2, 3], {"a": None}],
+             {"k": [True, 2.5]}, list(range(40))]
+    for case in cases:
+        got = wire.unpack(wire.pack(case))
+        assert got == case, (case, got)
+
+
+def test_msgpack_interop_with_reference_encoder():
+    msgpack = pytest.importorskip("msgpack")
+    obj = {"t": {"a": [1, -5, "s", None, True]},
+           "b": [{"d": "float32", "s": [2, 3], "n": 24}]}
+    assert msgpack.unpackb(bytes(wire.pack(obj)), strict_map_key=False) == obj
+    assert wire.unpack(msgpack.packb(obj)) == obj
+
+
+# ---------------------------------------------------------------------------
+# shared-memory ring
+# ---------------------------------------------------------------------------
+
+def test_shm_channel_ring_write_read_and_fallback():
+    ch = ShmChannel.create(1 << 20)
+    peer = ShmChannel.attach(ch.name, ch.size)
+    try:
+        data = np.random.default_rng(3).bytes(256 * 1024)
+        ch.begin_message()
+        off = ch.try_write(memoryview(data))
+        assert off is not None
+        assert bytes(peer.read(off, len(data))) == data
+        # too small to benefit -> socket fallback
+        assert ch.try_write(memoryview(b"tiny")) is None
+        # too large to fit in the tx half -> socket fallback
+        ch.begin_message()
+        assert ch.try_write(memoryview(bytearray(600 * 1024))) is None
+    finally:
+        peer.close()
+        ch.close()
+
+
+def test_shm_frames_roundtrip():
+    ch = ShmChannel.create(4 << 20)
+    peer = ShmChannel.attach(ch.name, ch.size)
+    try:
+        rng = np.random.default_rng(4)
+        want = {"a": rng.normal(size=(256, 256)).astype(np.float32),  # 256 KB
+                "b": rng.integers(0, 9, size=(4,)).astype(np.int8)}   # inline
+        out = _roundtrip(want, shm_pair=(ch, peer))
+        _assert_tree_equal(out, want)
+        assert ch._cursor >= want["a"].nbytes      # the big buffer used shm
+    finally:
+        peer.close()
+        ch.close()
+
+
+def test_shm_negotiation_end_to_end_and_fallback_rule():
+    """A large enqueue negotiates the ring lazily; equality holds end to end;
+    disabling shm by env falls back to pure-socket binary frames."""
+    from analytics_zoo_tpu.serving import start_broker
+    from analytics_zoo_tpu.serving.client import _Conn
+
+    broker = start_broker()
+    try:
+        big = np.random.default_rng(5).normal(size=(512, 128)).astype(
+            np.float32)                                        # 256 KB
+        c = _Conn("127.0.0.1", broker.port)
+        c.call("HSET", "shm-big", {"v": big})
+        assert c._shm is not None, "large payload should negotiate the ring"
+        back = c.call("HGET", "shm-big", 0)
+        np.testing.assert_array_equal(back["v"], big)
+        c.close()
+
+        c2 = _Conn("127.0.0.1", broker.port, shm_mode="off")
+        c2.call("HSET", "sock-big", {"v": big})
+        assert c2._shm is None
+        back2 = c2.call("HGET", "sock-big", 0)
+        np.testing.assert_array_equal(back2["v"], big)
+        c2.close()
+    finally:
+        broker.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# per-bucket compiled-executable cache
+# ---------------------------------------------------------------------------
+
+def test_bucket_cache_hit_miss_counters(zoo_ctx):
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+
+    model = Sequential([L.Dense(8, activation="relu", input_shape=(6,)),
+                        L.Dense(3)])
+    model.compile(optimizer="adam", loss="mse")
+    rng = np.random.default_rng(0)
+    model.fit(rng.normal(size=(32, 6)).astype(np.float32),
+              rng.normal(size=(32, 3)).astype(np.float32),
+              batch_size=16, nb_epoch=1)
+    im = InferenceModel(max_batch_size=16).load(model)
+    x = rng.normal(size=(16, 6)).astype(np.float32)
+
+    im.predict(x[:3])                       # bucket 4: miss -> compile
+    s1 = im.compile_stats()
+    assert s1["compiles"] == 1 and s1["compiled_shapes"] == 1
+    im.predict(x[:4])                       # bucket 4 again: pure dict hit
+    im.predict(x[:3])                       # bucket 4 again (padded up)
+    s2 = im.compile_stats()
+    assert s2["compiles"] == 1
+    assert s2["cache_hits"] >= s1["cache_hits"] + 2
+    im.predict(x[:5])                       # bucket 8: second executable
+    s3 = im.compile_stats()
+    assert s3["compiles"] == 2 and s3["compiled_shapes"] == 2
+    # mixed-size traffic: every size <= 16 maps into the bucket ladder
+    for n in (1, 3, 6, 7, 9, 12, 16, 2, 5):
+        im.predict(x[:n])
+    from analytics_zoo_tpu.inference.inference_model import _buckets
+
+    assert im.compile_stats()["compiled_shapes"] <= len(_buckets(16))
+
+
+def test_microbatcher_bucket_padding(zoo_ctx):
+    from analytics_zoo_tpu.serving.batching import MicroBatcher
+
+    seen = []
+
+    def predict(b):
+        arr = np.asarray(b)
+        seen.append(arr.shape[0])
+        return arr * 2.0
+
+    mb = MicroBatcher(predict, max_batch=16, max_delay_ms=50.0)
+    try:
+        slots = [mb.submit_async({"x": np.full(4, i, np.float32)})
+                 for i in range(5)]
+        outs = [mb.wait(s, timeout_s=30) for s in slots]
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o, np.full(4, 2.0 * i))
+        # every predict batch landed on a power-of-two bucket
+        assert seen and all(b & (b - 1) == 0 for b in seen), seen
+        stats = mb.stats()
+        assert stats["distinct_batch_shapes"] <= 5   # bucket ladder, not sizes
+        assert "queue_depth" in stats and "padded_rows" in stats
+    finally:
+        mb.close()
+
+
+# ---------------------------------------------------------------------------
+# AOF replay of binary-frame payloads
+# ---------------------------------------------------------------------------
+
+def test_aof_replay_binary_frames_store_level(tmp_path):
+    from analytics_zoo_tpu.serving.broker import _Store
+
+    rng = np.random.default_rng(6)
+    arr = rng.normal(size=(9, 4)).astype(np.float32)
+    bf16 = None
+    try:
+        import ml_dtypes
+
+        bf16 = arr.astype(ml_dtypes.bfloat16)
+    except ImportError:  # pragma: no cover
+        pass
+
+    aof = str(tmp_path / "bin.aof")
+    s = _Store(aof_path=aof)
+    s.xgroupcreate("in", "g", "0")
+    payload = {"uri": "r0", "data": {"x": arr}}
+    if bf16 is not None:
+        payload["data"]["h"] = bf16
+    s.xadd("in", payload)
+    s.hset("result:r0", {"value": arr * 2})
+    del s
+
+    s2 = _Store(aof_path=aof)                 # crash-restart replay
+    got = s2.xreadgroup("in", "g", 10, 0)
+    assert len(got) == 1
+    replayed = got[0][1]
+    assert replayed["uri"] == "r0"
+    np.testing.assert_array_equal(replayed["data"]["x"], arr)
+    assert replayed["data"]["x"].dtype == np.float32
+    if bf16 is not None:
+        assert replayed["data"]["h"].dtype == bf16.dtype
+        np.testing.assert_array_equal(replayed["data"]["h"], bf16)
+    np.testing.assert_array_equal(s2.hget("result:r0")["value"], arr * 2)
+
+
+def test_aof_replay_binary_frames_through_live_broker(tmp_path):
+    """Full loop: binary enqueue → broker with AOF → restart → the recovered
+    in-flight entry re-delivers the exact tensor."""
+    from analytics_zoo_tpu.serving import start_broker
+    from analytics_zoo_tpu.serving.client import _Conn
+
+    aof = str(tmp_path / "live.aof")
+    rng = np.random.default_rng(7)
+    arr = rng.normal(size=(32, 16)).astype(np.float32)
+
+    broker = start_broker(aof_path=aof)
+    c = _Conn("127.0.0.1", broker.port)
+    c.call("XGROUPCREATE", "s", "g", "0")
+    c.call("XADD", "s", {"uri": "bin0", "data": {"x": arr}})
+    (entry,) = c.call("XREADGROUP", "s", "g", 1, 0)   # delivered, never acked
+    np.testing.assert_array_equal(entry[1]["data"]["x"], arr)
+    c.close()
+    broker.shutdown()
+
+    broker2 = start_broker(aof_path=aof)              # "crash" restart
+    c2 = _Conn("127.0.0.1", broker2.port)
+    (redelivered,) = c2.call("XREADGROUP", "s", "g", 10, 0)
+    assert redelivered[1]["uri"] == "bin0"
+    np.testing.assert_array_equal(redelivered[1]["data"]["x"], arr)
+    c2.close()
+    broker2.shutdown()
