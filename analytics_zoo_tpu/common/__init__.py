@@ -1,6 +1,7 @@
 """Core runtime: context/mesh bootstrap, config, summaries, triggers,
 resilience (retry/backoff, circuit breaking, heartbeats) and chaos testing."""
 
+from . import telemetry
 from .chaos import (ChaosSchedule, WorkerKilled, chaos_point, get_chaos,
                     install_chaos, uninstall_chaos)
 from .config import (MeshConfig, PrecisionConfig, RuntimeConfig, TrainConfig,
@@ -26,5 +27,5 @@ __all__ = [
     "TrainerState", "ValidationSummary", "WorkerKilled", "ZooContext",
     "apply_env_overrides", "build_mesh", "chaos_point", "get_chaos",
     "get_zoo_context", "init_zoo_context", "install_chaos", "read_scalars",
-    "reset_zoo_context", "timing", "uninstall_chaos",
+    "reset_zoo_context", "telemetry", "timing", "uninstall_chaos",
 ]

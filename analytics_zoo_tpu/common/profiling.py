@@ -32,11 +32,20 @@ def xprof_trace(log_dir: str) -> Iterator[None]:
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
     """Named region inside a trace (TraceAnnotation) + wall-time log — the
-    ``timing`` block (InferenceSupportive.scala) upgraded with xprof context."""
-    import jax
+    ``timing`` block (InferenceSupportive.scala) upgraded with xprof context.
+
+    Measurements ACCUMULATE: each run lands in the shared registry's
+    ``zoo_span_duration_seconds{span=name}`` histogram (counts/sum/buckets →
+    rates and percentiles at scrape time) and the span recorder, instead of
+    being logged once and thrown away. The xprof TraceAnnotation is entered by
+    the telemetry span itself (jax is imported here, so the integration is
+    active)."""
+    import jax  # noqa: F401  — guarantees the span's xprof annotation engages
+
+    from . import telemetry
 
     t0 = time.perf_counter()
-    with jax.profiler.TraceAnnotation(name):
+    with telemetry.span(name):
         yield
     log.info("%s: %.1f ms", name, (time.perf_counter() - t0) * 1e3)
 

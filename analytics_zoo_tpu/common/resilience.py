@@ -33,7 +33,55 @@ import dataclasses
 import random
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
+
+from . import telemetry as _tm
+
+# breaker/heartbeat state lands on the shared scrape: live instances register
+# into weak sets and scrape-time collectors walk them — no per-beat overhead
+# beyond what the classes already pay
+_LIVE_BREAKERS: "weakref.WeakSet[CircuitBreaker]" = weakref.WeakSet()
+_LIVE_REGISTRIES: "weakref.WeakSet[HealthRegistry]" = weakref.WeakSet()
+_BREAKER_STATE_VALUE = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+_BREAKER_OPENS = _tm.counter("zoo_breaker_opens_total",
+                             "Circuit-breaker open transitions",
+                             labels=("name",))
+_RETRIES = _tm.counter("zoo_retry_attempts_total",
+                       "Failures recorded by retry trackers (each implies a "
+                       "backoff or a terminal retry error)")
+
+
+def _collect_breaker_states():
+    # same-named breakers (two frontends in one process both default to
+    # "serving-frontend") aggregate by WORST state, so an open breaker can
+    # never be masked by a healthy same-named sibling on the scrape
+    out = {}
+    for b in list(_LIVE_BREAKERS):
+        key = (b.name,)
+        v = _BREAKER_STATE_VALUE.get(b.state, -1.0)
+        out[key] = max(out.get(key, -1.0), v)
+    return out.items()
+
+
+def _collect_component_liveness():
+    # keyed by (registry, component): two registries in one process (e.g. two
+    # serving jobs) may register same-named components, and last-write-wins
+    # over a bare component label would nondeterministically report a dead
+    # job's entry for a live one
+    out = {}
+    for reg in list(_LIVE_REGISTRIES):
+        for name, comp in reg.status()["components"].items():
+            out[(reg.name, name)] = 1.0 if comp["alive"] else 0.0
+    return out.items()
+
+
+_tm.collector("zoo_breaker_state",
+              "Circuit-breaker state (0=closed, 1=half_open, 2=open)",
+              _collect_breaker_states, labels=("name",))
+_tm.collector("zoo_component_alive",
+              "Heartbeat liveness per registered component (1=alive)",
+              _collect_component_liveness, labels=("registry", "component"))
 
 
 class ResilienceError(Exception):
@@ -177,6 +225,7 @@ class RetryTracker:
     def record_failure(self, exc: BaseException) -> float:
         self.attempts += 1
         self.last_error = exc
+        _RETRIES.inc()
         if self.exhausted:
             raise RetryExhaustedError(
                 f"gave up after {self.attempts} attempts: {exc}") from exc
@@ -224,6 +273,7 @@ class CircuitBreaker:
         self._state = self.CLOSED
         self._opened_at = 0.0
         self._probes = 0
+        _LIVE_BREAKERS.add(self)
 
     # -- state ---------------------------------------------------------------
     @property
@@ -242,6 +292,7 @@ class CircuitBreaker:
         self._state = self.OPEN
         self._opened_at = self._clock()
         self._outcomes.clear()
+        _BREAKER_OPENS.labels(name=self.name).inc()
 
     def retry_after_s(self) -> float:
         """Seconds until the next probe is admitted (0 when not open)."""
@@ -331,12 +382,22 @@ class HealthRegistry:
     serving supervisor's respawn and the TaskPool watchdog.
     """
 
+    _seq = 0
+    _seq_lock = threading.Lock()
+
     def __init__(self, default_timeout_s: float = 5.0,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 name: Optional[str] = None):
         self.default_timeout_s = default_timeout_s
+        if name is None:
+            with HealthRegistry._seq_lock:
+                HealthRegistry._seq += 1
+                name = f"hr{HealthRegistry._seq}"
+        self.name = name     # distinguishes registries on the shared scrape
         self._clock = clock or time.monotonic
         self._lock = threading.Lock()
         self._entries: Dict[str, Dict[str, Any]] = {}
+        _LIVE_REGISTRIES.add(self)
 
     def register(self, name: str, timeout_s: Optional[float] = None,
                  **meta) -> Heartbeat:

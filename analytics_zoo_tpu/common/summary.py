@@ -18,6 +18,17 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
+from . import telemetry as _tm
+
+# every scalar written to an event file is mirrored here, so the TensorBoard
+# curves, the metrics.jsonl stream, and a Prometheus scrape all show one set
+# of numbers (the ISSUE-3 "same numbers everywhere" contract)
+_SUMMARY_SCALAR = _tm.gauge(
+    "zoo_summary_scalar", "Latest value of each Train/Validation summary tag",
+    labels=("app", "kind", "tag"))
+_SUMMARY_EVENTS = _tm.counter(
+    "zoo_summary_events_total", "Scalar events written to summary files")
+
 # ----------------------------------------------------------------------------- crc32c
 # TFRecord framing uses masked CRC32-C (Castagnoli). Table-driven implementation.
 
@@ -233,6 +244,8 @@ class Summary:
     """Base for Train/Validation summaries (Topology.scala:196-239 parity)."""
 
     def __init__(self, log_dir: str, app_name: str, kind: str):
+        self.app_name = app_name
+        self.kind = kind
         self.log_dir = os.path.join(log_dir, app_name, kind)
         self.writer = EventWriter(self.log_dir)
         self._jsonl = open(os.path.join(self.log_dir, "metrics.jsonl"), "a")
@@ -241,6 +254,10 @@ class Summary:
         clean = {k: float(v) for k, v in scalars.items()}
         self.writer.add_scalars(step, clean)
         self._jsonl.write(json.dumps({"step": step, "ts": time.time(), **clean}) + "\n")
+        for tag, v in clean.items():
+            _SUMMARY_SCALAR.labels(app=self.app_name, kind=self.kind,
+                                   tag=tag).set(v)
+        _SUMMARY_EVENTS.inc(len(clean))
         self.flush()
 
     def read_scalar(self, tag: str) -> List[Tuple[int, float]]:

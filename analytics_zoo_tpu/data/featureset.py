@@ -25,11 +25,23 @@ from __future__ import annotations
 import math
 import os
 import tempfile
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from ..common import telemetry as _tm
+
 ArrayTree = Any  # nested tuple/dict/list of np.ndarray, all with equal leading dim
+
+# input-pipeline visibility: how long each host-side batch takes to
+# materialize (gather/slice/decode) — the producer-side complement of the
+# Estimator's per-step DataWait, which only sees time the STEP loop blocked
+_DATA_BATCHES = _tm.counter("zoo_data_batches_total",
+                            "Host batches produced by FeatureSet iterators")
+_DATA_GATHER = _tm.histogram("zoo_data_batch_gather_seconds",
+                             "Host time to materialize one batch "
+                             "(gather/slice, memmap reads)")
 
 
 class MemoryType:
@@ -297,7 +309,24 @@ class FeatureSet:
 
         ``batch_size`` is GLOBAL and must divide by ``process_count`` (the
         reference requires batch % total_cores == 0 — tf_dataset.py:144).
+        Each batch's host-side materialization time lands in the shared
+        registry (``zoo_data_batch_gather_seconds``).
         """
+        inner = self._iter_batches(batch_size, epoch=epoch, shuffle=shuffle,
+                                   drop_remainder=drop_remainder)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                b = next(inner)
+            except StopIteration:
+                return
+            _DATA_GATHER.observe(time.perf_counter() - t0)
+            _DATA_BATCHES.inc()
+            yield b
+
+    def _iter_batches(self, batch_size: int, *, epoch: int = 0,
+                      shuffle: bool = True,
+                      drop_remainder: bool = True) -> Iterator[ArrayTree]:
         if batch_size % self.process_count:
             raise ValueError(
                 f"global batch {batch_size} not divisible by {self.process_count} hosts")

@@ -29,6 +29,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..common import telemetry as _tm
 from ..common.chaos import chaos_point
 from ..common.config import TrainConfig
 from ..common.context import get_zoo_context
@@ -44,6 +45,32 @@ from ..nn.optimizers import get_optimizer, with_clipping
 from . import checkpoint as ckpt
 
 logger = logging.getLogger("analytics_zoo_tpu.estimator")
+
+# per-step training breakdown (ISSUE 3): is the loop data-bound or
+# device-bound? DataWait = time blocked on the host input pipeline;
+# Compute = everything else in the step window (dispatch + device execution,
+# synced at each log point by the loss transfer). The same numbers flush to
+# TrainSummary (TensorBoard + metrics.jsonl) and land here for /metrics.
+_STEPS = _tm.counter("zoo_train_steps_total", "Optimizer steps run")
+_DATA_WAIT = _tm.histogram("zoo_train_data_wait_seconds",
+                           "Per-step host wait on the input pipeline")
+_COMPUTE = _tm.histogram("zoo_train_compute_seconds",
+                         "Per-step dispatch + device time (window mean, "
+                         "synced at log points)")
+_COMPILES = _tm.counter("zoo_train_compiles_total",
+                        "Train-step executables built (first dispatch of a "
+                        "jitted step/scan-block)")
+_COMPILE_TIME = _tm.histogram("zoo_train_compile_seconds",
+                              "Wall time of first-dispatch (compile) steps",
+                              buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30,
+                                       60, 120))
+_ROLLBACKS = _tm.counter("zoo_train_rollbacks_total",
+                         "Checkpoint rollbacks taken by fit's retry loop")
+_CHECKPOINTS = _tm.counter("zoo_train_checkpoints_total",
+                           "Checkpoints saved")
+_SIGTERM_EXITS = _tm.counter("zoo_train_sigterm_exits_total",
+                             "Graceful SIGTERM teardowns (final checkpoint "
+                             "+ exit 143)")
 
 
 class _GracefulStop(BaseException):
@@ -107,6 +134,12 @@ class Estimator:
         self.param_sharding = param_sharding
         self.train_state: Optional[Dict[str, Any]] = None
         self.trainer_state = TrainerState()
+        # compile-event detection keys on the dispatched batch signature (jit
+        # re-traces per shape/dtype): a second fit() with a new batch_size is
+        # a fresh compile that must be attributed to zoo_train_compile_*, not
+        # silently smeared into that window's ComputeMs
+        self._step_shapes: set = set()
+        self._scan_shapes: set = set()
         self.train_summary: Optional[TrainSummary] = None
         self.val_summary: Optional[ValidationSummary] = None
         self._train_step = None
@@ -133,6 +166,7 @@ class Estimator:
         self.config.gradient_clip_value = clip_value
         self.tx = with_clipping(self._base_tx, clip_norm, clip_value)
         self._train_step = None
+        self._step_shapes.clear()
         return self
 
     # ------------------------------------------------------------------ shardings
@@ -345,6 +379,7 @@ class Estimator:
                         # ORIGINAL failure (reference semantics — callers see
                         # what actually broke, with the policy error chained)
                         raise e
+                    _ROLLBACKS.inc()
                     logger.warning("step failed (%s); retry %d/%d from %s "
                                    "in %.2fs", e, tracker.attempts,
                                    cfg.retry_times, latest, delay)
@@ -372,6 +407,7 @@ class Estimator:
             # resumes exactly here, then exit 143 (128+SIGTERM) — the
             # conventional graceful-termination status
             jax.block_until_ready(self.train_state)
+            _SIGTERM_EXITS.inc()
             if cfg.checkpoint_dir:
                 self._save(cfg.checkpoint_dir)
                 logger.warning("SIGTERM: final checkpoint saved at iter %d; "
@@ -428,32 +464,79 @@ class Estimator:
             while buf:
                 yield buf.pop(0)
 
-        for global_batch in prefetched():
+        # per-step breakdown window: data-wait accumulates per batch; compute
+        # is the window remainder, synced by the float(loss) transfer at each
+        # log point so dispatched-but-unfinished device work can't hide
+        it = prefetched()
+        win_t0 = t0
+        win_steps = 0
+        win_data_wait = 0.0
+        epoch_data_wait = 0.0
+        epoch_compile = 0.0
+        while True:
+            td = time.perf_counter()
+            try:
+                global_batch = next(it)
+            except StopIteration:
+                break
+            dw = time.perf_counter() - td
+            win_data_wait += dw
+            epoch_data_wait += dw
+            _DATA_WAIT.observe(dw)
             self._check_interrupt()
             chaos_point("estimator.step")
+            key = self._batch_signature(global_batch)
+            t_step = time.perf_counter()
             self.train_state, loss = self._train_step(self.train_state, global_batch)
+            if key not in self._step_shapes:
+                # first dispatch of this shape = compile event: sync so its
+                # cost is attributed to compilation, not smeared over the
+                # window — which requires restarting the window clock here,
+                # and excluding the cost from the epoch epilogue's ComputeMs
+                jax.block_until_ready(loss)
+                self._step_shapes.add(key)
+                _COMPILES.inc()
+                compile_s = time.perf_counter() - t_step
+                _COMPILE_TIME.observe(compile_s)
+                epoch_compile += compile_s
+                win_t0 += compile_s
+            _STEPS.inc()
+            win_steps += 1
             ts.iteration += 1
             seen += batch_size
             if ts.iteration % cfg.log_every_n_steps == 0:
                 loss_val = float(loss)
                 ts.last_loss = loss_val
-                dt = time.perf_counter() - t0
-                throughput = seen / max(dt, 1e-9)
+                now = time.perf_counter()
+                throughput = seen / max(now - t0, 1e-9)
+                data_ms = win_data_wait / win_steps * 1e3
+                compute_ms = max(0.0, (now - win_t0 - win_data_wait)
+                                 / win_steps) * 1e3
+                _COMPUTE.observe(compute_ms / 1e3)
                 if self.train_summary:
                     self.train_summary.add_scalars(ts.iteration, {
-                        "Loss": loss_val, "Throughput": throughput})
-                logger.info("epoch %d iter %d loss %.4f throughput %.1f rec/s",
-                            epoch, ts.iteration, loss_val, throughput)
+                        "Loss": loss_val, "Throughput": throughput,
+                        "DataWaitMs": data_ms, "ComputeMs": compute_ms})
+                logger.info("epoch %d iter %d loss %.4f throughput %.1f rec/s"
+                            " (data %.2fms compute %.2fms /step)",
+                            epoch, ts.iteration, loss_val, throughput,
+                            data_ms, compute_ms)
+                win_t0, win_steps, win_data_wait = now, 0, 0.0
             if (checkpoint_trigger is not None and checkpoint_trigger(ts)
                     and cfg.checkpoint_dir):
                 self._save(cfg.checkpoint_dir)
-        self._finish_epoch(t0, seen, loss)
+        self._finish_epoch(t0, seen, loss, batch_size,
+                           data_wait_s=epoch_data_wait,
+                           compile_s=epoch_compile)
 
-    def _finish_epoch(self, t0: float, seen: int, loss):
+    def _finish_epoch(self, t0: float, seen: int, loss,
+                      batch_size: Optional[int] = None,
+                      data_wait_s: float = 0.0, compile_s: float = 0.0):
         """Epoch epilogue shared by both epoch runners: final-loss scalar,
         epoch/records bookkeeping, checkpoint save, summary flush."""
         cfg = self.config
         ts = self.trainer_state
+        steps_this_epoch = max(1, seen // max(1, batch_size or cfg.batch_size))
         if loss is not None:
             # lazy: a 0-d device array; TrainerState materializes it on read.
             # Eagerly float()-ing here costs one full tunnel/network RTT per
@@ -464,7 +547,12 @@ class Estimator:
             if self.train_summary:
                 dt = time.perf_counter() - t0
                 self.train_summary.add_scalars(ts.iteration, {
-                    "Loss": ts.last_loss, "Throughput": seen / max(dt, 1e-9)})
+                    "Loss": ts.last_loss, "Throughput": seen / max(dt, 1e-9),
+                    "DataWaitMs": data_wait_s / steps_this_epoch * 1e3,
+                    # compile cost is reported separately
+                    # (zoo_train_compile_seconds), not smeared over steps
+                    "ComputeMs": max(0.0, dt - data_wait_s - compile_s)
+                    / steps_this_epoch * 1e3})
         ts.epoch += 1
         ts.records_processed += seen
         if cfg.checkpoint_dir:
@@ -520,28 +608,49 @@ class Estimator:
         n_blocks = n_steps // block
         seen = 0
         loss = None
-        for b in range(n_blocks):
+        epoch_compile = 0.0
+        win_t0, win_steps = t0, 0          # reset at each log point, like
+        for b in range(n_blocks):          # the streaming path's window
             self._check_interrupt()
             chaos_point("estimator.step")
             sel = idx[b * block * batch_size:(b + 1) * block * batch_size]
             idx_mat = sel.reshape(block, batch_size)
+            t_blk = time.perf_counter()
             self.train_state, losses = self._scan_block(
                 self.train_state, self._device_data, idx_mat)
+            scan_key = tuple(idx_mat.shape)
+            if scan_key not in self._scan_shapes:
+                jax.block_until_ready(losses)
+                self._scan_shapes.add(scan_key)
+                _COMPILES.inc()
+                compile_s = time.perf_counter() - t_blk
+                _COMPILE_TIME.observe(compile_s)
+                epoch_compile += compile_s
+                win_t0 += compile_s     # keep compile out of ComputeMs
             loss = losses[-1]
+            # device-cached epochs: data wait is ~0 by construction (the
+            # dataset lives in HBM; batches are gathers inside the scan), so
+            # the whole block window is compute
+            _STEPS.inc(block)
+            win_steps += block
             ts.iteration += block
             seen += block * batch_size
             if cfg.log_every_n_steps and (b + 1) * block >= cfg.log_every_n_steps \
                     and ((b + 1) * block) // cfg.log_every_n_steps \
                     > (b * block) // cfg.log_every_n_steps:
-                loss_val = float(loss)
+                loss_val = float(loss)          # device sync closes the window
                 ts.last_loss = loss_val
-                dt = time.perf_counter() - t0
-                throughput = seen / max(dt, 1e-9)
+                now = time.perf_counter()
+                throughput = seen / max(now - t0, 1e-9)
+                compute_ms = (now - win_t0) / max(1, win_steps) * 1e3
+                _COMPUTE.observe(compute_ms / 1e3)
                 if self.train_summary:
                     self.train_summary.add_scalars(ts.iteration, {
-                        "Loss": loss_val, "Throughput": throughput})
+                        "Loss": loss_val, "Throughput": throughput,
+                        "DataWaitMs": 0.0, "ComputeMs": compute_ms})
                 logger.info("epoch %d iter %d loss %.4f throughput %.1f rec/s",
                             epoch, ts.iteration, loss_val, throughput)
+                win_t0, win_steps = now, 0
             if (checkpoint_trigger is not None and cfg.checkpoint_dir
                     and self._trigger_crossed(checkpoint_trigger, ts, block)):
                 self._save(cfg.checkpoint_dir)
@@ -552,13 +661,31 @@ class Estimator:
             sel = idx[s * batch_size:(s + 1) * batch_size]
             db = jax.tree_util.tree_map(lambda a: jnp.take(a, sel, axis=0),
                                         self._device_data)
+            key = self._batch_signature(db)
+            t_step = time.perf_counter()
             self.train_state, loss = self._train_step(self.train_state, db)
+            if key not in self._step_shapes:
+                jax.block_until_ready(loss)
+                self._step_shapes.add(key)
+                _COMPILES.inc()
+                compile_s = time.perf_counter() - t_step
+                _COMPILE_TIME.observe(compile_s)
+                epoch_compile += compile_s
+            _STEPS.inc()
             ts.iteration += 1
             seen += batch_size
             if (checkpoint_trigger is not None and checkpoint_trigger(ts)
                     and cfg.checkpoint_dir):
                 self._save(cfg.checkpoint_dir)
-        self._finish_epoch(t0, seen, loss)
+        self._finish_epoch(t0, seen, loss, batch_size,
+                           compile_s=epoch_compile)
+
+    @staticmethod
+    def _batch_signature(batch) -> Tuple:
+        """Shape/dtype key of a dispatched batch — the thing jit re-traces
+        on."""
+        return tuple((tuple(l.shape), str(getattr(l, "dtype", type(l))))
+                     for l in jax.tree_util.tree_leaves(batch))
 
     def _check_interrupt(self):
         """SIGTERM lands between device steps (a step is never torn mid-
@@ -578,6 +705,7 @@ class Estimator:
 
     def _save(self, directory: str):
         if get_zoo_context().process_index == 0:
+            _CHECKPOINTS.inc()
             ckpt.save_checkpoint(directory, self.train_state,
                                  iteration=self.trainer_state.iteration,
                                  epoch=self.trainer_state.epoch)

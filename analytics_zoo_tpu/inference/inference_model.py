@@ -36,7 +36,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import telemetry as _tm
 from .summary import InferenceSummary, timing
+
+_COMPILES = _tm.counter("zoo_infer_compiles_total",
+                        "Bucketed executables built by InferenceModel "
+                        "(flat under steady traffic = no mid-stream "
+                        "recompiles)")
+_CACHE_HITS = _tm.counter("zoo_infer_cache_hits_total",
+                          "Dispatches served by a compiled-cache dict lookup")
 
 
 def _buckets(max_batch: int) -> List[int]:
@@ -268,8 +276,10 @@ class InferenceModel:
                     exe = jax.jit(self._apply)
                     self._compiled[key] = exe
                     self.compile_count += 1
+                    _COMPILES.inc()
                     return exe
         self.cache_hit_count += 1
+        _CACHE_HITS.inc()
         return exe
 
     def compile_stats(self) -> Dict[str, int]:

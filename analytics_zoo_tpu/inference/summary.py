@@ -14,7 +14,14 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..common import telemetry as _tm
+
 logger = logging.getLogger("analytics_zoo_tpu.inference")
+
+_TIMING_HIST = _tm.histogram(
+    "zoo_timing_seconds",
+    "Wall time of timing() blocks (buckets give the percentiles the "
+    "count/total/max dict never could)", labels=("name",))
 
 
 class _TimingStats:
@@ -47,6 +54,7 @@ def timing(name: str, log: bool = False):
             st.count += 1
             st.total_s += dt
             st.max_s = max(st.max_s, dt)
+        _TIMING_HIST.labels(name=name).observe(dt)
         if log:
             logger.info("%s time elapsed [%.3f ms]", name, dt * 1e3)
 

@@ -11,11 +11,15 @@ data marshalling (XShards / pandas / numpy / FeatureSet → device batches).
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ...common import telemetry as _tm
 from ...data.xshards import XShards
+
+_ORCA_FITS = _tm.counter("zoo_orca_fit_total",
+                         "Orca Estimator.fit invocations", labels=("input",))
 
 
 def _marshal_shards(data: XShards, feature_cols, label_cols):
@@ -150,6 +154,16 @@ class Estimator:
         its own slice into a ``FeatureSet.from_host_shard`` — the multi-host
         sharded-ingest path; no host materializes the global dataset."""
         self._ensure_compiled()
+        _ORCA_FITS.labels(input=type(data).__name__).inc()
+        # the fit span shows up in xprof captures and the span recorder; the
+        # per-step DataWait/Compute breakdown comes from the engine Estimator
+        # underneath (model.fit) and is read back via train_stats()
+        with _tm.span("orca.fit"):
+            return self._fit(data, epochs, batch_size, feature_cols,
+                             label_cols, validation_data, host_sharding)
+
+    def _fit(self, data, epochs, batch_size, feature_cols, label_cols,
+             validation_data, host_sharding) -> "Estimator":
         if isinstance(data, XShards):
             import jax
 
@@ -180,6 +194,14 @@ class Estimator:
         self.model.fit(x, y, batch_size=batch_size, nb_epoch=epochs,
                        validation_data=val)
         return self
+
+    def train_stats(self) -> Dict[str, Any]:
+        """The training-side telemetry snapshot (per-step data-wait vs.
+        compute histograms, compile/rollback/checkpoint counters) — the same
+        numbers the Prometheus endpoint and TensorBoard scalars show."""
+        snap = _tm.snapshot()
+        return {k: v for k, v in snap.items() if k.startswith("zoo_train_")
+                or k.startswith("zoo_data_") or k == "zoo_summary_scalar"}
 
     def evaluate(self, data, batch_size: int = 32,
                  feature_cols=None, label_cols=None, metrics=None):

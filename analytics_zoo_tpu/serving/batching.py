@@ -23,9 +23,27 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..common import telemetry as _tm
+
+_B_RECORDS = _tm.counter("zoo_batch_records_total",
+                         "Records submitted to micro-batchers")
+_B_RUNS = _tm.counter("zoo_batch_runs_total",
+                      "Micro-batches dispatched to predict_fn")
+_B_PADDED = _tm.counter("zoo_batch_padded_rows_total",
+                        "Zero-pad rows added to reach a bucket size")
+_B_SIZE = _tm.histogram("zoo_batch_size",
+                        "Records coalesced per micro-batch",
+                        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+_LIVE_BATCHERS: "weakref.WeakSet[MicroBatcher]" = weakref.WeakSet()
+_tm.collector("zoo_batch_queue_depth",
+              "Live queue depth summed over this process's micro-batchers",
+              lambda: [((), float(sum(b._q.qsize()
+                                      for b in list(_LIVE_BATCHERS))))])
 
 
 class _Slot:
@@ -67,6 +85,7 @@ class MicroBatcher:
         # bucket_pad this stays <= len(buckets) per tensor signature, which is
         # exactly the "no mid-traffic recompile" property /metrics watches
         self.batch_shapes_seen = set()
+        _LIVE_BATCHERS.add(self)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-microbatcher")
         self._thread.start()
@@ -143,6 +162,9 @@ class MicroBatcher:
         self.batches_run += 1
         self.max_batch_seen = max(self.max_batch_seen, k)
         self.batch_sizes.append(k)
+        _B_RECORDS.inc(k)
+        _B_RUNS.inc()
+        _B_SIZE.observe(k)
         try:
             names = list(group[0].tensors)
             arrays = [np.stack([s.tensors[n] for s in group]) for n in names]
@@ -151,6 +173,7 @@ class MicroBatcher:
                 arrays = [np.pad(a, [(0, bucket - k)] + [(0, 0)] * (a.ndim - 1))
                           for a in arrays]
                 self.padded_rows += bucket - k
+                _B_PADDED.inc(bucket - k)
             self.batch_shapes_seen.add(
                 tuple((bucket,) + a.shape[1:] + (str(a.dtype),)
                       for a in arrays))

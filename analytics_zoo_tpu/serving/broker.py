@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import argparse
 import collections
+import contextlib
 import json
 import os
 import socketserver
@@ -43,11 +44,28 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from .schema import json_default, json_revive
+from ..common import telemetry as _tm
+from .schema import json_default, json_revive, payload_trace
 # wire-protocol primitives live in wire.py; re-exported here because the
 # historical import surface for the framing helpers is this module
 from .wire import (MAX_MSG, VERSION as WIRE_VERSION,  # noqa: F401
-                   _recv_exact, recv_msg, send_msg, wire_stats)
+                   _recv_exact, received_trace_context, recv_msg, send_msg,
+                   wire_stats)
+
+_KNOWN_CMDS = frozenset({"XADD", "XGROUPCREATE", "XREADGROUP", "XACK",
+                         "HSET", "HGET", "HDEL", "LEN", "PING", "SHMOPEN",
+                         "INFO", "SHUTDOWN"})
+# unknown verbs collapse to one label value: client-supplied strings must not
+# mint unbounded counter children in the process-wide registry
+_CMDS = _tm.counter("zoo_broker_commands_total",
+                    "Broker commands handled, by verb", labels=("cmd",))
+_AOF_REPLAYED = _tm.counter(
+    "zoo_broker_aof_replayed_records_total",
+    "AOF records replayed at broker startup, by record op", labels=("op",))
+_SHM_NEG = _tm.counter(
+    "zoo_broker_shm_negotiations_total",
+    "SHMOPEN ring negotiations, by outcome (fallback = connection stays "
+    "socket-only)", labels=("outcome",))
 
 
 class _Store:
@@ -82,6 +100,9 @@ class _Store:
         self._aof = None
         self._aof_path = aof_path
         self._ops_since_rewrite = 0
+        # replay visibility: counts by record op, surfaced by INFO/`cli info`
+        # and mirrored into the shared metric registry
+        self.replayed: Dict[str, int] = {}
         if aof_path:
             if os.path.exists(aof_path):
                 self._replay(aof_path)
@@ -169,6 +190,8 @@ class _Store:
                 except json.JSONDecodeError:
                     continue  # torn final write from the crash: ignore
                 op = rec[0]
+                self.replayed[op] = self.replayed.get(op, 0) + 1
+                _AOF_REPLAYED.labels(op=op).inc()
                 if op == "A":
                     _, stream, entry_id, payload = rec
                     all_payloads[stream][entry_id] = payload
@@ -336,6 +359,12 @@ class _Store:
             return len(self.streams[stream])
 
 
+# connection-scoped command sentinels (returned by _dispatch, acted on by
+# handle() which owns the per-connection state)
+_SHMOPEN = object()
+_SHUTDOWN = object()
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         from ..common.chaos import chaos_point
@@ -346,68 +375,108 @@ class _Handler(socketserver.BaseRequestHandler):
             while True:
                 req = recv_msg(self.request, shm=shm_ch)
                 cmd = req[0]
+                verb = (cmd if isinstance(cmd, str) and cmd in _KNOWN_CMDS
+                        else "unknown")   # unhashable/garbage cmd must still
+                                          # get the unknown-command reply
+                _CMDS.labels(cmd=verb).inc()
+                self.server.count_command(verb)  # type: ignore[attr-defined]
+                # parent the broker-side span on the client's trace: binary
+                # frames carry it in the header, JSON XADDs inside the payload
+                # dict; commands without one (old clients, polls) skip the
+                # span — no orphan traces from XREADGROUP idle loops
+                ctx = received_trace_context()
+                if ctx is None and cmd == "XADD" and len(req) > 2:
+                    ctx = payload_trace(req[2])
+                span_cm = (_tm.span("serving.broker.handle", remote=ctx,
+                                    cmd=str(cmd)) if ctx is not None
+                           else contextlib.nullcontext())
                 # deterministic fault site: a "fail" rule severs this client's
                 # connection mid-protocol (the except below closes it); a
                 # "delay" rule models a slow broker reply
                 chaos_point("broker.handle", tag=cmd)
-                if cmd == "XADD":
-                    resp = store.xadd(req[1], req[2])
-                elif cmd == "XGROUPCREATE":
-                    store.xgroupcreate(req[1], req[2],
-                                       req[3] if len(req) > 3 else "$")
-                    resp = "OK"
-                elif cmd == "XREADGROUP":
-                    resp = store.xreadgroup(req[1], req[2], req[3], req[4])
-                elif cmd == "XACK":
-                    resp = store.xack(req[1], req[2], req[3])
-                elif cmd == "HSET":
-                    store.hset(req[1], req[2])
-                    resp = "OK"
-                elif cmd == "HGET":
-                    resp = store.hget(req[1], req[2] if len(req) > 2 else 0)
-                elif cmd == "HDEL":
-                    store.hdel(req[1])
-                    resp = "OK"
-                elif cmd == "LEN":
-                    resp = store.slen(req[1])
-                elif cmd == "PING":
-                    resp = "PONG"
-                elif cmd == "SHMOPEN":
-                    # same-host zero-copy negotiation: attach the client's
-                    # ring; any failure leaves this connection on the socket
-                    # path (the client falls back on a non-"OK" reply)
-                    try:
-                        from .shm import ShmChannel
+                with span_cm:
+                    resp = self._dispatch(cmd, req, store)
+                    if resp is _SHMOPEN:
+                        # same-host zero-copy negotiation: attach the client's
+                        # ring; any failure leaves this connection on the
+                        # socket path (client falls back on a non-"OK" reply)
+                        try:
+                            from .shm import ShmChannel
 
-                        new_ch = ShmChannel.attach(req[1], int(req[2]))
-                    except Exception as e:
-                        resp = {"error": f"shm attach failed: {e}"}
-                    else:
-                        if shm_ch is not None:
-                            shm_ch.close()
-                        shm_ch = new_ch
-                        resp = "OK"
-                elif cmd == "INFO":
-                    with store.lock:
-                        streams = {s: len(e) for s, e in store.streams.items()}
-                        n_hashes = len(store.hashes)
-                    resp = {"wire_version": WIRE_VERSION,
-                            "streams": streams, "hashes": n_hashes,
-                            "shm_attached": shm_ch is not None,
-                            "wire": wire_stats()}
-                elif cmd == "SHUTDOWN":
-                    send_msg(self.request, "OK")
-                    threading.Thread(target=self.server.shutdown,
-                                     daemon=True).start()
-                    return
-                else:
-                    resp = {"error": f"unknown command {cmd!r}"}
+                            new_ch = ShmChannel.attach(req[1], int(req[2]))
+                        except Exception as e:
+                            _SHM_NEG.labels(outcome="fallback").inc()
+                            self.server.count_shm(  # type: ignore[attr-defined]
+                                "fallback")
+                            resp = {"error": f"shm attach failed: {e}"}
+                        else:
+                            if shm_ch is not None:
+                                shm_ch.close()
+                            shm_ch = new_ch
+                            _SHM_NEG.labels(outcome="ok").inc()
+                            self.server.count_shm(  # type: ignore[attr-defined]
+                                "ok")
+                            resp = "OK"
+                    elif resp is _SHUTDOWN:
+                        send_msg(self.request, "OK")
+                        threading.Thread(target=self.server.shutdown,
+                                         daemon=True).start()
+                        return
+                    elif cmd == "INFO":
+                        resp["shm_attached"] = shm_ch is not None
                 send_msg(self.request, resp, shm=shm_ch)
         except (ConnectionError, OSError):
             return
         finally:
             if shm_ch is not None:
                 shm_ch.close()
+
+    def _dispatch(self, cmd, req, store: "_Store"):
+        """Store-level command handling; connection-scoped commands (SHMOPEN,
+        SHUTDOWN) return sentinels for :meth:`handle` to act on."""
+        if cmd == "XADD":
+            return store.xadd(req[1], req[2])
+        if cmd == "XGROUPCREATE":
+            store.xgroupcreate(req[1], req[2],
+                               req[3] if len(req) > 3 else "$")
+            return "OK"
+        if cmd == "XREADGROUP":
+            return store.xreadgroup(req[1], req[2], req[3], req[4])
+        if cmd == "XACK":
+            return store.xack(req[1], req[2], req[3])
+        if cmd == "HSET":
+            store.hset(req[1], req[2])
+            return "OK"
+        if cmd == "HGET":
+            return store.hget(req[1], req[2] if len(req) > 2 else 0)
+        if cmd == "HDEL":
+            store.hdel(req[1])
+            return "OK"
+        if cmd == "LEN":
+            return store.slen(req[1])
+        if cmd == "PING":
+            return "PONG"
+        if cmd == "SHMOPEN":
+            return _SHMOPEN
+        if cmd == "INFO":
+            with store.lock:
+                streams = {s: len(e) for s, e in store.streams.items()}
+                n_hashes = len(store.hashes)
+                replayed = dict(store.replayed)
+            server = self.server  # type: ignore[attr-defined]
+            return {"wire_version": WIRE_VERSION,
+                    "streams": streams, "hashes": n_hashes,
+                    "wire": wire_stats(),
+                    # observability satellites: replay + ring-negotiation
+                    # visibility, printed verbatim by `cli info`. These are
+                    # per-BROKER-INSTANCE counts (like streams/hashes) — the
+                    # registry's zoo_broker_* counters aggregate the process
+                    "aof_replayed_records": replayed,
+                    "shm_negotiations": server.shm_counts(),
+                    "commands": server.command_counts()}
+        if cmd == "SHUTDOWN":
+            return _SHUTDOWN
+        return {"error": f"unknown command {cmd!r}"}
 
 
 class QueueBroker(socketserver.ThreadingTCPServer):
@@ -419,6 +488,27 @@ class QueueBroker(socketserver.ThreadingTCPServer):
                  reclaim_idle_ms: int = 60_000):
         super().__init__((host, port), _Handler)
         self.store = _Store(aof_path=aof_path, reclaim_idle_ms=reclaim_idle_ms)
+        # per-instance observability counts for INFO (a process can host
+        # several brokers; the registry counters aggregate across them)
+        self._counts_lock = threading.Lock()
+        self._commands: Dict[str, int] = {}
+        self._shm_neg = {"ok": 0, "fallback": 0}
+
+    def count_command(self, verb: str) -> None:
+        with self._counts_lock:
+            self._commands[verb] = self._commands.get(verb, 0) + 1
+
+    def count_shm(self, outcome: str) -> None:
+        with self._counts_lock:
+            self._shm_neg[outcome] += 1
+
+    def command_counts(self) -> Dict[str, int]:
+        with self._counts_lock:
+            return dict(self._commands)
+
+    def shm_counts(self) -> Dict[str, int]:
+        with self._counts_lock:
+            return dict(self._shm_neg)
 
     @property
     def port(self) -> int:

@@ -14,7 +14,11 @@ re-delivers in-flight ones.
 
 ``info`` prints the broker's data-plane gauges (wire protocol version,
 per-stream depths, bytes on wire by frame kind, shm attachment) as JSON —
-the operator-side view of the binary zero-copy data plane.
+the operator-side view of the binary zero-copy data plane. Since the unified
+telemetry layer it also carries ``aof_replayed_records`` (per-op counts of
+log records replayed at the last startup), ``shm_negotiations`` (ok vs.
+fallback ring attachments), and per-verb ``commands`` totals — the broker-side
+slice of the shared metric registry (docs/observability.md).
 """
 
 from __future__ import annotations

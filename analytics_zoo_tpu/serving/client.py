@@ -14,11 +14,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import telemetry as _tm
 from ..common.chaos import chaos_point
 from ..common.resilience import RetryPolicy
 from .shm import MIN_SHM_BUFFER_BYTES, ShmChannel, shm_enabled
 from .wire import WireError, recv_msg, send_msg
-from .schema import decode_payload
+from .schema import TRACE_KEY, decode_payload
 
 INPUT_STREAM = "serving_stream"
 RESULT_PREFIX = "result:"
@@ -192,10 +193,14 @@ class InputQueue:
         if not data:
             raise ValueError("enqueue needs at least one named tensor")
         uri = uri or uuid.uuid4().hex
-        payload = {"uri": uri, "data":
-                   {k: np.asarray(v) if not isinstance(v, (str, bytes)) else v
-                    for k, v in data.items()}}
-        self._conn.call("XADD", self.stream, payload)
+        # the send span parents the whole request's trace: its context rides
+        # BOTH the binary frame header (ambient, via send_msg) and the payload
+        # (durable — it survives the broker stream/AOF to the engine hops)
+        with _tm.span("serving.client.send", uri=uri) as sp:
+            payload = {"uri": uri, TRACE_KEY: sp.wire_context(), "data":
+                       {k: np.asarray(v) if not isinstance(v, (str, bytes))
+                        else v for k, v in data.items()}}
+            self._conn.call("XADD", self.stream, payload)
         return uri
 
     def __len__(self) -> int:
@@ -219,11 +224,12 @@ class OutputQueue:
 
     def query(self, uri: str, timeout_s: float = 30.0) -> Any:
         """Blocking fetch of one result (client.py:277 parity)."""
-        resp = self._conn.call("HGET", RESULT_PREFIX + uri,
-                               int(timeout_s * 1000))
-        if resp is None:
-            raise TimeoutError(f"no result for {uri!r} within {timeout_s}s")
-        self._conn.call("HDEL", RESULT_PREFIX + uri)
+        with _tm.span("serving.client.query", uri=uri):
+            resp = self._conn.call("HGET", RESULT_PREFIX + uri,
+                                   int(timeout_s * 1000))
+            if resp is None:
+                raise TimeoutError(f"no result for {uri!r} within {timeout_s}s")
+            self._conn.call("HDEL", RESULT_PREFIX + uri)
         decoded = decode_payload(resp)
         if "error" in decoded:
             raise RuntimeError(f"serving error for {uri!r}: {decoded['error']}")

@@ -22,15 +22,22 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import telemetry as _tm
 from ..common.chaos import WorkerKilled, chaos_point
 from ..common.resilience import (HealthRegistry, RetryAbortedError,
                                  RetryPolicy)
 from ..inference import InferenceModel, InferenceSummary
 from .client import INPUT_STREAM, RESULT_PREFIX, _Conn
 from .config import ServingConfig
-from .schema import decode_payload
+from .schema import decode_payload, payload_trace
 
 logger = logging.getLogger("analytics_zoo_tpu.serving")
+
+_RECORDS = _tm.counter("zoo_serving_records_total",
+                       "Records served by the streaming engine",
+                       labels=("outcome",))
+_RESPAWNS = _tm.counter("zoo_serving_worker_respawns_total",
+                        "Dead model-worker slots respawned by the supervisor")
 
 
 class ClusterServing:
@@ -115,14 +122,21 @@ class ClusterServing:
                         time.sleep(0.005)  # non-blocking poll: avoid busy spin
                     continue
                 batch, bad = [], []
+                t_recv = time.perf_counter()
                 for _id, payload in entries:
+                    # trace context enqueued by the client rides the payload
+                    # through the stream (and AOF replay); absent from old
+                    # clients — every consumer below tolerates ctx=None
+                    ctx = payload_trace(payload)
                     try:
                         batch.append((_id, payload["uri"],
-                                      decode_payload(payload["data"])))
+                                      decode_payload(payload["data"]),
+                                      ctx, t_recv))
                     except Exception as e:  # malformed record: report, keep running
                         logger.exception("malformed record %s", _id)
                         uri = payload.get("uri") if isinstance(payload, dict) else None
-                        bad.append((_id, uri, {"error": f"malformed payload: {e}"}))
+                        bad.append((_id, uri,
+                                    {"error": f"malformed payload: {e}"}, ctx))
                 if bad:
                     self._sink_q.put(bad)
                 if batch:
@@ -133,13 +147,13 @@ class ClusterServing:
             hb.stop()
             conn.close()
 
-    def _collate(self, batch: List[Tuple[str, str, Dict[str, np.ndarray]]]):
+    def _collate(self, batch):
         """Stack per-record tensors into batched arrays (FlinkInference batches
         records before predict). Records must share input names/shapes."""
         names = list(batch[0][2].keys())
         arrays = []
         for name in names:
-            arrays.append(np.stack([rec[name] for _, _, rec in batch], axis=0))
+            arrays.append(np.stack([rec[2][name] for rec in batch], axis=0))
         return arrays[0] if len(arrays) == 1 else arrays
 
     def _infer_loop(self, widx: int = 0):
@@ -155,15 +169,30 @@ class ClusterServing:
                     batch = self._infer_q.get(timeout=0.1)
                 except queue.Empty:
                     continue
-                ids = [i for i, _, _ in batch]
-                uris = [u for _, u, _ in batch]
+                ids = [rec[0] for rec in batch]
+                uris = [rec[1] for rec in batch]
+                ctxs = [rec[3] for rec in batch]
+                # micro-batch wait: source receipt -> this worker picking the
+                # batch up (stream dwell + XREADGROUP window + queue depth)
+                t_pick = time.perf_counter()
+                for rec in batch:
+                    if rec[3] is not None:
+                        _tm.record_span("serving.batch.wait", rec[4], t_pick,
+                                        remote=rec[3], worker=widx)
                 try:
                     chaos_point("serving.infer", tag=widx)
                     x = self._collate(batch)
                     y = self.model.predict(x)
                     outs = self._postprocess(y)
-                    self._sink_q.put([(i, u, {"value": o})
-                                      for i, u, o in zip(ids, uris, outs)])
+                    t_done = time.perf_counter()
+                    for ctx in ctxs:
+                        if ctx is not None:
+                            _tm.record_span("serving.engine.dispatch", t_pick,
+                                            t_done, remote=ctx, worker=widx,
+                                            batch=len(batch))
+                    self._sink_q.put([(i, u, {"value": o}, c)
+                                      for i, u, o, c
+                                      in zip(ids, uris, outs, ctxs)])
                 except WorkerKilled:
                     # simulated hard death: hand the un-sunk batch back (it is
                     # still unacked broker-side) and die; the supervisor
@@ -179,8 +208,8 @@ class ClusterServing:
                     return
                 except Exception as e:  # one bad record must not kill the job
                     logger.exception("inference batch failed")
-                    self._sink_q.put([(i, u, {"error": str(e)})
-                                      for i, u in zip(ids, uris)])
+                    self._sink_q.put([(i, u, {"error": str(e)}, c)
+                                      for i, u, c in zip(ids, uris, ctxs)])
                 # a re-queued batch stays in flight, so the decrement lives
                 # here (after sinking) rather than in a finally
                 with self._inflight_lock:
@@ -221,12 +250,21 @@ class ClusterServing:
                     continue
                 try:
                     done_ids = []
-                    for entry_id, uri, value in results:
+                    for entry_id, uri, value, ctx in results:
                         # the connection's policy retries across reconnects; a
                         # RetryAbortedError means stopping AND broker gone.
                         # Result tensors ride raw binary frames (no npy/base64)
                         if uri is not None:
-                            conn.call("HSET", RESULT_PREFIX + uri, value)
+                            if ctx is not None:
+                                with _tm.span("serving.fanout", remote=ctx,
+                                              uri=uri):
+                                    conn.call("HSET", RESULT_PREFIX + uri,
+                                              value)
+                            else:
+                                conn.call("HSET", RESULT_PREFIX + uri, value)
+                        _RECORDS.labels(
+                            outcome="error" if isinstance(value, dict)
+                            and "error" in value else "ok").inc()
                         self.served += 1
                         done_ids.append(entry_id)
                     # results are durably written: release the broker's pending
@@ -261,6 +299,7 @@ class ClusterServing:
                 if not t.is_alive() and not self._stop.is_set():
                     logger.warning("respawning dead infer worker %d", widx)
                     self.workers_respawned += 1
+                    _RESPAWNS.inc()
                     self._spawn_infer_worker(widx)
             self._stop.wait(0.05)
 

@@ -17,7 +17,10 @@ Routes:
     GET  /                 -> liveness ("welcome to analytics zoo web serving")
     GET  /healthz          -> health registry status (503 when a component is dead)
     POST /predict          -> {"instances":[{name: tensor-as-nested-list, ...}]}
-    GET  /metrics          -> timing stats JSON (+ batching stats in direct mode)
+    GET  /metrics          -> the shared telemetry registry as Prometheus text
+                              format (docs/observability.md)
+    GET  /metrics.json     -> legacy JSON stats view (timing + batching +
+                              engine + wire dicts)
 
 Resilience: requests beyond ``max_inflight`` are shed with HTTP 503 +
 ``Retry-After`` (bounded work queue — under overload the frontend answers
@@ -37,12 +40,19 @@ from typing import Optional
 
 import numpy as np
 
+from ..common import telemetry as _tm
 from ..common.resilience import (CircuitBreaker, CircuitOpenError,
                                  HealthRegistry, ResilienceError)
 from ..inference.summary import timing, timing_stats
 from .client import InputQueue, OutputQueue
 from .config import ServingConfig
 from .wire import wire_stats
+
+_HTTP_REQS = _tm.counter("zoo_http_requests_total",
+                         "HTTP /predict requests by final status code",
+                         labels=("code",))
+_HTTP_SHED = _tm.counter("zoo_http_shed_total",
+                         "Requests shed with 503 (admission or breaker)")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -76,6 +86,18 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         app: "FrontEndApp" = self.server.app  # type: ignore[attr-defined]
         if self.path == "/metrics":
+            # ONE scrape shows the whole system: every subsystem (wire,
+            # batching, engine compiles, breakers, heartbeats, spans,
+            # training) reports through the shared registry
+            text = _tm.render_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(text)))
+            self.end_headers()
+            self.wfile.write(text)
+        elif self.path == "/metrics.json":
+            # legacy JSON stats view (pre-registry consumers, quick curl)
             stats = dict(timing_stats())
             if app._batcher is not None:
                 # micro-batcher efficiency: mean/max batch, batches_run,
@@ -107,29 +129,43 @@ class _Handler(BaseHTTPRequestHandler):
         if not app._admit():
             # bounded queue full: shed instead of queueing unbounded work
             app.shed_requests += 1
+            _HTTP_SHED.inc()
+            _HTTP_REQS.labels(code="503").inc()
             self._respond_shed(1.0, "server overloaded, request shed")
             return
+        code = "500"
         try:
             n = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(n) or b"{}")
             instances = body.get("instances")
             if not isinstance(instances, list) or not instances:
                 raise ValueError('body must contain non-empty "instances"')
-            with timing("http.predict"):
+            # root span of the request's trace: in queue mode the enqueue /
+            # query hops (and through them broker + engine) nest under it
+            with timing("http.predict"), \
+                    _tm.span("serving.http.predict", n=len(instances)):
                 preds = app.predict_instances(instances,
                                               timeout_s=app.timeout_s)
+            code = "200"
             self._respond(200, {"predictions": preds})
         except (ValueError, KeyError, json.JSONDecodeError) as e:
+            code = "400"
             self._respond(400, {"error": str(e)})
         except CircuitOpenError as e:
+            code = "503"
+            _HTTP_SHED.inc()
             self._respond_shed(e.retry_after_s, str(e))
         except TimeoutError as e:
+            code = "504"
             self._respond(504, {"error": str(e)})
         except ResilienceError as e:   # broker unreachable after retries
+            code = "503"
+            _HTTP_SHED.inc()
             self._respond_shed(1.0, str(e))
         except Exception as e:  # pragma: no cover
             self._respond(500, {"error": str(e)})
         finally:
+            _HTTP_REQS.labels(code=code).inc()
             app._release()
 
 

@@ -21,9 +21,29 @@ from __future__ import annotations
 
 import base64
 import io
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
+
+# Trace-context field carried INSIDE request/result payload dicts (the JSON
+# control-plane twin of the binary frame header's "c" field): a plain
+# ``{"t": trace_id, "s": span_id}`` dict, JSON- and AOF-serializable, ignored
+# by peers that predate it — interop never depends on its presence.
+TRACE_KEY = "trace"
+
+
+def payload_trace(payload: Any) -> Optional[Dict[str, str]]:
+    """Tolerant read of a payload dict's trace context (``None`` when absent
+    or malformed — e.g. a record enqueued by an old client). Validation is
+    delegated to ``TraceContext.from_wire`` so the payload field and the
+    frame-header field accept exactly the same shapes."""
+    if isinstance(payload, dict):
+        from ..common.telemetry import TraceContext
+
+        ctx = payload.get(TRACE_KEY)
+        if TraceContext.from_wire(ctx) is not None:
+            return ctx
+    return None
 
 
 def encode_ndarray(arr: np.ndarray) -> str:

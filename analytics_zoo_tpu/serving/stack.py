@@ -58,6 +58,11 @@ def main(argv=None) -> int:
     ap.add_argument("--no-shm", action="store_true",
                     help="disable the same-host shared-memory ring (tensor "
                          "buffers then ride the socket as binary frames)")
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="append a JSONL snapshot of the telemetry registry "
+                         "to this file every --metrics-interval seconds and "
+                         "at shutdown (the file-based twin of GET /metrics)")
+    ap.add_argument("--metrics-interval", type=float, default=60.0)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     if args.no_shm:
@@ -95,11 +100,30 @@ def main(argv=None) -> int:
         signal.signal(sig, lambda *_: stop.set())
     threading.Thread(target=app.serve, daemon=True,
                      name="zoo-http-frontend").start()
+    if args.metrics_jsonl:
+        from ..common import telemetry
+
+        def _dump_loop():
+            while not stop.wait(max(1.0, args.metrics_interval)):
+                try:
+                    telemetry.write_jsonl(args.metrics_jsonl)
+                except OSError:
+                    logging.exception("metrics snapshot failed")
+
+        threading.Thread(target=_dump_loop, daemon=True,
+                         name="zoo-metrics-jsonl").start()
     logging.info("serving stack up: http=%s:%d broker=127.0.0.1:%d%s",
                  args.host, args.http_port, args.broker_port,
                  f" aof={args.aof}" if args.aof else "")
     stop.wait()
     logging.info("shutting down")
+    if args.metrics_jsonl:
+        from ..common import telemetry
+
+        try:
+            telemetry.write_jsonl(args.metrics_jsonl)
+        except OSError:
+            pass
     app.stop()
     serving.stop()
     broker.shutdown()

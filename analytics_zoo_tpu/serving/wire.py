@@ -43,6 +43,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import telemetry as _tm
+
 MAGIC = b"\x00ZB"
 VERSION = 1
 _HDR = struct.Struct(">I")
@@ -57,24 +59,55 @@ class WireError(ValueError):
 
 
 # ---------------------------------------------------------------------------
-# byte accounting (exposed at /metrics as bytes-on-wire gauges)
+# byte accounting — shared-registry counters (one scrape shows the whole
+# system); wire_stats() keeps the historical dict shape for /metrics.json,
+# broker INFO, and the bench
 # ---------------------------------------------------------------------------
 
-_stats_lock = threading.Lock()
-_STATS = {"bytes_sent": 0, "bytes_received": 0, "frames_binary": 0,
-          "frames_json": 0, "shm_bytes": 0}
+_WIRE_BYTES = _tm.counter("zoo_wire_bytes_total",
+                          "Bytes moved by the serving wire protocol",
+                          labels=("direction",))
+_WIRE_FRAMES = _tm.counter("zoo_wire_frames_total",
+                           "Frames sent+received by body kind",
+                           labels=("kind",))
+_WIRE_SHM = _tm.counter("zoo_wire_shm_bytes_total",
+                        "Tensor bytes that rode a same-host shm ring "
+                        "instead of the socket")
+
+_ACCOUNT = {
+    "bytes_sent": _WIRE_BYTES.labels(direction="sent"),
+    "bytes_received": _WIRE_BYTES.labels(direction="received"),
+    "frames_binary": _WIRE_FRAMES.labels(kind="binary"),
+    "frames_json": _WIRE_FRAMES.labels(kind="json"),
+    "shm_bytes": _WIRE_SHM.labels(),
+}
 
 
 def _account(**kw) -> None:
-    with _stats_lock:
-        for k, v in kw.items():
-            _STATS[k] += v
+    for k, v in kw.items():
+        _ACCOUNT[k].inc(v)
 
 
 def wire_stats() -> Dict[str, int]:
     """Process-wide data-plane counters (monotonic since import)."""
-    with _stats_lock:
-        return dict(_STATS)
+    return {k: int(c.value()) for k, c in _ACCOUNT.items()}
+
+
+# ---------------------------------------------------------------------------
+# trace-context propagation: binary frames carry the ambient span's context in
+# an optional header field "c" (old decoders ignore unknown header keys; old
+# senders simply omit it — both directions tolerate absence). recv_msg stashes
+# the last received context per thread; connection handlers read it right
+# after recv to parent their server-side spans.
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+def received_trace_context() -> Optional[Dict[str, str]]:
+    """Wire trace context (``{"t": trace_id, "s": span_id}``) carried by the
+    last frame ``recv_msg`` returned on THIS thread, or ``None``."""
+    return getattr(_TLS, "ctx", None)
 
 
 # ---------------------------------------------------------------------------
@@ -407,7 +440,11 @@ def send_msg(sock: socket.socket, obj: Any, shm=None) -> None:
         elif arr.nbytes:
             inline.append(mv)
         descs.append(d)
-    header = pack({"t": tree, "b": descs})
+    meta: Dict[str, Any] = {"t": tree, "b": descs}
+    ctx = _tm.current_wire_context()
+    if ctx is not None:
+        meta["c"] = ctx
+    header = pack(meta)
     inline_bytes = sum(len(m) for m in inline)
     total = _PRE.size + len(header) + inline_bytes
     if total > MAX_MSG:
@@ -441,6 +478,7 @@ def recv_msg(sock: socket.socket, shm=None) -> Any:
         if n > 1:
             recv_exact_into(sock, memoryview(body)[1:])
         _account(bytes_received=4 + n, frames_json=1)
+        _TLS.ctx = None       # JSON control frames carry context in-payload
         return json.loads(bytes(body))
     pre = bytearray(_PRE.size)
     pre[0] = first[0]
@@ -457,6 +495,9 @@ def recv_msg(sock: socket.socket, shm=None) -> Any:
     header = bytearray(header_len)
     recv_exact_into(sock, memoryview(header))
     meta = unpack(header)
+    # optional trace context ("c"): absent from old senders — tolerated
+    ctx = meta.get("c")
+    _TLS.ctx = ctx if _tm.TraceContext.from_wire(ctx) is not None else None
     expect = _PRE.size + header_len + sum(
         d["n"] for d in meta["b"] if "o" not in d)
     if expect != n:

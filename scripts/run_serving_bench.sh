@@ -5,9 +5,14 @@
 #   scripts/run_serving_bench.sh --quick    # CI smoke: small CPU run that
 #                                           # asserts dispatch_rtt_ms under
 #                                           # $ZOO_SERVING_QUICK_RTT_MS (15),
-#                                           # 0 failed requests, and compiled
+#                                           # 0 failed requests, compiled
 #                                           # shapes bounded by the bucket
-#                                           # ladder; never writes the artifact
+#                                           # ladder, AND that a live /metrics
+#                                           # scrape parses as Prometheus text
+#                                           # format and contains the
+#                                           # request-span histogram
+#                                           # (zoo_span_duration_seconds);
+#                                           # never writes the artifact
 #
 # SERVING_BENCH_TIMEOUT (seconds, default 900) caps the run so a wedged
 # accelerator tunnel can never hang CI.

@@ -149,6 +149,30 @@ def run_bench(im=None, n_clients: int = N_CLIENTS,
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+    # scrape /metrics while the app is still up: the quick gate asserts the
+    # exposition parses as Prometheus text and carries the request-span
+    # histogram (run_quick checks metrics_scrape below)
+    metrics_scrape = {"valid": False, "families": 0,
+                      "has_request_span_histogram": False}
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", app.port, timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode("utf-8")
+        conn.close()
+        from analytics_zoo_tpu.common.telemetry import parse_prometheus
+
+        families = parse_prometheus(text)
+        hist = families.get("zoo_span_duration_seconds", {})
+        metrics_scrape = {
+            "valid": resp.status == 200,
+            "families": len(families),
+            "has_request_span_histogram": hist.get("type") == "histogram"
+            and any(l.get("span") == "serving.http.predict"
+                    for _n, l, _v in hist.get("samples", ())),
+        }
+    except Exception as e:
+        metrics_scrape["error"] = repr(e)
     app.stop()
 
     stats = app._batcher.stats()
@@ -179,6 +203,7 @@ def run_bench(im=None, n_clients: int = N_CLIENTS,
         "distinct_batch_shapes": stats["distinct_batch_shapes"],
         "padded_rows": stats["padded_rows"],
         "compiled_shapes": im.compile_stats()["compiled_shapes"],
+        "metrics_scrape": metrics_scrape,
     }
 
 
@@ -412,6 +437,13 @@ def run_quick() -> int:
     if result["compiled_shapes"] > len(_buckets(im.max_batch_size)):
         failures.append(f"compiled_shapes={result['compiled_shapes']} exceeds "
                         f"the bucket ladder")
+    scrape = result.get("metrics_scrape") or {}
+    if not scrape.get("valid"):
+        failures.append(f"/metrics scrape invalid: {scrape}")
+    if not scrape.get("has_request_span_histogram"):
+        failures.append("/metrics lacks the request-span histogram "
+                        "(zoo_span_duration_seconds{span=serving.http."
+                        "predict})")
     if failures:
         print(f"[serving_bench --quick] FAIL: {'; '.join(failures)}",
               file=sys.stderr)

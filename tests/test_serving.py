@@ -191,8 +191,16 @@ def test_http_frontend(zoo_ctx, broker, fitted):
                 f"http://127.0.0.1:{app.port}/", timeout=10) as r:
             assert "welcome" in json.loads(r.read())["message"]
         with urllib.request.urlopen(
-                f"http://127.0.0.1:{app.port}/metrics", timeout=10) as r:
+                f"http://127.0.0.1:{app.port}/metrics.json", timeout=10) as r:
             assert "http.predict" in json.loads(r.read())
+        # the Prometheus twin parses and carries the same request span
+        from analytics_zoo_tpu.common.telemetry import parse_prometheus
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/metrics", timeout=10) as r:
+            fams = parse_prometheus(r.read().decode())
+        assert any(l.get("span") == "serving.http.predict"
+                   for _n, l, _v
+                   in fams["zoo_span_duration_seconds"]["samples"])
     finally:
         app.stop()
         job.stop()
@@ -245,9 +253,9 @@ def test_http_direct_mode_microbatches_across_requests(zoo_ctx, fitted):
         # the batching claim itself: far fewer predict calls than requests
         assert calls["n"] < n_req / 2, (calls, app._batcher.stats())
         assert max(calls["sizes"]) >= 4
-        # /metrics surfaces batching stats in direct mode
+        # /metrics.json surfaces batching stats in direct mode
         with urllib.request.urlopen(
-                f"http://127.0.0.1:{app.port}/metrics", timeout=10) as r:
+                f"http://127.0.0.1:{app.port}/metrics.json", timeout=10) as r:
             stats = json.loads(r.read())
         assert stats["batching"]["records"] == n_req
         assert stats["batching"]["mean_batch_size"] > 1.0

@@ -1,0 +1,481 @@
+"""Unified telemetry layer (ISSUE 3): metric registry semantics, Prometheus
+exposition + parse, trace spans with cross-process propagation over the
+serving wire, the end-to-end request span tree, the per-step training
+breakdown, and the instrumented satellites (annotate, AOF replay counters,
+breaker state collectors)."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import telemetry as tm
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    tm.reset_telemetry()
+    yield
+    tm.reset_telemetry()
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+
+    model = Sequential([L.Dense(8, activation="relu", input_shape=(8,)),
+                        L.Dense(4, activation="softmax")])
+    model.compile(optimizer="adam", loss="categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+    model.fit(x, y, batch_size=16, nb_epoch=1)
+    return model, x
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    c = tm.counter("zoo_t_basic_total", "t", labels=("k",))
+    c.labels(k="a").inc()
+    c.labels(k="a").inc(2.5)
+    c.labels(k="b").inc()
+    assert c.labels(k="a").value() == 3.5
+    assert c.labels(k="b").value() == 1.0
+    with pytest.raises(tm.TelemetryError):
+        c.labels(k="a").inc(-1)          # counters only go up
+    g = tm.gauge("zoo_t_basic_gauge", "t")
+    g.set(7)
+    g.add(-2)
+    assert g.value() == 5.0
+    h = tm.histogram("zoo_t_basic_seconds", "t", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = h.labels().snapshot()
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(5.555)
+    # cumulative buckets: <=0.01 ->1, <=0.1 ->2, <=1.0 ->3, +Inf ->4
+    assert [n for _le, n in snap["buckets"]] == [1, 2, 3, 4]
+
+
+def test_registry_rejects_kind_and_name_conflicts():
+    tm.counter("zoo_t_conflict_total", "t")
+    with pytest.raises(tm.TelemetryError):
+        tm.gauge("zoo_t_conflict_total", "t")
+    with pytest.raises(tm.TelemetryError):
+        tm.counter("0bad-name", "t")
+    with pytest.raises(tm.TelemetryError):
+        tm.counter("zoo_t_badlabel_total", "t", labels=("le-gal",))
+    # an explicit bucket ladder that disagrees with the existing family must
+    # fail loudly, not silently keep the first registrant's buckets
+    tm.histogram("zoo_t_bucket_seconds", "t", buckets=(1.0, 5.0))
+    with pytest.raises(tm.TelemetryError):
+        tm.histogram("zoo_t_bucket_seconds", "t", buckets=(9.0,))
+    tm.histogram("zoo_t_bucket_seconds", "t")   # unspecified: accepts existing
+
+
+def test_lock_free_shards_merge_across_threads():
+    c = tm.counter("zoo_t_threads_total", "t")
+    h = tm.histogram("zoo_t_threads_seconds", "t")
+
+    def work():
+        for _ in range(5000):
+            c.inc()
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 40000
+    assert h.labels().snapshot()["count"] == 40000
+
+
+def test_prometheus_render_parse_roundtrip():
+    c = tm.counter("zoo_t_render_total", "requests", labels=("code",))
+    c.labels(code="200").inc(3)
+    c.labels(code='50"3\n').inc()          # escaping round-trips
+    tm.histogram("zoo_t_render_seconds", "lat",
+                 labels=("op",)).labels(op="x").observe(0.02)
+    tm.collector("zoo_t_render_coll", "coll",
+                 lambda: [(("a",), 1.5)], labels=("n",))
+    text = tm.render_prometheus()
+    fams = tm.parse_prometheus(text)
+    assert fams["zoo_t_render_total"]["type"] == "counter"
+    samples = {tuple(sorted(l.items())): v
+               for _n, l, v in fams["zoo_t_render_total"]["samples"]}
+    assert samples[(("code", "200"),)] == 3
+    # escaped label values parse back to the ORIGINAL string
+    assert samples[(("code", '50"3\n'),)] == 1
+    hist = fams["zoo_t_render_seconds"]
+    assert hist["type"] == "histogram"
+    names = {n for n, _l, _v in hist["samples"]}
+    assert {"zoo_t_render_seconds_bucket", "zoo_t_render_seconds_sum",
+            "zoo_t_render_seconds_count"} <= names
+    assert fams["zoo_t_render_coll"]["samples"][0][2] == 1.5
+    # malformed exposition must be REJECTED (the bench's validity gate)
+    with pytest.raises(tm.TelemetryError):
+        tm.parse_prometheus("this is not { prometheus")
+
+
+def test_dead_thread_cells_retire_but_keep_totals():
+    """Thread-per-connection servers: a dead thread's shard cell folds into
+    the retired accumulator — totals survive, live-cell count stays bounded."""
+    import gc
+
+    c = tm.counter("zoo_t_retire_total", "t")
+    h = tm.histogram("zoo_t_retire_seconds", "t")
+    for _ in range(20):
+        t = threading.Thread(target=lambda: (c.inc(), h.observe(0.01)))
+        t.start()
+        t.join()
+    gc.collect()
+    assert c.value() == 20
+    assert h.labels().snapshot()["count"] == 20
+    shards = c.labels()._shards
+    assert len(shards.cells()) <= 3     # retired + at most a couple live
+
+
+def test_nan_gauge_does_not_break_the_scrape():
+    g = tm.gauge("zoo_t_nan_gauge", "t")
+    g.set(float("nan"))                 # e.g. a diverged loss mirrored in
+    text = tm.render_prometheus()       # must not raise
+    fams = tm.parse_prometheus(text)
+    (_n, _l, v), = fams["zoo_t_nan_gauge"]["samples"]
+    assert v != v                       # NaN round-trips
+
+
+def test_jsonl_snapshot_export(tmp_path):
+    tm.counter("zoo_t_jsonl_total", "t").inc(4)
+    p = str(tmp_path / "metrics.jsonl")
+    tm.write_jsonl(p)
+    tm.write_jsonl(p)
+    lines = [json.loads(l) for l in open(p)]
+    assert len(lines) == 2
+    assert lines[0]["metrics"]["zoo_t_jsonl_total"]["samples"][""] == 4
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_remote_parent():
+    with tm.span("outer", kind="test") as outer:
+        with tm.span("inner"):
+            pass
+        ctx = outer.wire_context()
+    inner = tm.spans(name="inner")[0]
+    assert inner.trace_id == outer.trace_id
+    assert inner.parent_id == outer.span_id
+    # remote context wins over ambient and missing context is tolerated
+    with tm.span("remote-child", remote=ctx):
+        pass
+    rc = tm.spans(name="remote-child")[0]
+    assert rc.trace_id == outer.trace_id and rc.parent_id == outer.span_id
+    assert tm.TraceContext.from_wire(None) is None
+    assert tm.TraceContext.from_wire({"bogus": 1}) is None
+    # error status + histogram accounting
+    with pytest.raises(RuntimeError):
+        with tm.span("boom"):
+            raise RuntimeError("x")
+    assert tm.spans(name="boom")[0].status == "error"
+    hist = tm.default_registry().histogram(
+        "zoo_span_duration_seconds", labels=("span",)).labels(span="outer")
+    assert hist.snapshot()["count"] == 1
+
+
+def test_record_span_with_explicit_timestamps():
+    with tm.span("root") as root:
+        ctx = root.wire_context()
+    t0 = time.perf_counter()
+    rec = tm.record_span("queue.wait", t0, t0 + 0.25, remote=ctx, worker=3)
+    assert rec.duration_s == pytest.approx(0.25)
+    assert rec.trace_id == root.trace_id and rec.parent_id == root.span_id
+    assert rec.tags["worker"] == 3
+
+
+def test_wire_header_carries_trace_context():
+    from analytics_zoo_tpu.serving.wire import (received_trace_context,
+                                                recv_msg, send_msg)
+
+    a, b = socket.socketpair()
+    try:
+        payload = {"x": np.arange(4, dtype=np.float32)}
+        with tm.span("sender") as sp:
+            send_msg(a, payload)
+        got = recv_msg(b)
+        np.testing.assert_array_equal(got["x"], payload["x"])
+        ctx = received_trace_context()
+        assert ctx == sp.wire_context()
+        # a frame sent OUTSIDE any span carries no context — and the receiver
+        # tolerates that (the old-client story at the frame level)
+        send_msg(a, payload)
+        recv_msg(b)
+        assert received_trace_context() is None
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end serving trace (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serving
+def test_end_to_end_serving_trace(zoo_ctx, fitted):
+    from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                           OutputQueue, ServingConfig,
+                                           start_broker)
+
+    model, x = fitted
+    broker = start_broker()
+    cfg = ServingConfig(batch_size=4, queue_port=broker.port)
+    job = ClusterServing(model, cfg).start()
+    need = {"serving.client.send", "serving.broker.handle",
+            "serving.batch.wait", "serving.engine.dispatch", "serving.fanout"}
+    try:
+        iq = InputQueue(port=broker.port)
+        oq = OutputQueue(port=broker.port)
+        uri = iq.enqueue(None, input=x[0])
+        got = oq.query(uri, timeout_s=30)
+        np.testing.assert_allclose(got, model.predict(x[:1])[0],
+                                   rtol=1e-4, atol=1e-5)
+        send = [s for s in tm.spans(name="serving.client.send")
+                if s.tags.get("uri") == uri][0]
+        # the sink records its fan-out span just after HSET unblocks the
+        # client's query — poll briefly for the full tree
+        deadline = time.time() + 10
+        tree = []
+        while time.time() < deadline:
+            tree = tm.spans(trace_id=send.trace_id)
+            if need <= {s.name for s in tree}:
+                break
+            time.sleep(0.02)
+        names = {s.name for s in tree}
+        assert need <= names, f"incomplete span tree: {sorted(names)}"
+        # ONE trace end to end, and every non-root span parents into it
+        assert {s.trace_id for s in tree} == {send.trace_id}
+        by_id = {s.span_id: s for s in tree}
+        for s in tree:
+            if s.span_id != send.span_id:
+                assert s.parent_id in by_id or s.parent_id == send.span_id
+        iq.close()
+        oq.close()
+    finally:
+        job.stop()
+        broker.shutdown()
+
+
+@pytest.mark.serving
+def test_old_client_without_trace_context_interops(zoo_ctx, fitted):
+    """A payload with NO trace field (an old client's XADD) is served
+    normally — absence of context is tolerated end to end."""
+    from analytics_zoo_tpu.serving import (ClusterServing, OutputQueue,
+                                           ServingConfig, start_broker)
+    from analytics_zoo_tpu.serving.client import INPUT_STREAM, _Conn
+
+    model, x = fitted
+    broker = start_broker()
+    cfg = ServingConfig(batch_size=4, queue_port=broker.port)
+    job = ClusterServing(model, cfg, group="oldwire").start()
+    try:
+        conn = _Conn("127.0.0.1", broker.port)
+        conn.call("XADD", INPUT_STREAM,
+                  {"uri": "legacy-1", "data": {"input": x[0]}})
+        oq = OutputQueue(port=broker.port)
+        got = oq.query("legacy-1", timeout_s=30)
+        np.testing.assert_allclose(got, model.predict(x[:1])[0],
+                                   rtol=1e-4, atol=1e-5)
+        conn.close()
+        oq.close()
+    finally:
+        job.stop()
+        broker.shutdown()
+
+
+@pytest.mark.serving
+def test_http_metrics_prometheus_scrape(zoo_ctx, fitted):
+    from analytics_zoo_tpu.serving import FrontEndApp, ServingConfig
+
+    model, x = fitted
+    app = FrontEndApp(ServingConfig(), port=0, model=model,
+                      max_batch=8, max_delay_ms=2.0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{app.port}/predict",
+            data=json.dumps(
+                {"instances": [{"input": x[0].tolist()}]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read())["predictions"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        fams = tm.parse_prometheus(text)          # raises if malformed
+        spans = fams["zoo_span_duration_seconds"]
+        assert spans["type"] == "histogram"
+        assert any(l.get("span") == "serving.http.predict"
+                   for _n, l, _v in spans["samples"])
+        # one scrape shows the whole system: http + batching + wire counters
+        assert any(l.get("code") == "200" for _n, l, _v
+                   in fams["zoo_http_requests_total"]["samples"])
+        assert fams["zoo_batch_records_total"]["samples"][0][2] >= 1
+        assert "zoo_wire_frames_total" in fams
+    finally:
+        app.stop()
+
+
+# ---------------------------------------------------------------------------
+# broker satellites: AOF replay + shm negotiation counters, `cli info`
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serving
+def test_broker_aof_replay_and_cli_info_counters(tmp_path, capsys):
+    from analytics_zoo_tpu.serving import start_broker
+    from analytics_zoo_tpu.serving.cli import main as cli_main
+    from analytics_zoo_tpu.serving.client import _Conn
+
+    aof = str(tmp_path / "serving.aof")
+    b1 = start_broker(aof_path=aof)
+    c = _Conn("127.0.0.1", b1.port)
+    for i in range(3):
+        c.call("XADD", "s", {"v": i})
+    c.call("HSET", "k", {"x": 1})
+    c.close()
+    b1.shutdown()
+    b1.server_close()
+
+    b2 = start_broker(aof_path=aof)
+    try:
+        c = _Conn("127.0.0.1", b2.port)
+        info = c.call("INFO")
+        c.close()
+        assert info["aof_replayed_records"].get("A") == 3
+        assert info["aof_replayed_records"].get("H") == 1
+        assert "shm_negotiations" in info
+        assert info["commands"]["INFO"] >= 1
+        snap = tm.snapshot()
+        assert snap["zoo_broker_aof_replayed_records_total"]["samples"]["A"] \
+            == 3
+        # `cli info` prints the counters (the operator view)
+        rc = cli_main(["info", "--port", str(b2.port)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        printed = json.loads(out)
+        assert printed["aof_replayed_records"]["A"] == 3
+        assert "shm_negotiations" in printed
+    finally:
+        b2.shutdown()
+        b2.server_close()
+
+
+# ---------------------------------------------------------------------------
+# resilience + profiling + summary re-pointing
+# ---------------------------------------------------------------------------
+
+def test_breaker_and_heartbeat_land_on_the_scrape():
+    from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
+                                                     HealthRegistry)
+
+    br = CircuitBreaker(failure_threshold=2, name="scrape-test",
+                        clock=lambda: 0.0)
+    br.record_failure()
+    br.record_failure()            # opens
+    reg = HealthRegistry(default_timeout_s=60.0)
+    reg.register("scrape.component").beat()
+    fams = tm.parse_prometheus(tm.render_prometheus())
+    states = {l["name"]: v for _n, l, v
+              in fams["zoo_breaker_state"]["samples"]}
+    assert states["scrape-test"] == 2.0          # open
+    opens = {l["name"]: v for _n, l, v
+             in fams["zoo_breaker_opens_total"]["samples"]}
+    assert opens["scrape-test"] == 1
+    alive = {l["component"]: v for _n, l, v
+             in fams["zoo_component_alive"]["samples"]}
+    assert alive["scrape.component"] == 1.0
+    # same-named components in a SECOND registry don't collide on the scrape
+    reg2 = HealthRegistry(default_timeout_s=60.0)
+    reg2.register("scrape.component")          # never beats -> still alive=1
+    fams = tm.parse_prometheus(tm.render_prometheus())
+    rows = [(l["registry"], l["component"]) for _n, l, _v
+            in fams["zoo_component_alive"]["samples"]
+            if l["component"] == "scrape.component"]
+    assert len(rows) == 2 and rows[0][0] != rows[1][0]
+
+
+def test_annotate_accumulates_into_registry():
+    from analytics_zoo_tpu.common.profiling import annotate
+
+    for _ in range(3):
+        with annotate("train.pad"):
+            pass
+    hist = tm.default_registry().histogram(
+        "zoo_span_duration_seconds", labels=("span",)).labels(span="train.pad")
+    assert hist.snapshot()["count"] == 3        # accumulated, not thrown away
+    assert len(tm.spans(name="train.pad")) == 3
+
+
+def test_summary_scalars_mirror_to_registry(tmp_path):
+    from analytics_zoo_tpu.common.summary import TrainSummary
+
+    s = TrainSummary(str(tmp_path), "mirror-app")
+    s.add_scalars(5, {"Loss": 0.25, "Throughput": 1000.0})
+    s.close()
+    snap = tm.snapshot()
+    samples = snap["zoo_summary_scalar"]["samples"]
+    assert samples["mirror-app,train,Loss"] == 0.25
+    assert samples["mirror-app,train,Throughput"] == 1000.0
+
+
+# ---------------------------------------------------------------------------
+# training: per-step data-wait vs. compute split (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_estimator_fit_reports_step_time_breakdown(zoo_ctx, tmp_path):
+    from analytics_zoo_tpu.common.summary import read_scalars
+    from analytics_zoo_tpu.engine.estimator import Estimator
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    model = Sequential([L.Dense(8, activation="relu", input_shape=(6,)),
+                        L.Dense(2, activation="softmax")])
+    est = Estimator(model, optimizer="adam",
+                    loss="sparse_categorical_crossentropy")
+    est.config.cache_on_device = False
+    est.config.log_every_n_steps = 2
+    est.set_tensorboard(str(tmp_path), "split-app")
+    est.fit((x, y), batch_size=16, epochs=2)
+
+    tags = {t for _s, t, _v in read_scalars(est.train_summary.writer.path)}
+    assert {"DataWaitMs", "ComputeMs", "Loss", "Throughput"} <= tags
+    snap = tm.snapshot()
+    steps = snap["zoo_train_steps_total"]["samples"][""]
+    assert steps == 8                       # 64/16 * 2 epochs
+    assert snap["zoo_train_data_wait_seconds"]["samples"][""]["count"] == 8
+    assert snap["zoo_train_compute_seconds"]["samples"][""]["count"] >= 2
+    assert snap["zoo_train_compiles_total"]["samples"][""] == 1
+    assert snap["zoo_data_batches_total"]["samples"][""] >= 8
+    # the same numbers are scrapeable as Prometheus text
+    fams = tm.parse_prometheus(tm.render_prometheus())
+    count = [v for n, _l, v
+             in fams["zoo_train_data_wait_seconds"]["samples"]
+             if n.endswith("_count")]
+    assert count == [8]
+    # a further epoch at a NEW batch size re-traces the jitted step: that is
+    # a second compile event, attributed to compile_*, not ComputeMs
+    est.fit((x, y), batch_size=32, epochs=3)
+    assert tm.snapshot()["zoo_train_compiles_total"]["samples"][""] == 2
