@@ -137,6 +137,24 @@ class TrainConfig:
                                             # host work); single-process only
     scan_block_steps: int = 100             # steps fused per scanned device call in
                                             # cache_on_device mode (trigger granularity)
+    prefetch_depth: int = 2                 # async input pipeline: host batches kept
+                                            # in flight (gather→decode→device_put on
+                                            # a background producer thread feeding a
+                                            # bounded queue); 0 = fully synchronous
+                                            # in-line production (NOTE: stricter than
+                                            # the pre-PR-4 path, which dispatched the
+                                            # next batch's device_put one batch ahead
+                                            # but still ran gather/decode inline on
+                                            # the consumer thread)
+    async_checkpoint: bool = True           # snapshot-then-write for trigger-based
+                                            # mid-epoch saves: the hot loop pays only
+                                            # the device→host snapshot; serialization+
+                                            # fsync+rename run on an at-most-one-in-
+                                            # flight writer thread. Epoch-boundary and
+                                            # SIGTERM-final saves stay durable-
+                                            # synchronous, and the writer is drained
+                                            # at fit() exit and before rollback
+                                            # restores
 
 
 def apply_env_overrides(cfg: Any, prefix: str = _ENV_PREFIX) -> Any:

@@ -1,8 +1,11 @@
-"""Data layer: FeatureSet cache tiers, XShards, image/text pipelines."""
+"""Data layer: FeatureSet cache tiers, async input pipeline, XShards,
+image/text pipelines."""
 
-from .featureset import FeatureSet, MemoryType, device_prefetch
+from .featureset import FeatureSet, MemoryType
 from .image import ImageFeature, ImageSet
+from .pipeline import PrefetchLoader, decode_map, device_prefetch
 from .text import Relation, TextFeature, TextSet
 
-__all__ = ["FeatureSet", "ImageFeature", "ImageSet", "MemoryType", "Relation",
-           "TextFeature", "TextSet", "device_prefetch"]
+__all__ = ["FeatureSet", "ImageFeature", "ImageSet", "MemoryType",
+           "PrefetchLoader", "Relation", "TextFeature", "TextSet",
+           "decode_map", "device_prefetch"]

@@ -41,7 +41,12 @@ _DATA_BATCHES = _tm.counter("zoo_data_batches_total",
                             "Host batches produced by FeatureSet iterators")
 _DATA_GATHER = _tm.histogram("zoo_data_batch_gather_seconds",
                              "Host time to materialize one batch "
-                             "(gather/slice, memmap reads)")
+                             "(gather/slice, memmap reads, AND per-record "
+                             "decode for byte-record tiers)")
+_DATA_DECODE = _tm.histogram("zoo_data_decode_seconds",
+                             "Per-batch record-decode time "
+                             "(BytesFeatureSet.decoder over the gathered "
+                             "records; subset of zoo_data_batch_gather_seconds)")
 
 
 class MemoryType:
@@ -405,33 +410,31 @@ class FeatureSet:
 
     def transform(self, fn) -> "FeatureSet":
         """Apply a preprocessing fn over the whole tree (ImageSet/TextSet transform
-        chain parity — applied eagerly host-side)."""
+        chain parity — applied eagerly host-side).
+
+        The cache tier SURVIVES: a transformed ``DISK_AND_DRAM``/``PMEM`` set
+        re-memmaps the transformed tree into a fresh subdirectory of the
+        original cache dir (same mount), instead of silently coming back as
+        a plain DRAM set.
+        """
+        kw = {}
+        if (self.memory_type.startswith("DISK_AND_DRAM")
+                or self.memory_type == MemoryType.PMEM):
+            kw = dict(memory_type=self.memory_type,
+                      cache_dir=tempfile.mkdtemp(prefix="transform_",
+                                                 dir=self._cache_dir))
         return FeatureSet(fn(self.data), process_index=self.process_index,
                           process_count=self.process_count, seed=self.seed,
-                          host_shard=self.host_shard)
+                          host_shard=self.host_shard, **kw)
 
 
 def device_prefetch(batch_iter: Iterator[ArrayTree], sharding=None, depth: int = 2):
-    """Double-buffer host→device transfer: keep ``depth`` batches in flight.
+    """Legacy alias — absorbed into :mod:`analytics_zoo_tpu.data.pipeline`
+    (the PrefetchLoader runs the ``device_put`` on a producer THREAD instead
+    of buffering futures on the consumer)."""
+    from .pipeline import device_prefetch as _impl
 
-    Replaces the reference's per-executor data locality (data already lives next to
-    compute under Spark); on TPU the equivalent is overlapping the HBM upload of
-    batch N+1 with the step on batch N.
-    """
-    import jax
-
-    def put(b):
-        if sharding is None:
-            return _tree_map(jax.device_put, b)
-        return _tree_map(lambda a: jax.device_put(a, sharding), b)
-
-    buf = []
-    for b in batch_iter:
-        buf.append(put(b))
-        if len(buf) >= depth:
-            yield buf.pop(0)
-    while buf:
-        yield buf.pop(0)
+    return _impl(batch_iter, sharding=sharding, depth=depth)
 
 
 class BytesFeatureSet(FeatureSet):
@@ -441,26 +444,47 @@ class BytesFeatureSet(FeatureSet):
     shuffle, multi-host strided sharding, epoch slicing of the RAW records)
     applies unchanged, and ``batches`` decodes just the gathered records."""
 
-    def __init__(self, records: Sequence[bytes], decoder: Callable, **kw):
+    def __init__(self, records: Sequence[bytes], decoder: Callable,
+                 decode_workers: Optional[int] = None, **kw):
         arr = np.empty(len(records), dtype=object)
         arr[:] = list(records)
         kw.pop("memory_type", None)   # raw-object tier is DRAM by definition
         super().__init__((arr,), **kw)
         self.decoder = decoder
+        # per-record decode parallelism: None = auto (min(8, cpu) or the
+        # ZOO_TPU_DECODE_WORKERS override), 0/1 = in-line. Decoders are
+        # numpy/PIL-heavy and release the GIL, so the shared zoo-decode pool
+        # overlaps records of one batch while keeping output order exact.
+        # CONTRACT: under auto, `decoder` must be thread-safe (a pure
+        # per-record function — the jpeg/np.frombuffer shape). A decoder
+        # that mutates shared state (scratch buffers, a shared tokenizer)
+        # must pass decode_workers=0 to keep the old serial behavior.
+        self.decode_workers = decode_workers
 
-    def batches(self, batch_size: int, *, epoch: int = 0, shuffle: bool = True,
-                drop_remainder: bool = True) -> Iterator[ArrayTree]:
-        for (raw,) in super().batches(batch_size, epoch=epoch, shuffle=shuffle,
-                                      drop_remainder=drop_remainder):
-            rows = [self.decoder(r) for r in raw]
+    def _iter_batches(self, batch_size: int, *, epoch: int = 0,
+                      shuffle: bool = True,
+                      drop_remainder: bool = True) -> Iterator[ArrayTree]:
+        # decode INSIDE the parent's timing wrapper: batches() wraps this
+        # iterator, so per-record decode lands in zoo_data_batch_gather_seconds
+        # (and, itemized, in zoo_data_decode_seconds) instead of vanishing
+        # from the DataWait story
+        from .pipeline import decode_map
+
+        for (raw,) in super()._iter_batches(batch_size, epoch=epoch,
+                                            shuffle=shuffle,
+                                            drop_remainder=drop_remainder):
+            t0 = time.perf_counter()
+            rows = decode_map(self.decoder, raw, self.decode_workers)
             first = rows[0]
             if isinstance(first, dict):
-                yield {k: np.stack([r[k] for r in rows]) for k in first}
+                out = {k: np.stack([r[k] for r in rows]) for k in first}
             elif isinstance(first, (tuple, list)):
-                yield tuple(np.stack([r[i] for r in rows])
+                out = tuple(np.stack([r[i] for r in rows])
                             for i in range(len(first)))
             else:
-                yield (np.stack(rows),)
+                out = (np.stack(rows),)
+            _DATA_DECODE.observe(time.perf_counter() - t0)
+            yield out
 
     def slices(self, num_slices: Optional[int] = None) -> List["FeatureSet"]:
         """Sub-epoch slices of the RAW records — each slice keeps the decoder
@@ -472,6 +496,7 @@ class BytesFeatureSet(FeatureSet):
             sl = slice(i * per, min((i + 1) * per, self._n_total))
             out.append(BytesFeatureSet(
                 list(self.data[0][sl]), self.decoder,
+                decode_workers=self.decode_workers,
                 process_index=self.process_index,
                 process_count=self.process_count,
                 seed=self.seed + 17 * (i + 1), host_shard=self.host_shard))
@@ -481,6 +506,7 @@ class BytesFeatureSet(FeatureSet):
         """Transform the raw record array; the decoder rides along."""
         (arr,) = fn(self.data)
         return BytesFeatureSet(list(arr), self.decoder,
+                               decode_workers=self.decode_workers,
                                process_index=self.process_index,
                                process_count=self.process_count, seed=self.seed,
                                host_shard=self.host_shard)

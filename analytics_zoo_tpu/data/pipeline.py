@@ -1,0 +1,365 @@
+"""Asynchronous input pipeline: background producers feeding a bounded queue.
+
+The streaming train/eval/predict paths used to run the whole host side of a
+step — row gather, ``BytesFeatureSet`` decode, and the host→HBM
+``jax.device_put`` — inline on the consumer thread, between device steps
+(``Estimator._run_epoch``'s old one-batch-lookahead generator).  That put the
+host on the critical path: the per-step DataWaitMs the telemetry layer
+reports *is* that inline work.  The reference system kept data next to
+compute via Spark partition locality; the TPU-native equivalent is this
+module — a producer thread that overlaps gather → decode → ``device_put``
+with the device step, the overlap the TF input pipeline made canonical.
+
+Components:
+
+* :class:`PrefetchLoader` — the async loader.  One producer thread walks the
+  underlying ``FeatureSet.batches`` iterator IN ORDER (so the batch stream is
+  byte-identical to the synchronous path for a given ``(seed, epoch)``),
+  applies an optional ``put_fn`` (the Estimator passes its batch-sharded
+  ``device_put``), and feeds a bounded queue of ``depth`` batches.  ``depth=0``
+  degrades to fully synchronous in-line production (the bench's control arm).
+  Worker exceptions propagate to the consumer; ``close()`` (or the context
+  manager / generator teardown) shuts the producer down promptly even when it
+  is blocked on a full queue.
+* :func:`decode_map` — ordered map over a process-wide pool of daemon
+  ``zoo-decode-*`` threads; ``BytesFeatureSet`` routes per-record decode
+  through it (numpy/PIL-heavy decoders release the GIL, so records of one
+  batch decode in parallel while order stays deterministic).
+* :func:`device_prefetch` — the old ``featureset.device_prefetch`` helper,
+  absorbed as a thin wrapper over :class:`PrefetchLoader`.
+
+Telemetry: ``zoo_data_prefetch_queue_depth`` (scrape-time gauge over live
+loaders), ``zoo_data_prefetch_producer_stall_seconds`` (producer blocked on a
+full queue — consumer is the bottleneck) and
+``zoo_data_prefetch_consumer_wait_seconds`` (consumer blocked on an empty
+queue — the producer-side remainder of the Estimator's DataWaitMs story).
+Chaos site: ``data.prefetch`` fires once per produced batch, on the producer
+thread, so fault drills exercise the cross-thread propagation path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import queue
+import threading
+import time
+import weakref
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from ..common import telemetry as _tm
+from ..common.chaos import chaos_point
+
+_STALL = _tm.histogram(
+    "zoo_data_prefetch_producer_stall_seconds",
+    "Producer time blocked on a full prefetch queue (consumer-bound pipeline)")
+_WAIT = _tm.histogram(
+    "zoo_data_prefetch_consumer_wait_seconds",
+    "Consumer time blocked on an empty prefetch queue (producer-bound "
+    "pipeline)")
+
+# scrape-time queue-depth gauge over every live loader: depth > 0 at scrape
+# means the producer is ahead (healthy); pinned at 0 means the consumer is
+# starving and DataWaitMs is about to show it
+_LIVE_LOADERS: "weakref.WeakSet[PrefetchLoader]" = weakref.WeakSet()
+
+
+def _queue_depth_samples():
+    return [((), float(sum(l.queue_depth() for l in list(_LIVE_LOADERS))))]
+
+
+_tm.collector("zoo_data_prefetch_queue_depth",
+              "Batches currently buffered across live PrefetchLoaders",
+              _queue_depth_samples)
+
+
+_END = object()           # producer sentinel: source exhausted
+
+
+class _WorkerError:
+    """Exception captured on the producer thread, re-raised at the consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class PrefetchLoader:
+    """Bounded-queue async batch loader with a deterministic order contract.
+
+    ``source`` is a FeatureSet (``batches(batch_size, epoch=…, shuffle=…,
+    drop_remainder=…)`` is called lazily on the producer thread) or any
+    iterable of already-built host batches.  ``put_fn`` runs on the producer
+    thread per batch — the place for ``jax.device_put``/batch sharding so the
+    HBM upload of batch N+1 overlaps the device step on batch N.
+
+    Determinism: ONE producer walks the source iterator in order, and decode
+    parallelism (``decode_map``) reassembles records in order, so the yielded
+    stream is byte-identical to iterating the source synchronously.
+
+    Shutdown: ``close()`` is idempotent and safe at any point — epoch end,
+    consumer exception, SIGTERM teardown; a producer blocked on a full queue
+    observes the stop flag within its put timeout and exits. Exceptions from
+    the source iterator, ``put_fn``, or an installed chaos schedule
+    (``data.prefetch``) surface at the consumer's next ``__next__``.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, source, batch_size: Optional[int] = None, *,
+                 epoch: int = 0, shuffle: bool = True,
+                 drop_remainder: bool = True,
+                 put_fn: Optional[Callable[[Any], Any]] = None,
+                 depth: int = 2):
+        self._put = put_fn
+        self.depth = max(0, int(depth))
+        if hasattr(source, "batches"):
+            if batch_size is None:
+                raise TypeError("batch_size is required for FeatureSet sources")
+            self._make_iter = lambda: source.batches(
+                batch_size, epoch=epoch, shuffle=shuffle,
+                drop_remainder=drop_remainder)
+        else:
+            src_iter = iter(source)
+            self._make_iter = lambda: src_iter
+        self._closed = False
+        self._iterated = False
+        if self.depth == 0:        # synchronous control path: no thread
+            self._q = None
+            self._thread = None
+            return
+        self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce, name=f"zoo-prefetch-{next(self._ids)}",
+            daemon=True)
+        _LIVE_LOADERS.add(self)
+        self._thread.start()
+
+    # ------------------------------------------------------------- producer
+    def _produce(self):
+        try:
+            for hb in self._make_iter():
+                if self._stop.is_set():
+                    return
+                chaos_point("data.prefetch")
+                item = self._put(hb) if self._put is not None else hb
+                if not self._enqueue(item):
+                    return
+            self._enqueue(_END)
+        except BaseException as e:  # incl. chaos WorkerKilled (BaseException)
+            self._enqueue(_WorkerError(e))
+
+    def _enqueue(self, item) -> bool:
+        """Stop-aware bounded put; stall time (queue full) is recorded."""
+        try:
+            self._q.put_nowait(item)
+            return True
+        except queue.Full:
+            pass
+        t0 = time.perf_counter()
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                _STALL.observe(time.perf_counter() - t0)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # ------------------------------------------------------------- consumer
+    def __iter__(self) -> Iterator[Any]:
+        # SINGLE-PASS at every depth (the producer thread walks the source
+        # once): construct a fresh loader per epoch, like the train loop does
+        if self._iterated:
+            raise RuntimeError(
+                "PrefetchLoader is single-pass; construct a new loader per "
+                "epoch instead of re-iterating this one")
+        self._iterated = True
+        if self._q is None:        # depth=0: produce in-line, same contract
+            for hb in self._make_iter():
+                chaos_point("data.prefetch")
+                yield self._put(hb) if self._put is not None else hb
+            return
+        while True:
+            t0 = time.perf_counter()
+            while True:
+                try:
+                    item = self._q.get(timeout=0.5)
+                    break
+                except queue.Empty:
+                    if self._closed:
+                        return
+                    if not self._thread.is_alive():
+                        # the producer may have enqueued its final item and
+                        # exited between our timeout and this check
+                        try:
+                            item = self._q.get_nowait()
+                            break
+                        except queue.Empty:
+                            raise RuntimeError(
+                                "prefetch producer died without a result "
+                                "(thread %s)" % self._thread.name) from None
+            _WAIT.observe(time.perf_counter() - t0)
+            if item is _END:
+                return
+            if isinstance(item, _WorkerError):
+                raise item.exc
+            yield item
+
+    # ------------------------------------------------------------ lifecycle
+    def queue_depth(self) -> int:
+        return self._q.qsize() if self._q is not None else 0
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Idempotent teardown: stop the producer, drain the queue so a
+        blocked put wakes up, and join the thread."""
+        self._closed = True
+        if self._q is None:
+            return
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+    def __enter__(self) -> "PrefetchLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # safety net; the owning loop closes explicitly
+        try:
+            self.close(timeout=0.0)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# shared ordered decode pool (BytesFeatureSet per-record decode)
+# ---------------------------------------------------------------------------
+
+class _OrderedThreadPool:
+    """Minimal shared thread pool whose ``map`` preserves input order.
+
+    Deliberately NOT ``concurrent.futures.ThreadPoolExecutor``: its workers
+    are non-daemon and would trip the session-end rogue-thread report in
+    tests/conftest.py. These workers are daemon threads named ``zoo-decode-N``
+    and live for the process (like BLAS pools) — they hold no state between
+    calls.
+    """
+
+    def __init__(self, name: str = "zoo-decode"):
+        self._name = name
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._threads: list = []
+        self._lock = threading.Lock()
+
+    def ensure_workers(self, n: int) -> None:
+        with self._lock:
+            while len(self._threads) < n:
+                t = threading.Thread(
+                    target=self._worker,
+                    name=f"{self._name}-{len(self._threads)}", daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    def _worker(self):
+        while True:
+            fn, arg, i, results, state, cond = self._q.get()
+            try:
+                results[i] = fn(arg)
+                exc = None
+            except BaseException as e:  # re-raised in map(); worker survives
+                exc = e
+            with cond:
+                if exc is not None and state["exc"] is None:
+                    state["exc"] = exc
+                state["left"] -= 1
+                if not state["left"]:
+                    cond.notify_all()
+
+    def map(self, fn: Callable, items) -> list:
+        n = len(items)
+        results = [None] * n
+        state = {"left": n, "exc": None}
+        cond = threading.Condition()
+        for i in range(n):
+            self._q.put((fn, items[i], i, results, state, cond))
+        with cond:
+            while state["left"]:
+                cond.wait()
+        if state["exc"] is not None:
+            raise state["exc"]
+        return results
+
+
+_DECODE_POOL = _OrderedThreadPool()
+
+
+def default_decode_workers() -> int:
+    """``ZOO_TPU_DECODE_WORKERS`` env override, else ``min(8, cpu_count)``."""
+    env = os.environ.get("ZOO_TPU_DECODE_WORKERS")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    return min(8, os.cpu_count() or 1)
+
+
+def decode_map(fn: Callable, items, workers: Optional[int] = None) -> list:
+    """Ordered parallel map for per-record decoders.
+
+    ``workers=None`` → :func:`default_decode_workers`; ``0``/``1`` (or a
+    tiny batch) decodes in-line. Results always come back in input order, and
+    the first decoder exception re-raises at the caller.
+
+    The cap is enforced per CALL even though the pool is shared: the batch
+    is split into at most ``workers`` contiguous chunk-tasks, so a caller
+    asking for 2-way decode gets 2-way decode even when another featureset
+    grew the pool to 8 threads.
+    """
+    n_workers = default_decode_workers() if workers is None else max(0, workers)
+    if n_workers <= 1 or len(items) < 4:
+        return [fn(x) for x in items]
+    _DECODE_POOL.ensure_workers(n_workers)
+    n = len(items)
+    n_chunks = min(n_workers, n)
+    bounds = [(i * n) // n_chunks for i in range(n_chunks + 1)]
+
+    def run_chunk(span):
+        lo, hi = span
+        return [fn(items[i]) for i in range(lo, hi)]
+
+    chunks = _DECODE_POOL.map(run_chunk, list(zip(bounds, bounds[1:])))
+    return [r for chunk in chunks for r in chunk]
+
+
+# ---------------------------------------------------------------------------
+# legacy helper, absorbed (was data/featureset.py::device_prefetch)
+# ---------------------------------------------------------------------------
+
+def device_prefetch(batch_iter: Iterable, sharding=None, depth: int = 2):
+    """Double-buffer host→device transfer (legacy API, now a thin wrapper
+    over :class:`PrefetchLoader`): keep ``depth`` batches in flight, with the
+    ``device_put`` running on the producer thread instead of the consumer."""
+    import jax
+
+    def put(b):
+        from .featureset import _tree_map
+
+        if sharding is None:
+            return _tree_map(jax.device_put, b)
+        return _tree_map(lambda a: jax.device_put(a, sharding), b)
+
+    loader = PrefetchLoader(batch_iter, put_fn=put, depth=depth)
+    try:
+        yield from loader
+    finally:
+        loader.close()

@@ -1,6 +1,7 @@
 """Training engine: Estimator, checkpointing, GAN."""
 
-from .checkpoint import latest_checkpoint, load_checkpoint, save_checkpoint
+from .checkpoint import (CheckpointWriter, latest_checkpoint,
+                         load_checkpoint, save_checkpoint, snapshot_state)
 from .estimator import Estimator
 from .gan import GANEstimator
 
@@ -10,5 +11,6 @@ from .gan import GANEstimator
 # kept for API parity.
 LocalEstimator = Estimator
 
-__all__ = ["Estimator", "GANEstimator", "LocalEstimator", "latest_checkpoint",
-           "load_checkpoint", "save_checkpoint"]
+__all__ = ["CheckpointWriter", "Estimator", "GANEstimator", "LocalEstimator",
+           "latest_checkpoint", "load_checkpoint", "save_checkpoint",
+           "snapshot_state"]

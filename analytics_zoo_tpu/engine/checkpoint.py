@@ -1,4 +1,4 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume, with off-hot-path (snapshot-then-write) saving.
 
 Parity: the reference snapshots model + per-submodule OptimMethod into timestamped
 dirs at epoch/iteration triggers (KerasNet.setCheckpoint Topology.scala:248-258,
@@ -10,6 +10,16 @@ Format: one ``checkpoint_<iteration>`` directory per snapshot holding
 numpy — no framework dependency — and layout-stable for multi-host: every host
 saves only on process 0 unless ``all_hosts`` (sharded leaves land via
 ``jax.experimental.multihost_utils`` in later rounds).
+
+Async mode (:class:`CheckpointWriter`): the training loop pays ONLY the
+device→host snapshot (``zoo_train_checkpoint_snapshot_seconds``); the
+serialization + fsync + atomic rename run on an at-most-one-in-flight
+``zoo-ckpt-write`` thread (``zoo_train_checkpoint_write_seconds``).  Writes
+publish by atomic rename of a ``*.tmp`` staging dir, and ``latest_checkpoint``
+only matches completed ``checkpoint_<n>`` names — so a kill mid-write can
+never surface a half-written snapshot; the most recent DURABLE checkpoint
+always wins.  Callers that must observe a durable state (fit() exit, the
+SIGTERM path, rollback-retry restores) drain the writer first.
 """
 
 from __future__ import annotations
@@ -18,13 +28,27 @@ import json
 import os
 import re
 import shutil
+import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from ..common import telemetry as _tm
+from ..common.chaos import chaos_point
+
 _CKPT_RE = re.compile(r"^checkpoint_(\d+)$")
+
+_SNAPSHOT_TIME = _tm.histogram(
+    "zoo_train_checkpoint_snapshot_seconds",
+    "Device→host state-snapshot time — the only checkpoint cost the hot "
+    "loop pays in async mode")
+_WRITE_TIME = _tm.histogram(
+    "zoo_train_checkpoint_write_seconds",
+    "Checkpoint serialization + fsync + atomic-rename time (background "
+    "zoo-ckpt-write thread in async mode)",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30))
 
 
 def _flatten_with_paths(tree):
@@ -32,17 +56,100 @@ def _flatten_with_paths(tree):
     return leaves, treedef
 
 
-def save_checkpoint(directory: str, state: Any, *, iteration: int, epoch: int,
-                    extra: Optional[Dict] = None, keep: int = 5) -> str:
-    """Snapshot ``state`` (any pytree of arrays) under ``directory``."""
-    os.makedirs(directory, exist_ok=True)
-    path = os.path.join(directory, f"checkpoint_{iteration}")
+def snapshot_state(state: Any) -> List[np.ndarray]:
+    """Materialize every leaf as an independent HOST copy.
+
+    Independence matters for async saves: the train loop donates/overwrites
+    its state buffers on the very next step, so the writer thread must never
+    alias them. ``device_get`` already copies device arrays; host-numpy
+    leaves (which it passes through) are copied explicitly.
+    """
+    t0 = time.perf_counter()
+    leaves, _ = _flatten_with_paths(state)
+    host: List[np.ndarray] = []
+    for l in leaves:
+        h = np.asarray(jax.device_get(l))
+        # force a true copy whenever the result aliases anything: device_get
+        # passes host-numpy leaves through (h is l), and on the CPU backend
+        # it returns a ZERO-COPY view of the live XLA buffer (h.base is a
+        # PyCapsule) — which the next donated step would overwrite under the
+        # writer thread
+        if h is l or h.base is not None or not h.flags["OWNDATA"]:
+            h = h.copy()
+        host.append(h)
+    _SNAPSHOT_TIME.observe(time.perf_counter() - t0)
+    return host
+
+
+def _fsync(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # e.g. directories on filesystems that don't support it
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_snapshot(directory: str, host_leaves: List[np.ndarray],
+                    meta: Dict, keep: int) -> str:
+    """Durable publication: stage under ``*.tmp``, fsync, atomic rename."""
+    path = os.path.join(directory, f"checkpoint_{meta['iteration']}")
     tmp = path + ".tmp"
-    os.makedirs(tmp, exist_ok=True)
-    leaves, treedef = _flatten_with_paths(state)
-    host_leaves = [np.asarray(jax.device_get(l)) for l in leaves]
-    np.savez(os.path.join(tmp, "state.npz"),
-             **{f"leaf_{i}": l for i, l in enumerate(host_leaves)})
+    t0 = time.perf_counter()
+    try:
+        os.makedirs(tmp, exist_ok=True)
+        np.savez(os.path.join(tmp, "state.npz"),
+                 **{f"leaf_{i}": l for i, l in enumerate(host_leaves)})
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync(os.path.join(tmp, "state.npz"))
+        # deterministic kill site BETWEEN serialization and publication: the
+        # chaos drill killing a writer here must leave only complete,
+        # durable checkpoints discoverable
+        chaos_point("ckpt.write")
+        # the staging dir's own entries must be durable BEFORE the rename
+        # publishes it, or a crash could surface checkpoint_<n> with a
+        # missing/truncated state.npz
+        _fsync(tmp)
+        # re-saving an existing iteration (rollback re-runs, epoch-boundary
+        # overwrite of a trigger save): move the old durable dir ASIDE
+        # instead of deleting it, so no kill window exists in which neither
+        # version is recoverable; .old never matches latest_checkpoint
+        old = None
+        if os.path.exists(path):
+            old = path + ".old"
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(path, old)
+        os.rename(tmp, path)
+        _fsync(directory)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+    except BaseException:    # incl. chaos WorkerKilled: never leave a .tmp
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    finally:
+        _WRITE_TIME.observe(time.perf_counter() - t0)
+    _gc(directory, keep)
+    return path
+
+
+def save_checkpoint(directory: str, state: Any, *, iteration: int, epoch: int,
+                    extra: Optional[Dict] = None, keep: int = 5,
+                    writer: Optional["CheckpointWriter"] = None) -> str:
+    """Snapshot ``state`` (any pytree of arrays) under ``directory``.
+
+    With ``writer`` the call returns after the device→host snapshot; the
+    write itself happens on the writer's background thread (drain the writer
+    before depending on the file). Without it, the write is synchronous.
+    """
+    os.makedirs(directory, exist_ok=True)
+    host_leaves = snapshot_state(state)
     meta = {
         "iteration": iteration,
         "epoch": epoch,
@@ -50,25 +157,75 @@ def save_checkpoint(directory: str, state: Any, *, iteration: int, epoch: int,
         "n_leaves": len(host_leaves),
         "extra": extra or {},
     }
-    with open(os.path.join(tmp, "meta.json"), "w") as f:
-        json.dump(meta, f)
-    if os.path.exists(path):
-        shutil.rmtree(path)
-    os.rename(tmp, path)
-    _gc(directory, keep)
-    return path
+    if writer is not None:
+        return writer.submit(directory, host_leaves, meta, keep)
+    return _write_snapshot(directory, host_leaves, meta, keep)
+
+
+class CheckpointWriter:
+    """At-most-one-in-flight background checkpoint writer.
+
+    ``submit`` first drains the previous write (re-raising its failure — a
+    lost checkpoint must not stay silent), then hands the already-snapshotted
+    host leaves to a fresh daemon ``zoo-ckpt-write`` thread. ``drain`` blocks
+    until the in-flight write is durable. Not a thread pool on purpose: one
+    writer at a time means two saves can never interleave on the same
+    directory, and the newest snapshot is always the last published.
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self._path: Optional[str] = None
+
+    def submit(self, directory: str, host_leaves: List[np.ndarray],
+               meta: Dict, keep: int) -> str:
+        self.drain()
+
+        def run():
+            try:
+                self._path = _write_snapshot(directory, host_leaves, meta, keep)
+            except BaseException as e:   # surfaced at the next drain/submit
+                self._exc = e
+
+        self._thread = threading.Thread(target=run, name="zoo-ckpt-write",
+                                        daemon=True)
+        self._thread.start()
+        return os.path.join(directory, f"checkpoint_{meta['iteration']}")
+
+    def in_flight(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def drain(self) -> Optional[str]:
+        """Block until pending work is durable; re-raise a failed write."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._exc is not None:
+            e, self._exc = self._exc, None
+            raise e
+        return self._path
+
+    close = drain
 
 
 def _gc(directory: str, keep: int) -> None:
+    names = os.listdir(directory)
     ckpts = sorted(
-        (int(m.group(1)), name) for name in os.listdir(directory)
+        (int(m.group(1)), name) for name in names
         if (m := _CKPT_RE.match(name)))
     for _, name in ckpts[:-keep]:
         shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
+    for name in names:        # .old dirs stranded by a crash mid-replace
+        if name.endswith(".old") and _CKPT_RE.match(name[:-4]):
+            shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
 
 
 def latest_checkpoint(directory: str) -> Optional[str]:
-    """Newest snapshot path (getLatestFile parity, Topology.scala:1522-1539)."""
+    """Newest COMPLETE snapshot path (getLatestFile parity,
+    Topology.scala:1522-1539). ``*.tmp`` staging dirs never match."""
     if not os.path.isdir(directory):
         return None
     best = None

@@ -38,6 +38,7 @@ from ..common.summary import TrainSummary, ValidationSummary
 from ..common.triggers import (EveryEpoch, MaxEpoch, SeveralIteration, Trigger,
                                TrainerState)
 from ..data.featureset import FeatureSet
+from ..data.pipeline import PrefetchLoader
 from ..nn.losses import get_loss
 from ..nn.metrics import Metric, get_metric
 from ..nn.module import Layer
@@ -151,6 +152,9 @@ class Estimator:
         # set True when initial_weights holds only SOME layers' params
         # (transfer learning) — missing slots then keep a fresh init
         self.initial_weights_partial = False
+        # at-most-one-in-flight async checkpoint writer (created lazily on
+        # the first save when config.async_checkpoint)
+        self._ckpt_writer: Optional[ckpt.CheckpointWriter] = None
 
     def set_gradient_clipping(self, clip_norm: Optional[float] = None,
                               clip_value: Optional[tuple] = None) -> "Estimator":
@@ -369,6 +373,12 @@ class Estimator:
                 except Exception as e:  # retry-from-checkpoint
                     if not cfg.checkpoint_dir:
                         raise
+                    # a rollback must never pick a checkpoint whose write is
+                    # still in flight (half-written / about to be replaced by
+                    # the newer snapshot): drain the async writer first. A
+                    # FAILED in-flight write is logged and forfeited — the
+                    # rollback falls back to the last durable snapshot.
+                    self._drain_checkpoints(raise_errors=False)
                     latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
                     if latest is None:
                         raise
@@ -402,6 +412,10 @@ class Estimator:
                                                      results)
                     logger.info("epoch %d validation: %s",
                                 self.trainer_state.epoch, results)
+            # training finished: block once on the async writer so fit()
+            # returning implies the newest checkpoint is DURABLE (and a
+            # failed write surfaces here instead of dying silently)
+            self._drain_checkpoints()
         except _GracefulStop:
             # SIGTERM: persist one final checkpoint so the replacement run
             # resumes exactly here, then exit 143 (128+SIGTERM) — the
@@ -409,13 +423,23 @@ class Estimator:
             jax.block_until_ready(self.train_state)
             _SIGTERM_EXITS.inc()
             if cfg.checkpoint_dir:
-                self._save(cfg.checkpoint_dir)
+                # durable save: the supervisor's replacement run must find
+                # this final snapshot on disk the moment exit(143) is seen.
+                # A previously failed async write must not abort it — exit
+                # 143 with the freshest possible snapshot beats a traceback.
+                self._save(cfg.checkpoint_dir, durable=True,
+                           raise_drain_errors=False)
                 logger.warning("SIGTERM: final checkpoint saved at iter %d; "
                                "exiting", self.trainer_state.iteration)
             raise SystemExit(143)
         finally:
             if handler_installed:
                 signal.signal(signal.SIGTERM, prev_handler)
+            # thread hygiene on ANY exit: an in-flight write never outlives
+            # fit(). During an exceptional unwind errors are logged, not
+            # raised (the original failure must not be masked); the normal
+            # path already drained with raise_errors=True above.
+            self._drain_checkpoints(raise_errors=False)
         # fit() returning means training FINISHED: epochs only dispatch work
         # (epoch-final losses stay lazy device scalars — one host transfer per
         # epoch would cost a full network RTT on remote-chip topologies), so
@@ -447,84 +471,87 @@ class Estimator:
                                           checkpoint_trigger)
         ts = self.trainer_state
         epoch = ts.epoch
-        sharding = self._batch_sharding()
         t0 = time.perf_counter()
         seen = 0
         loss = None
 
-        def prefetched():
-            # one-batch lookahead: overlap host gather + HBM upload of batch N+1
-            # with the device step on batch N (device_prefetch pattern)
-            buf = []
-            for hb in train_set.batches(batch_size, epoch=epoch,
-                                        shuffle=self.config.shuffle):
-                buf.append(self._to_global(hb))
-                if len(buf) >= 2:
-                    yield buf.pop(0)
-            while buf:
-                yield buf.pop(0)
-
+        # async input pipeline: gather → decode → sharded device_put run on a
+        # background producer feeding a bounded queue (depth =
+        # config.prefetch_depth; 0 = synchronous in-line production),
+        # so the host work of batch N+1 overlaps the device step on batch N.
+        # Batch ORDER is byte-identical to the sync path per (seed, epoch).
+        loader = PrefetchLoader(train_set, batch_size, epoch=epoch,
+                                shuffle=self.config.shuffle,
+                                put_fn=self._to_global,
+                                depth=self.config.prefetch_depth)
         # per-step breakdown window: data-wait accumulates per batch; compute
         # is the window remainder, synced by the float(loss) transfer at each
         # log point so dispatched-but-unfinished device work can't hide
-        it = prefetched()
+        it = iter(loader)
         win_t0 = t0
         win_steps = 0
         win_data_wait = 0.0
         epoch_data_wait = 0.0
         epoch_compile = 0.0
-        while True:
-            td = time.perf_counter()
-            try:
-                global_batch = next(it)
-            except StopIteration:
-                break
-            dw = time.perf_counter() - td
-            win_data_wait += dw
-            epoch_data_wait += dw
-            _DATA_WAIT.observe(dw)
-            self._check_interrupt()
-            chaos_point("estimator.step")
-            key = self._batch_signature(global_batch)
-            t_step = time.perf_counter()
-            self.train_state, loss = self._train_step(self.train_state, global_batch)
-            if key not in self._step_shapes:
-                # first dispatch of this shape = compile event: sync so its
-                # cost is attributed to compilation, not smeared over the
-                # window — which requires restarting the window clock here,
-                # and excluding the cost from the epoch epilogue's ComputeMs
-                jax.block_until_ready(loss)
-                self._step_shapes.add(key)
-                _COMPILES.inc()
-                compile_s = time.perf_counter() - t_step
-                _COMPILE_TIME.observe(compile_s)
-                epoch_compile += compile_s
-                win_t0 += compile_s
-            _STEPS.inc()
-            win_steps += 1
-            ts.iteration += 1
-            seen += batch_size
-            if ts.iteration % cfg.log_every_n_steps == 0:
-                loss_val = float(loss)
-                ts.last_loss = loss_val
-                now = time.perf_counter()
-                throughput = seen / max(now - t0, 1e-9)
-                data_ms = win_data_wait / win_steps * 1e3
-                compute_ms = max(0.0, (now - win_t0 - win_data_wait)
-                                 / win_steps) * 1e3
-                _COMPUTE.observe(compute_ms / 1e3)
-                if self.train_summary:
-                    self.train_summary.add_scalars(ts.iteration, {
-                        "Loss": loss_val, "Throughput": throughput,
-                        "DataWaitMs": data_ms, "ComputeMs": compute_ms})
-                logger.info("epoch %d iter %d loss %.4f throughput %.1f rec/s"
-                            " (data %.2fms compute %.2fms /step)",
-                            epoch, ts.iteration, loss_val, throughput,
-                            data_ms, compute_ms)
-                win_t0, win_steps, win_data_wait = now, 0, 0.0
-            if (checkpoint_trigger is not None and checkpoint_trigger(ts)
-                    and cfg.checkpoint_dir):
-                self._save(cfg.checkpoint_dir)
+        try:
+            while True:
+                td = time.perf_counter()
+                try:
+                    global_batch = next(it)
+                except StopIteration:
+                    break
+                dw = time.perf_counter() - td
+                win_data_wait += dw
+                epoch_data_wait += dw
+                _DATA_WAIT.observe(dw)
+                self._check_interrupt()
+                chaos_point("estimator.step")
+                key = self._batch_signature(global_batch)
+                t_step = time.perf_counter()
+                self.train_state, loss = self._train_step(self.train_state,
+                                                          global_batch)
+                if key not in self._step_shapes:
+                    # first dispatch of this shape = compile event: sync so
+                    # its cost is attributed to compilation, not smeared over
+                    # the window — which requires restarting the window clock
+                    # here, and excluding the cost from the epoch epilogue's
+                    # ComputeMs
+                    jax.block_until_ready(loss)
+                    self._step_shapes.add(key)
+                    _COMPILES.inc()
+                    compile_s = time.perf_counter() - t_step
+                    _COMPILE_TIME.observe(compile_s)
+                    epoch_compile += compile_s
+                    win_t0 += compile_s
+                _STEPS.inc()
+                win_steps += 1
+                ts.iteration += 1
+                seen += batch_size
+                if ts.iteration % cfg.log_every_n_steps == 0:
+                    loss_val = float(loss)
+                    ts.last_loss = loss_val
+                    now = time.perf_counter()
+                    throughput = seen / max(now - t0, 1e-9)
+                    data_ms = win_data_wait / win_steps * 1e3
+                    compute_ms = max(0.0, (now - win_t0 - win_data_wait)
+                                     / win_steps) * 1e3
+                    _COMPUTE.observe(compute_ms / 1e3)
+                    if self.train_summary:
+                        self.train_summary.add_scalars(ts.iteration, {
+                            "Loss": loss_val, "Throughput": throughput,
+                            "DataWaitMs": data_ms, "ComputeMs": compute_ms})
+                    logger.info("epoch %d iter %d loss %.4f throughput %.1f "
+                                "rec/s (data %.2fms compute %.2fms /step)",
+                                epoch, ts.iteration, loss_val, throughput,
+                                data_ms, compute_ms)
+                    win_t0, win_steps, win_data_wait = now, 0, 0.0
+                if (checkpoint_trigger is not None and checkpoint_trigger(ts)
+                        and cfg.checkpoint_dir):
+                    self._save(cfg.checkpoint_dir)
+        finally:
+            # epoch end, step exception, or SIGTERM unwind: the producer
+            # thread must not outlive the epoch
+            loader.close()
         self._finish_epoch(t0, seen, loss, batch_size,
                            data_wait_s=epoch_data_wait,
                            compile_s=epoch_compile)
@@ -556,7 +583,10 @@ class Estimator:
         ts.epoch += 1
         ts.records_processed += seen
         if cfg.checkpoint_dir:
-            self._save(cfg.checkpoint_dir)
+            # epoch boundary = durability barrier: the save is synchronous
+            # (and drains any in-flight mid-epoch write), so a hard kill in
+            # epoch N+1 can never lose epoch N's completion
+            self._save(cfg.checkpoint_dir, durable=True)
         if self.train_summary:
             self.train_summary.flush()
 
@@ -703,12 +733,48 @@ class Estimator:
                     > (ts.iteration - block) // trigger.interval)
         return trigger(ts)
 
-    def _save(self, directory: str):
+    def _save(self, directory: str, durable: bool = False,
+              raise_drain_errors: bool = True):
+        """``durable=False`` (trigger-based mid-epoch saves — the hot-path
+        cost async checkpointing removes): snapshot-then-write; this returns
+        after the device→host snapshot and the serialization/fsync/rename run
+        on the writer thread (at most one in flight — submit drains the
+        previous write first). ``durable=True`` (epoch boundaries, SIGTERM
+        finals): drain any in-flight write, then write synchronously — the
+        caller's contract is "this state is on disk when I return", which a
+        hard kill right after the save must not be able to violate.
+        ``raise_drain_errors=False``: a previously FAILED async write is
+        logged and forfeited instead of aborting this save (the SIGTERM
+        path, where writing the final snapshot beats error propagation)."""
         if get_zoo_context().process_index == 0:
             _CHECKPOINTS.inc()
+            writer = None
+            if self.config.async_checkpoint and not durable:
+                if self._ckpt_writer is None:
+                    self._ckpt_writer = ckpt.CheckpointWriter()
+                writer = self._ckpt_writer
+            else:
+                self._drain_checkpoints(raise_errors=raise_drain_errors)
             ckpt.save_checkpoint(directory, self.train_state,
                                  iteration=self.trainer_state.iteration,
-                                 epoch=self.trainer_state.epoch)
+                                 epoch=self.trainer_state.epoch,
+                                 writer=writer)
+
+    def _drain_checkpoints(self, raise_errors: bool = True):
+        """Block until the in-flight async checkpoint write (if any) is
+        durable. With ``raise_errors=False`` a failed write is logged and
+        forfeited (teardown/rollback paths that must not mask the original
+        failure)."""
+        w = self._ckpt_writer
+        if w is None:
+            return
+        try:
+            w.drain()
+        except BaseException:
+            if raise_errors:
+                raise
+            logger.exception("async checkpoint write failed; continuing "
+                             "with the last durable snapshot")
 
     # ---------------------------------------------------------------- evaluate
     def evaluate(self, data, batch_size: int = 256,
@@ -738,11 +804,19 @@ class Estimator:
             self._eval_cache[key] = jax.jit(eval_step)
         eval_step = self._eval_cache[key]
         accs = [m.init() for m in metric_objs]
-        for host_batch in eval_set.batches(batch_size, shuffle=False,
-                                           drop_remainder=False):
-            accs = eval_step(self.train_state["params"],
-                             self.train_state["model_state"],
-                             accs, self._to_global(host_batch))
+        # same async loader as the train path: gather/decode + device upload
+        # of batch N+1 overlap the eval step on batch N, and every host batch
+        # is produced (and counted) through the one FeatureSet iterator
+        loader = PrefetchLoader(eval_set, batch_size, epoch=0, shuffle=False,
+                                drop_remainder=False, put_fn=self._to_global,
+                                depth=self.config.prefetch_depth)
+        try:
+            for global_batch in loader:
+                accs = eval_step(self.train_state["params"],
+                                 self.train_state["model_state"],
+                                 accs, global_batch)
+        finally:
+            loader.close()
         return {m.name: m.result(a) for m, a in zip(metric_objs, accs)}
 
     # ----------------------------------------------------------------- predict
@@ -758,11 +832,19 @@ class Estimator:
             xb = first[0] if len(first) == 1 else list(first)
             self.train_state = self._init_state((xb, None))
         outs = []
-        for host_batch in fs.batches(batch_size, shuffle=False, drop_remainder=False):
-            xb = host_batch[0] if len(host_batch) == 1 else list(host_batch)
-            y = self._predict_step(self.train_state["params"],
-                                   self.train_state["model_state"], xb)
-            outs.append(jax.device_get(y))
+        # prefetch host-side production (gather/decode); the jit dispatch
+        # handles the transfer, so put_fn stays None here
+        loader = PrefetchLoader(fs, batch_size, epoch=0, shuffle=False,
+                                drop_remainder=False,
+                                depth=self.config.prefetch_depth)
+        try:
+            for host_batch in loader:
+                xb = host_batch[0] if len(host_batch) == 1 else list(host_batch)
+                y = self._predict_step(self.train_state["params"],
+                                       self.train_state["model_state"], xb)
+                outs.append(jax.device_get(y))
+        finally:
+            loader.close()
         if isinstance(outs[0], (tuple, list)):
             # multi-output model (functional Model with several outputs):
             # concatenate each output head across batches
@@ -858,11 +940,15 @@ class Estimator:
 
     def save(self, directory: str):
         assert self.train_state is not None
+        # public save is SYNCHRONOUS: callers expect a durable file on
+        # return; drain first so it can't interleave with an async write
+        self._drain_checkpoints()
         return ckpt.save_checkpoint(directory, self.train_state,
                                     iteration=self.trainer_state.iteration,
                                     epoch=self.trainer_state.epoch)
 
     def load(self, directory: str, sample_batch=None):
+        self._drain_checkpoints()
         if self.train_state is None:
             assert sample_batch is not None, "need sample_batch to build state"
             self.train_state = self._init_state(sample_batch)

@@ -148,19 +148,35 @@ class Estimator:
             feature_cols: Optional[List[str]] = None,
             label_cols: Optional[List[str]] = None,
             validation_data=None,
-            host_sharding: Optional[bool] = None) -> "Estimator":
+            host_sharding: Optional[bool] = None,
+            prefetch_depth: Optional[int] = None,
+            async_checkpoint: Optional[bool] = None) -> "Estimator":
         """``host_sharding`` (default auto: on under a multi-host job): XShards
         input is split by partition across hosts and each host marshals ONLY
         its own slice into a ``FeatureSet.from_host_shard`` — the multi-host
-        sharded-ingest path; no host materializes the global dataset."""
+        sharded-ingest path; no host materializes the global dataset.
+
+        ``prefetch_depth`` / ``async_checkpoint`` override the engine
+        Estimator's input-pipeline and checkpointing knobs for THIS fit only
+        (``prefetch_depth=0`` forces the synchronous data path); the prior
+        config values are restored on return."""
         self._ensure_compiled()
+        cfg = self.model.estimator.config
+        saved = (cfg.prefetch_depth, cfg.async_checkpoint)
+        if prefetch_depth is not None:
+            cfg.prefetch_depth = int(prefetch_depth)
+        if async_checkpoint is not None:
+            cfg.async_checkpoint = bool(async_checkpoint)
         _ORCA_FITS.labels(input=type(data).__name__).inc()
         # the fit span shows up in xprof captures and the span recorder; the
         # per-step DataWait/Compute breakdown comes from the engine Estimator
         # underneath (model.fit) and is read back via train_stats()
-        with _tm.span("orca.fit"):
-            return self._fit(data, epochs, batch_size, feature_cols,
-                             label_cols, validation_data, host_sharding)
+        try:
+            with _tm.span("orca.fit"):
+                return self._fit(data, epochs, batch_size, feature_cols,
+                                 label_cols, validation_data, host_sharding)
+        finally:
+            cfg.prefetch_depth, cfg.async_checkpoint = saved
 
     def _fit(self, data, epochs, batch_size, feature_cols, label_cols,
              validation_data, host_sharding) -> "Estimator":
@@ -197,8 +213,9 @@ class Estimator:
 
     def train_stats(self) -> Dict[str, Any]:
         """The training-side telemetry snapshot (per-step data-wait vs.
-        compute histograms, compile/rollback/checkpoint counters) — the same
-        numbers the Prometheus endpoint and TensorBoard scalars show."""
+        compute histograms, input-pipeline queue/stall/decode metrics,
+        compile/rollback counters, checkpoint snapshot-vs-write split) — the
+        same numbers the Prometheus endpoint and TensorBoard scalars show."""
         snap = _tm.snapshot()
         return {k: v for k, v in snap.items() if k.startswith("zoo_train_")
                 or k.startswith("zoo_data_") or k == "zoo_summary_scalar"}

@@ -473,6 +473,141 @@ def run_transformer_mfu(seq_len: int = 2048, batch: Optional[int] = None,
                 os.environ[k] = v
 
 
+def run_data_pipeline(platform: str | None = None, n_records: int = 1024,
+                      record_floats: int = 8192, batch: int = 128,
+                      epochs: int = 3, hidden: int = 768) -> dict:
+    """Input-pipeline micro-bench: sync vs async DataWaitMs on a decode-heavy
+    ``BytesFeatureSet`` (ISSUE 4 acceptance).
+
+    Each record is ``record_floats`` float32 bytes; the decoder does real
+    numpy work per record (sort + matmul — the JPEG-decode stand-in; releases
+    the GIL) so host-side production costs milliseconds per batch. The SAME
+    recipe trains twice — ``prefetch_depth=0`` (fully synchronous in-line
+    production, the control arm) and ``prefetch_depth=2`` (the async
+    producer pipeline) — and the
+    per-step DataWaitMs means come from the shared telemetry registry's
+    ``zoo_train_data_wait_seconds`` deltas, i.e. exactly the numbers the
+    train loop logs. Also asserts the async batch stream is byte-identical
+    to the sync one, and reports the async-checkpoint snapshot-vs-write
+    split (``zoo_train_checkpoint_{snapshot,write}_seconds``).
+    """
+    import tempfile
+
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    from analytics_zoo_tpu.common import telemetry as _tm
+    from analytics_zoo_tpu.common import (TrainConfig, init_zoo_context,
+                                          reset_zoo_context)
+    from analytics_zoo_tpu.data import PrefetchLoader
+    from analytics_zoo_tpu.data.featureset import FeatureSet
+    from analytics_zoo_tpu.engine import Estimator
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+
+    reset_zoo_context()
+    init_zoo_context()
+
+    rng = np.random.default_rng(0)
+    side = int(np.sqrt(record_floats))
+    records = [rng.normal(size=record_floats).astype(np.float32).tobytes()
+               for _ in range(n_records)]
+
+    def decoder(r: bytes):
+        a = np.frombuffer(r, np.float32)
+        a = np.sort(a)                          # GIL-releasing numpy work
+        m = a[:side * side].reshape(side, side)
+        v = (m @ m[:64].T).mean(axis=1)[:64]    # decode-heavy stand-in
+        return v.astype(np.float32), np.float32(v[0] > 0)
+
+    def featureset():
+        return FeatureSet.from_bytes(records, decoder, seed=7)
+
+    def hist_delta(snap0, snap1, name):
+        s0 = snap0.get(name, {}).get("samples", {}).get("", {"sum": 0.0,
+                                                            "count": 0})
+        s1 = snap1.get(name, {}).get("samples", {}).get("", {"sum": 0.0,
+                                                            "count": 0})
+        n = s1["count"] - s0["count"]
+        return ((s1["sum"] - s0["sum"]) / n if n else 0.0), n
+
+    def run_mode(depth: int) -> dict:
+        fs = featureset()
+        # the device step must be heavy enough that a well-overlapped host
+        # pipeline can hide its decode cost inside the compute window —
+        # i.e. the normal compute-bound training regime
+        model = Sequential([L.Dense(hidden, activation="relu",
+                                    input_shape=(64,)),
+                            L.Dense(hidden, activation="relu"),
+                            L.Dense(hidden, activation="relu"),
+                            L.Dense(1)])
+        ckdir = tempfile.mkdtemp(prefix=f"bench_ckpt_d{depth}_")
+        # checkpoint_every_n_iters puts trigger-based MID-EPOCH saves on the
+        # hot path — the saves async checkpointing moves to the writer
+        # thread; without it only durable-synchronous epoch-boundary saves
+        # would run and the snapshot-vs-write split would never exercise the
+        # async writer
+        est = Estimator(model, optimizer="sgd", loss="mse",
+                        config=TrainConfig(log_every_n_steps=1,
+                                           prefetch_depth=depth,
+                                           checkpoint_dir=ckdir,
+                                           checkpoint_every_n_iters=4))
+        est.fit(fs, batch_size=batch, epochs=1)      # compile + warmup epoch
+        snap0 = _tm.snapshot()
+        t0 = time.perf_counter()
+        est.fit(fs, batch_size=batch, epochs=1 + epochs)
+        dt = time.perf_counter() - t0
+        snap1 = _tm.snapshot()
+        dw_mean, n_steps = hist_delta(snap0, snap1,
+                                      "zoo_train_data_wait_seconds")
+        snap_mean, _ = hist_delta(snap0, snap1,
+                                  "zoo_train_checkpoint_snapshot_seconds")
+        write_mean, _ = hist_delta(snap0, snap1,
+                                   "zoo_train_checkpoint_write_seconds")
+        return {
+            "prefetch_depth": depth,
+            "data_wait_ms_mean": round(dw_mean * 1e3, 3),
+            "samples_per_sec": round(n_steps * batch / max(dt, 1e-9), 1),
+            "measured_steps": n_steps,
+            "ckpt_snapshot_ms_mean": round(snap_mean * 1e3, 3),
+            "ckpt_write_ms_mean": round(write_mean * 1e3, 3),
+        }
+
+    # byte-identity of the async stream vs the sync iterator (the loader's
+    # determinism contract), checked on the exact bench featureset
+    fs = featureset()
+    sync_stream = [b for b in fs.batches(batch, epoch=1, shuffle=True)]
+    loader = PrefetchLoader(featureset(), batch, epoch=1, shuffle=True,
+                            depth=2)
+    try:
+        async_stream = list(loader)
+    finally:
+        loader.close()
+    identical = len(sync_stream) == len(async_stream) and all(
+        all(np.array_equal(np.asarray(u), np.asarray(v))
+            for u, v in zip(sb, ab))
+        for sb, ab in zip(sync_stream, async_stream))
+
+    sync = run_mode(0)
+    async_ = run_mode(2)
+    ratio = (async_["data_wait_ms_mean"] / sync["data_wait_ms_mean"]
+             if sync["data_wait_ms_mean"] else None)
+    return {
+        "metric": "input-pipeline DataWaitMs, sync vs async",
+        "batch": batch,
+        "record_bytes": record_floats * 4,
+        "n_records": n_records,
+        "byte_identical": bool(identical),
+        "sync": sync,
+        "async": async_,
+        "data_wait_ratio_async_vs_sync": (round(ratio, 4)
+                                          if ratio is not None else None),
+        "platform": str(jax.devices()[0].platform),
+    }
+
+
 def _accelerator_alive(timeout_s: int = 90) -> bool:
     """Probe the default (TPU-tunnel) backend in a subprocess — a wedged tunnel
     blocks forever inside PJRT client init, so an in-process try/except can't
@@ -528,6 +663,22 @@ def _cpu_reference_join(proc: subprocess.Popen,
 
 
 if __name__ == "__main__":
+    if "--data-pipeline" in sys.argv:
+        # standalone input-pipeline micro-bench, ALWAYS on the CPU backend:
+        # it gates host-side pipeline behavior (the 0.5x threshold is tuned
+        # for it), and forcing CPU also sidesteps the wedged-TPU-tunnel hang
+        # every other entry routes around via _accelerator_alive
+        dp = run_data_pipeline(platform="cpu")
+        print(json.dumps(dp))
+        if "--quick" in sys.argv:
+            assert dp["byte_identical"], "async batch stream diverged from sync"
+            sync_dw = dp["sync"]["data_wait_ms_mean"]
+            async_dw = dp["async"]["data_wait_ms_mean"]
+            assert async_dw < 0.5 * sync_dw, (
+                f"async DataWaitMs {async_dw}ms not < 0.5x sync {sync_dw}ms")
+            print(f"[bench] quick gate OK: async {async_dw}ms < 0.5x "
+                  f"sync {sync_dw}ms", file=sys.stderr)
+        sys.exit(0)
     if "--cpu-reference" in sys.argv:
         print(json.dumps(run_ncf(platform="cpu")))
         sys.exit(0)
@@ -592,6 +743,12 @@ if __name__ == "__main__":
         print(f"[bench] transformer_lm entry failed: {e}", file=sys.stderr)
         tlm = None
 
+    try:  # input-pipeline micro-bench (sync vs async DataWaitMs)
+        data_pipeline = run_data_pipeline(platform=None if on_accel else "cpu")
+    except Exception as e:  # additive entry; never break the main line
+        print(f"[bench] data_pipeline entry failed: {e}", file=sys.stderr)
+        data_pipeline = None
+
     result = {
         "metric": "NCF MovieLens-1M training throughput",
         "value": main["samples_per_sec_per_chip"],
@@ -617,5 +774,6 @@ if __name__ == "__main__":
         "platform": main["platform"],
         "implicit": implicit,
         "transformer_lm": tlm,
+        "data_pipeline": data_pipeline,
     }
     print(json.dumps(result))

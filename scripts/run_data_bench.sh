@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Input-pipeline micro-bench gate (ISSUE 4): sync vs async DataWaitMs on a
+# decode-heavy BytesFeatureSet. --quick (default here) asserts the async
+# pipeline's mean DataWaitMs is < 0.5x the synchronous path AND that the
+# async batch stream is byte-identical to the sync one.
+#
+# Usage: scripts/run_data_bench.sh [output.json]
+# Runs on the CPU backend by default so it gates in CI without a TPU.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-DATA_PIPELINE_BENCH.json}"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python bench.py --data-pipeline --quick | tee "$OUT"
+echo "[run_data_bench] wrote $OUT" >&2
