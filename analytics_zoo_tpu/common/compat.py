@@ -1,0 +1,34 @@
+"""JAX API compatibility shims.
+
+The codebase targets the newest stable JAX API; this module papers over the
+(small) surface that moved between the versions the container images carry.
+
+``shard_map``: promoted from ``jax.experimental.shard_map`` to ``jax.shard_map``
+(and its replication-check kwarg renamed ``check_rep`` → ``check_vma``) — call
+sites import :func:`shard_map` from here and always pass ``check_vma=``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["axis_size", "shard_map"]
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis (``jax.lax.axis_size`` where it
+    exists; older releases special-case ``psum(1, axis)`` to a Python int)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=True):
+    """Version-portable ``shard_map`` (manual per-device mapping over a mesh)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)

@@ -146,6 +146,27 @@ class TrainConfig:
                                             # next batch's device_put one batch ahead
                                             # but still ran gather/decode inline on
                                             # the consumer thread)
+    grad_accum_steps: int = 1               # microbatch gradient accumulation: the
+                                            # global batch splits into K microbatches
+                                            # consumed by a lax.scan INSIDE the jitted
+                                            # step (grads accumulate in f32; one
+                                            # optimizer update — and, on the flat
+                                            # update-sharding path, one gradient
+                                            # collective — per GLOBAL step). batch_size
+                                            # must divide by K x the dp shard count
+    compute_dtype: Optional[str] = None     # mixed-precision training: "bfloat16"
+                                            # runs fwd/bwd in bf16 with f32 master
+                                            # weights kept only in the (sharded)
+                                            # optimizer state and an f32 global grad
+                                            # norm for clipping. None = inherit the
+                                            # process precision policy (float32)
+    update_sharding: Any = False            # ZeRO-1 weight-update sharding over the
+                                            # dp axis: False = replicated update;
+                                            # True/"auto" = flat reduce-scatter/
+                                            # all-gather exchange on a pure-dp mesh,
+                                            # per-leaf GSPMD placement otherwise;
+                                            # "flat"/"gspmd" force a path. See
+                                            # parallel/update_sharding.py
     async_checkpoint: bool = True           # snapshot-then-write for trigger-based
                                             # mid-epoch saves: the hot loop pays only
                                             # the device→host snapshot; serialization+

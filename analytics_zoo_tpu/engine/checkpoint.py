@@ -247,6 +247,17 @@ def load_checkpoint(path: str, state_template: Any) -> Tuple[Any, Dict]:
     if meta["n_leaves"] != len(leaves):
         raise ValueError(
             f"checkpoint has {meta['n_leaves']} leaves, template has {len(leaves)}")
-    new_leaves = [data[f"leaf_{i}"] for i in range(len(leaves))]
+    def restore(raw: np.ndarray, like) -> np.ndarray:
+        # npz has no representation for ml_dtypes customs (bfloat16, fp8):
+        # they round-trip as raw void bytes ("|V2"); the template knows the
+        # real dtype, and itemsize is preserved, so a view recovers it
+        want = np.dtype(getattr(like, "dtype", raw.dtype))
+        if raw.dtype != want and raw.dtype.kind == "V" \
+                and raw.dtype.itemsize == want.itemsize:
+            return raw.view(want)
+        return raw
+
+    new_leaves = [restore(data[f"leaf_{i}"], leaves[i])
+                  for i in range(len(leaves))]
     restored = jax.tree_util.tree_unflatten(treedef, new_leaves)
     return restored, meta

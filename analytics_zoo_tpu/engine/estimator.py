@@ -41,8 +41,9 @@ from ..data.featureset import FeatureSet
 from ..data.pipeline import PrefetchLoader
 from ..nn.losses import get_loss
 from ..nn.metrics import Metric, get_metric
-from ..nn.module import Layer
+from ..nn.module import Layer, cast_params, precision_policy
 from ..nn.optimizers import get_optimizer, with_clipping
+from ..parallel import update_sharding as upd
 from . import checkpoint as ckpt
 
 logger = logging.getLogger("analytics_zoo_tpu.estimator")
@@ -72,6 +73,17 @@ _CHECKPOINTS = _tm.counter("zoo_train_checkpoints_total",
 _SIGTERM_EXITS = _tm.counter("zoo_train_sigterm_exits_total",
                              "Graceful SIGTERM teardowns (final checkpoint "
                              "+ exit 143)")
+_GRAD_NORM = _tm.histogram("zoo_train_grad_norm",
+                           "f32 global (pre-clip) gradient L2 norm, observed "
+                           "at log points",
+                           buckets=(0.001, 0.01, 0.1, 0.5, 1, 2.5, 5, 10, 25,
+                                    100, 1000))
+_COMM = _tm.histogram("zoo_train_comm_seconds",
+                      "Measured one-round gradient-exchange time (param-sized "
+                      "collective probe on the dp axis, timed off the hot "
+                      "path at each log point)",
+                      buckets=(.0001, .0005, .001, .0025, .005, .01, .025,
+                               .05, .1, .25, 1))
 
 
 class _GracefulStop(BaseException):
@@ -123,8 +135,13 @@ class Estimator:
         self.loss_fn = get_loss(loss)
         self.config = config or TrainConfig()
         self._base_tx = get_optimizer(optimizer)
-        self.tx = with_clipping(self._base_tx, self.config.gradient_clip_norm,
-                                self.config.gradient_clip_value)
+        self._train_step = None
+        self._step_shapes: set = set()
+        self._rebuild_tx()
+        # flat (BigDL AllReduceParameter-layout) update sharding: static
+        # flattening meta, built by _init_state when the mode engages
+        self._flat_meta = None
+        self._comm_probe_cache = None
         self.mesh = mesh if mesh is not None else get_zoo_context().mesh
         # models that carry their own placement strategy (e.g.
         # PipelinedTransformerLM's stage-over-pp layout) expose
@@ -135,15 +152,14 @@ class Estimator:
         self.param_sharding = param_sharding
         self.train_state: Optional[Dict[str, Any]] = None
         self.trainer_state = TrainerState()
-        # compile-event detection keys on the dispatched batch signature (jit
-        # re-traces per shape/dtype): a second fit() with a new batch_size is
-        # a fresh compile that must be attributed to zoo_train_compile_*, not
-        # silently smeared into that window's ComputeMs
-        self._step_shapes: set = set()
+        # _step_shapes/_scan_shapes: compile-event detection keys on the
+        # dispatched batch signature (jit re-traces per shape/dtype): a second
+        # fit() with a new batch_size is a fresh compile that must be
+        # attributed to zoo_train_compile_*, not silently smeared into that
+        # window's ComputeMs (_step_shapes is created before _rebuild_tx above)
         self._scan_shapes: set = set()
         self.train_summary: Optional[TrainSummary] = None
         self.val_summary: Optional[ValidationSummary] = None
-        self._train_step = None
         self._eval_cache: Dict[Any, Callable] = {}
         # optional (params, model_state) replacing the fresh init — used by
         # model-bundle loading (ZooModel.loadModel); weights were already read
@@ -155,6 +171,26 @@ class Estimator:
         # at-most-one-in-flight async checkpoint writer (created lazily on
         # the first save when config.async_checkpoint)
         self._ckpt_writer: Optional[ckpt.CheckpointWriter] = None
+
+    def _rebuild_tx(self) -> "Estimator":
+        """(Re)compose the optimizer chain from ``_base_tx``: clipping first,
+        then — under mixed precision (TrainConfig.compute_dtype="bfloat16",
+        where fwd/bwd run in the compute dtype against f32 master weights
+        living ONLY in the possibly-dp-sharded optimizer state) — the
+        ``with_master_weights`` wrapper whose "updates" ARE the new
+        low-precision params. Invalidates the compiled step. The single
+        authority for this wiring — __init__, set_gradient_clipping, and
+        _refresh_precision all go through here."""
+        self.tx = with_clipping(self._base_tx, self.config.gradient_clip_norm,
+                                self.config.gradient_clip_value)
+        self._mp_dtype = None
+        if (self.config.compute_dtype is not None
+                and jnp.dtype(self.config.compute_dtype) != jnp.float32):
+            self._mp_dtype = jnp.dtype(self.config.compute_dtype)
+            self.tx = upd.with_master_weights(self.tx)
+        self._train_step = None
+        self._step_shapes.clear()
+        return self
 
     def set_gradient_clipping(self, clip_norm: Optional[float] = None,
                               clip_value: Optional[tuple] = None) -> "Estimator":
@@ -168,10 +204,17 @@ class Estimator:
                                "state is already initialized")
         self.config.gradient_clip_norm = clip_norm
         self.config.gradient_clip_value = clip_value
-        self.tx = with_clipping(self._base_tx, clip_norm, clip_value)
-        self._train_step = None
-        self._step_shapes.clear()
-        return self
+        return self._rebuild_tx()
+
+    def _refresh_precision(self) -> "Estimator":
+        """Recompute the mixed-precision wiring after ``config.compute_dtype``
+        changed post-construction (the orca facade's per-fit override). Must
+        run before the first fit step — the state dtype layout is built once."""
+        if self.train_state is not None:
+            raise RuntimeError("compute_dtype must be set before training "
+                               "starts: params/optimizer dtypes are already "
+                               "laid out")
+        return self._rebuild_tx()
 
     # ------------------------------------------------------------------ shardings
     def _batch_axes(self) -> Tuple[str, ...]:
@@ -183,14 +226,57 @@ class Estimator:
     def _replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
+    def _update_mode(self) -> Optional[str]:
+        """Weight-update sharding mode: ``None`` (replicated update),
+        ``"flat"`` (BigDL-layout reduce-scatter/shard-update/all-gather inside
+        shard_map — pure-dp meshes), or ``"gspmd"`` (per-leaf dp-extended
+        optimizer-state placement composed with the fsdp/tp rules)."""
+        us = self.config.update_sharding
+        if not us:
+            return None
+        dp = self.mesh.shape.get("dp", 1)
+        if dp <= 1:
+            return None
+        pure_dp = all(size == 1 for name, size in self.mesh.shape.items()
+                      if name != "dp")
+        if us == "gspmd":
+            return "gspmd"
+        if pure_dp and self.param_sharding is None:
+            return "flat"
+        if us == "flat":
+            logger.warning("update_sharding='flat' needs a pure-dp mesh and "
+                           "no param_sharding rules; using gspmd placement")
+        return "gspmd"
+
+    def _state_spec(self, path, leaf, mode, upd_rule) -> P:
+        """PartitionSpec for one train-state leaf: base param rule everywhere,
+        with the opt_state subtree overridden by the update-sharding mode."""
+        in_opt = bool(path) and str(getattr(path[0], "key", "")) == "opt_state"
+        if mode == "flat":
+            if (in_opt and self._flat_meta is not None
+                    and tuple(getattr(leaf, "shape", ()))
+                    == (self._flat_meta.npad,)):
+                return P("dp")
+            return P()       # flat mode implies no base rules (pure-dp mesh)
+        if in_opt and upd_rule is not None:
+            return upd_rule(path, leaf)
+        return (self.param_sharding(path, leaf)
+                if self.param_sharding is not None else P())
+
     def _place_state(self, state):
-        """Lay train state onto the mesh: replicated by default, or per
-        ``param_sharding(path, leaf) -> PartitionSpec`` (fsdp/tp rules)."""
-        if self.param_sharding is None:
+        """Lay train state onto the mesh: replicated by default, per
+        ``param_sharding(path, leaf) -> PartitionSpec`` (fsdp/tp rules), and —
+        under update sharding — the opt_state subtree dp-sharded congruent
+        with the grad shards (ZeRO-1: each replica owns 1/dp of the optimizer
+        state, master weights included)."""
+        mode = self._update_mode()
+        if self.param_sharding is None and mode is None:
             return jax.device_put(state, self._replicated())
+        upd_rule = (upd.make_update_sharding(self.mesh, self.param_sharding)
+                    if mode == "gspmd" else None)
 
         def put(path, leaf):
-            spec = self.param_sharding(path, leaf)
+            spec = self._state_spec(path, leaf, mode, upd_rule)
             return jax.device_put(leaf, NamedSharding(self.mesh, spec))
 
         return jax.tree_util.tree_map_with_path(put, state)
@@ -235,9 +321,22 @@ class Estimator:
                 mstate = _overlay(fresh_s, mstate or {})
         else:
             params, mstate = self.model.build(k_init, in_shape)
-        opt_state = self.tx.init(params)
+        # params come out of build() in f32 (param_dtype policy); under mixed
+        # precision the MODEL copy is cast down and the f32 values survive
+        # only as master weights inside the optimizer state
+        model_params = (cast_params(params, self._mp_dtype)
+                        if self._mp_dtype is not None else params)
+        mode = self._update_mode()
+        if mode == "flat":
+            self._flat_meta = upd.flat_meta(model_params,
+                                            self.mesh.shape["dp"])
+            opt_state = upd.flat_opt_init(
+                self._base_tx, params, self._flat_meta,
+                keep_master=self._mp_dtype is not None)
+        else:
+            opt_state = self.tx.init(params)
         state = {
-            "params": params,
+            "params": model_params,
             "opt_state": opt_state,
             "model_state": mstate,
             "step": jnp.zeros((), jnp.int32),
@@ -245,29 +344,122 @@ class Estimator:
         }
         return self._place_state(state)
 
+    def _grads_fn(self, micro_constraint=None):
+        """Build ``(params, mstate, rng, batch) -> (loss, new_mstate, grads)``.
+
+        With ``config.grad_accum_steps == K > 1`` the batch is reshaped to K
+        microbatches consumed by a ``lax.scan`` inside the jitted step (the
+        grad accumulator rides the scan carry, which XLA updates in place —
+        the donated-carry property): grads accumulate in f32 and are divided
+        by K once, so the result is the global-batch mean gradient and any
+        gradient collective pays once per GLOBAL step, amortizing comm K×.
+        ``micro_constraint``: NamedSharding for the (K, micro, ...) layout on
+        the GSPMD paths (None inside shard_map, where data is already local).
+        """
+        model, loss_fn = self.model, self.loss_fn
+        K = max(1, int(self.config.grad_accum_steps))
+
+        def loss_of(p, mstate, rng, x, y):
+            y_hat, new_mstate = model.apply(p, mstate, x, training=True,
+                                            rng=rng)
+            total = loss_fn(y, y_hat)
+            # 0.0 unless layers carry w/b regularizers
+            reg_fn = getattr(model, "regularization", None)
+            if reg_fn is not None:
+                total = total + reg_fn(p)
+            return total, new_mstate
+
+        grad_of = jax.value_and_grad(loss_of, has_aux=True)
+
+        def single(params, mstate, rng, batch):
+            x, y = batch
+            (loss, new_mstate), grads = grad_of(params, mstate, rng, x, y)
+            return loss, new_mstate, grads
+
+        if K == 1:
+            return single
+
+        def accum(params, mstate, rng, batch):
+            def to_micro(a):
+                return a.reshape((K, a.shape[0] // K) + a.shape[1:])
+
+            micro = jax.tree_util.tree_map(to_micro, batch)
+            if micro_constraint is not None:
+                micro = jax.tree_util.tree_map(
+                    lambda a: jax.lax.with_sharding_constraint(
+                        a, micro_constraint), micro)
+
+            def body(carry, mb):
+                acc, mst, i = carry
+                loss, mst2, g = single(params, mst,
+                                       jax.random.fold_in(rng, i), mb)
+                acc = jax.tree_util.tree_map(
+                    lambda a, gg: a + gg.astype(a.dtype), acc, g)
+                return (acc, mst2, i + jnp.int32(1)), loss
+
+            zero = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+            (acc, new_mstate, _), losses = jax.lax.scan(
+                body, (zero, mstate, jnp.int32(0)), micro)
+            grads = jax.tree_util.tree_map(lambda g: g / K, acc)
+            return jnp.mean(losses), new_mstate, grads
+
+        return accum
+
     def _step_fn(self):
-        """The raw (state, batch) -> (state, loss) transition shared by the
-        per-batch jitted step and the scanned device-cached epoch runner."""
-        model, loss_fn, tx = self.model, self.loss_fn, self.tx
+        """The raw (state, batch) -> (state, (loss, grad_norm)) transition
+        shared by the per-batch jitted step and the scanned device-cached
+        epoch runner. Three update layouts (see parallel/update_sharding.py):
+        replicated (classic), "gspmd" (grads constrained to dp-extended specs
+        so the partitioner reduce-scatters into the sharded optimizer state
+        and all-gathers params back), and "flat" (the shard_map BigDL-layout
+        exchange, built by _flat_step_fn)."""
+        mode = self._update_mode()
+        if mode == "flat":
+            return self._flat_step_fn()
+        cfg = self.config
+        mesh = self.mesh
+        mp = self._mp_dtype is not None
+        tx = self.tx
+        base_rule = self.param_sharding
+        micro_ns = (NamedSharding(mesh, P(None, self._batch_axes()))
+                    if cfg.grad_accum_steps > 1 else None)
+        grads_fn = self._grads_fn(micro_constraint=micro_ns)
+        upd_rule = (upd.make_update_sharding(mesh, base_rule)
+                    if mode == "gspmd" else None)
 
         def step(state, batch):
-            x, y = batch
             rng = jax.random.fold_in(state["rng"], state["step"])
+            loss, new_mstate, grads = grads_fn(
+                state["params"], state["model_state"], rng, batch)
+            # f32 grads from here on: the accumulation path already summed in
+            # f32; the single-batch mixed-precision path casts up so clipping
+            # and the update run against full-precision values
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
+            gnorm = optax.global_norm(grads)
+            if upd_rule is not None:
+                # dp-sharded grad placement congruent with the optimizer
+                # state: the partial→sharded transition is the reduce-scatter
+                grads = jax.tree_util.tree_map_with_path(
+                    lambda p, g: jax.lax.with_sharding_constraint(
+                        g, NamedSharding(mesh, upd_rule(p, g))), grads)
+            updates, new_opt = tx.update(grads, state["opt_state"],
+                                         state["params"])
+            if mp:
+                # with_master_weights returns the NEW low-precision params
+                new_params = updates
+            else:
+                new_params = optax.apply_updates(state["params"], updates)
+            if upd_rule is not None:
+                # back to the base (replicated / fsdp/tp) layout: the
+                # sharded→base transition is the params all-gather
+                def back(path, leaf):
+                    spec = base_rule(path, leaf) if base_rule else P()
+                    return jax.lax.with_sharding_constraint(
+                        leaf, NamedSharding(mesh, spec))
 
-            def loss_of(p):
-                y_hat, new_mstate = model.apply(p, state["model_state"], x,
-                                                training=True, rng=rng)
-                total = loss_fn(y, y_hat)
-                # 0.0 unless layers carry w/b regularizers
-                reg_fn = getattr(model, "regularization", None)
-                if reg_fn is not None:
-                    total = total + reg_fn(p)
-                return total, new_mstate
-
-            (loss, new_mstate), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(state["params"])
-            updates, new_opt = tx.update(grads, state["opt_state"], state["params"])
-            new_params = optax.apply_updates(state["params"], updates)
+                new_params = jax.tree_util.tree_map_with_path(back, new_params)
             new_state = {
                 "params": new_params,
                 "opt_state": new_opt,
@@ -275,13 +467,85 @@ class Estimator:
                 "step": state["step"] + 1,
                 "rng": state["rng"],
             }
-            return new_state, loss
+            return new_state, (loss, gnorm)
 
         return step
 
+    def _flat_step_fn(self):
+        """Pure-dp weight-update sharding: the whole step runs inside
+        ``shard_map`` (manual over the mesh), so per-replica grads stay local
+        through the accumulation scan and the exchange is structurally ONE
+        reduce-scatter + one params all-gather per global step —
+        BigDL ``AllReduceParameter``'s slice-owner update, TPU-native."""
+        from ..common.compat import shard_map
+
+        cfg = self.config
+        mesh = self.mesh
+        base_tx = self._base_tx
+        batch_axes = self._batch_axes()
+        grads_fn = self._grads_fn()
+
+        def step(state, batch):
+            meta = self._flat_meta
+
+            def body(st, bt):
+                rng = jax.random.fold_in(st["rng"], st["step"])
+                # decorrelate per-replica dropout/negative-sampling masks
+                rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+                loss, mstate2, grads = grads_fn(st["params"],
+                                                st["model_state"], rng, bt)
+                new_params, new_opt, gnorm = upd.flat_exchange(
+                    st["params"], grads, st["opt_state"], meta, base_tx,
+                    clip_norm=cfg.gradient_clip_norm,
+                    clip_value=cfg.gradient_clip_value)
+                loss = jax.lax.pmean(loss, "dp")
+                # keep float model state (batchnorm EMAs computed from LOCAL
+                # batch stats) replicated-consistent across replicas
+                mstate2 = jax.tree_util.tree_map(
+                    lambda a: (jax.lax.pmean(a, "dp")
+                               if jnp.issubdtype(jnp.asarray(a).dtype,
+                                                 jnp.floating) else a),
+                    mstate2)
+                new_state = {
+                    "params": new_params,
+                    "opt_state": new_opt,
+                    "model_state": mstate2,
+                    "step": st["step"] + 1,
+                    "rng": st["rng"],
+                }
+                return new_state, (loss, gnorm)
+
+            mode_rule = None  # flat mode: no per-leaf gspmd rule
+            state_specs = jax.tree_util.tree_map_with_path(
+                lambda p, l: self._state_spec(p, l, "flat", mode_rule), state)
+            batch_specs = jax.tree_util.tree_map(
+                lambda _: P(batch_axes), batch)
+            fn = shard_map(body, mesh=mesh,
+                           in_specs=(state_specs, batch_specs),
+                           out_specs=(state_specs, (P(), P())),
+                           check_vma=False)
+            return fn(state, batch)
+
+        return step
+
+    def _with_policy(self, fn):
+        """Engage TrainConfig.compute_dtype as the precision policy for the
+        dynamic extent of each dispatch (policy is read at TRACE time by the
+        layers' ``as_compute``; wrapping the call covers the trace)."""
+        if self.config.compute_dtype is None:
+            return fn
+        dt = self.config.compute_dtype
+
+        def wrapped(*args):
+            with precision_policy(compute_dtype=dt):
+                return fn(*args)
+
+        return wrapped
+
     def _make_train_step(self):
         donate = (0,) if self.config.donate_state else ()
-        return jax.jit(self._step_fn(), donate_argnums=donate)
+        return self._with_policy(jax.jit(self._step_fn(),
+                                         donate_argnums=donate))
 
     def _make_scan_block(self):
         """Device-cached mode: one jitted call running ``scan_block_steps``
@@ -301,7 +565,7 @@ class Estimator:
             return jax.lax.scan(body, state, idx_mat)
 
         donate = (0,) if self.config.donate_state else ()
-        return jax.jit(block, donate_argnums=donate)
+        return self._with_policy(jax.jit(block, donate_argnums=donate))
 
     # --------------------------------------------------------------------- fit
     def fit(self, data, batch_size: Optional[int] = None,
@@ -322,6 +586,18 @@ class Estimator:
         """
         cfg = self.config
         batch_size = batch_size or cfg.batch_size
+        accum = max(1, int(cfg.grad_accum_steps))
+        if accum > 1:
+            n_shards = 1
+            for ax in self._batch_axes():
+                n_shards *= self.mesh.shape[ax]
+            if batch_size % (accum * n_shards):
+                raise ValueError(
+                    f"batch_size={batch_size} must divide by "
+                    f"grad_accum_steps={accum} x dp-shards={n_shards}: each "
+                    f"of the {accum} microbatches is itself sharded over the "
+                    f"dp axes — pick batch_size as a multiple of "
+                    f"{accum * n_shards}")
         train_set = _as_featureset(data)
         end_trigger = end_trigger or MaxEpoch(epochs if epochs is not None
                                               else cfg.max_epochs)
@@ -508,8 +784,8 @@ class Estimator:
                 chaos_point("estimator.step")
                 key = self._batch_signature(global_batch)
                 t_step = time.perf_counter()
-                self.train_state, loss = self._train_step(self.train_state,
-                                                          global_batch)
+                self.train_state, (loss, gnorm) = self._train_step(
+                    self.train_state, global_batch)
                 if key not in self._step_shapes:
                     # first dispatch of this shape = compile event: sync so
                     # its cost is attributed to compilation, not smeared over
@@ -529,6 +805,8 @@ class Estimator:
                 seen += batch_size
                 if ts.iteration % cfg.log_every_n_steps == 0:
                     loss_val = float(loss)
+                    gnorm_val = float(gnorm)
+                    _GRAD_NORM.observe(gnorm_val)
                     ts.last_loss = loss_val
                     now = time.perf_counter()
                     throughput = seen / max(now - t0, 1e-9)
@@ -536,15 +814,22 @@ class Estimator:
                     compute_ms = max(0.0, (now - win_t0 - win_data_wait)
                                      / win_steps) * 1e3
                     _COMPUTE.observe(compute_ms / 1e3)
+                    self._observe_comm()
                     if self.train_summary:
                         self.train_summary.add_scalars(ts.iteration, {
                             "Loss": loss_val, "Throughput": throughput,
+                            "GradNorm": gnorm_val,
                             "DataWaitMs": data_ms, "ComputeMs": compute_ms})
-                    logger.info("epoch %d iter %d loss %.4f throughput %.1f "
-                                "rec/s (data %.2fms compute %.2fms /step)",
-                                epoch, ts.iteration, loss_val, throughput,
-                                data_ms, compute_ms)
-                    win_t0, win_steps, win_data_wait = now, 0, 0.0
+                    logger.info("epoch %d iter %d loss %.4f gnorm %.3f "
+                                "throughput %.1f rec/s (data %.2fms compute "
+                                "%.2fms /step)",
+                                epoch, ts.iteration, loss_val, gnorm_val,
+                                throughput, data_ms, compute_ms)
+                    # fresh clock: the comm probe (and its first-call
+                    # compile) ran after `now` and must not be attributed to
+                    # the NEXT window's ComputeMs
+                    win_t0, win_steps, win_data_wait = (time.perf_counter(),
+                                                        0, 0.0)
                 if (checkpoint_trigger is not None and checkpoint_trigger(ts)
                         and cfg.checkpoint_dir):
                     self._save(cfg.checkpoint_dir)
@@ -646,7 +931,7 @@ class Estimator:
             sel = idx[b * block * batch_size:(b + 1) * block * batch_size]
             idx_mat = sel.reshape(block, batch_size)
             t_blk = time.perf_counter()
-            self.train_state, losses = self._scan_block(
+            self.train_state, (losses, gnorms) = self._scan_block(
                 self.train_state, self._device_data, idx_mat)
             scan_key = tuple(idx_mat.shape)
             if scan_key not in self._scan_shapes:
@@ -669,18 +954,23 @@ class Estimator:
                     and ((b + 1) * block) // cfg.log_every_n_steps \
                     > (b * block) // cfg.log_every_n_steps:
                 loss_val = float(loss)          # device sync closes the window
+                gnorm_val = float(gnorms[-1])
+                _GRAD_NORM.observe(gnorm_val)
                 ts.last_loss = loss_val
                 now = time.perf_counter()
                 throughput = seen / max(now - t0, 1e-9)
                 compute_ms = (now - win_t0) / max(1, win_steps) * 1e3
                 _COMPUTE.observe(compute_ms / 1e3)
+                self._observe_comm()
                 if self.train_summary:
                     self.train_summary.add_scalars(ts.iteration, {
                         "Loss": loss_val, "Throughput": throughput,
+                        "GradNorm": gnorm_val,
                         "DataWaitMs": 0.0, "ComputeMs": compute_ms})
                 logger.info("epoch %d iter %d loss %.4f throughput %.1f rec/s",
                             epoch, ts.iteration, loss_val, throughput)
-                win_t0, win_steps = now, 0
+                # fresh clock: keep the comm probe out of the next window
+                win_t0, win_steps = time.perf_counter(), 0
             if (checkpoint_trigger is not None and cfg.checkpoint_dir
                     and self._trigger_crossed(checkpoint_trigger, ts, block)):
                 self._save(cfg.checkpoint_dir)
@@ -693,7 +983,8 @@ class Estimator:
                                         self._device_data)
             key = self._batch_signature(db)
             t_step = time.perf_counter()
-            self.train_state, loss = self._train_step(self.train_state, db)
+            self.train_state, (loss, _gn) = self._train_step(self.train_state,
+                                                             db)
             if key not in self._step_shapes:
                 jax.block_until_ready(loss)
                 self._step_shapes.add(key)
@@ -709,6 +1000,25 @@ class Estimator:
                 self._save(cfg.checkpoint_dir)
         self._finish_epoch(t0, seen, loss, batch_size,
                            compile_s=epoch_compile)
+
+    def _observe_comm(self):
+        """Feed ``zoo_train_comm_seconds``: time one param-sized gradient-
+        exchange round (psum, or reduce-scatter + all-gather under update
+        sharding) on the dp axis. A measured probe at log-point cadence — the
+        in-step collective is fused into the jitted program and cannot be
+        timed from the host."""
+        if self.mesh.shape.get("dp", 1) <= 1 or self.train_state is None:
+            return
+        if self._comm_probe_cache is None:
+            n_elems = sum(
+                int(np.prod(l.shape)) for l in
+                jax.tree_util.tree_leaves(self.train_state["params"]))
+            self._comm_probe_cache = upd.make_comm_probe(
+                self.mesh, n_elems, sharded=self._update_mode() is not None)
+        fn, vec = self._comm_probe_cache
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(vec))
+        _COMM.observe(time.perf_counter() - t0)
 
     @staticmethod
     def _batch_signature(batch) -> Tuple:
@@ -801,7 +1111,7 @@ class Estimator:
                 y_hat, _ = model.apply(params, mstate, x, training=False)
                 return [m.update(a, y, y_hat) for m, a in zip(metric_objs, accs)]
 
-            self._eval_cache[key] = jax.jit(eval_step)
+            self._eval_cache[key] = self._with_policy(jax.jit(eval_step))
         eval_step = self._eval_cache[key]
         accs = [m.init() for m in metric_objs]
         # same async loader as the train path: gather/decode + device upload
@@ -823,8 +1133,8 @@ class Estimator:
     def predict(self, x, batch_size: int = 256) -> np.ndarray:
         model = self.model
         if not hasattr(self, "_predict_step"):
-            self._predict_step = jax.jit(
-                lambda p, s, x: model.apply(p, s, x, training=False)[0])
+            self._predict_step = self._with_policy(jax.jit(
+                lambda p, s, x: model.apply(p, s, x, training=False)[0]))
         data = (x,) if not isinstance(x, (tuple, list)) else tuple(x)
         fs = FeatureSet(data)
         if self.train_state is None:

@@ -19,6 +19,7 @@ Conventions
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -49,6 +50,22 @@ def param_dtype():
 
 def compute_dtype():
     return _POLICY["compute_dtype"]
+
+
+@contextlib.contextmanager
+def precision_policy(param_dtype=None, compute_dtype=None):
+    """Scoped :func:`set_policy`: engage a precision override for the dynamic
+    extent of the block (restored on exit). The training engine wraps its
+    jitted-step dispatches in this so ``TrainConfig.compute_dtype`` affects
+    exactly the traces it owns without leaking a global policy change."""
+    prev = dict(_POLICY)
+    set_policy(param_dtype, compute_dtype)
+    try:
+        yield
+    finally:
+        with _POLICY_LOCK:
+            _POLICY.clear()
+            _POLICY.update(prev)
 
 
 # ---------------------------------------------------------------------- initializers

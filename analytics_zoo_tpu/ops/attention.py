@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..common.compat import axis_size, shard_map
+
 NEG_INF = -1e30
 
 
@@ -69,7 +71,7 @@ def _ring_body(q, k_blk, v_blk, o, m, l, *, scale, causal, q_pos, k_pos):
 def _ring_attention_jnp(q, k, v, *, axis_name: str = "sp", causal: bool = False):
     """Plain-jnp ring body (O(T_local²) score blocks) — fallback when the
     pallas kernel is unavailable or the local sequence does not tile."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, t_q, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -138,7 +140,7 @@ def _ring_flash_fwd_res(q, k, v, axis_name, causal, block_q, block_k):
     from .flash_attention import _flash_fwd, _interpret_default
 
     interpret = _interpret_default()
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, t_q, h, d = q.shape
     o0 = jnp.zeros((b, t_q, h, d), jnp.float32)
@@ -185,7 +187,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, block_q, block_k, res, g):
 
     q, k, v, out, lse = res
     interpret = _interpret_default()
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
@@ -273,7 +275,7 @@ def _zigzag_fwd_res(q, k, v, axis_name, block_q, block_k):
     from .flash_attention import _flash_fwd, _interpret_default
 
     interpret = _interpret_default()
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, t_loc, h, d = q.shape
     c = t_loc // 2
@@ -334,7 +336,7 @@ def _zigzag_vjp_bwd(axis_name, block_q, block_k, res, g):
 
     q, k, v, out, lse = res
     interpret = _interpret_default()
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, t_loc, h, d = q.shape
     c = t_loc // 2
@@ -477,7 +479,7 @@ def ulysses_attention_local(q, k, v, *, axis_name: str = "sp",
     attention over the complete sequence, reshard back. Head count must divide
     the ``sp`` axis size.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
 
     def a2a(x, split, concat):
@@ -552,7 +554,7 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
         if q.shape[0] % batch_div or q.shape[2] % mesh.shape[head_axis]:
             return flash_attention(q, k, v, causal)
         spec = P(batch_axes, None, head_axis, None)
-        wrapped = jax.shard_map(
+        wrapped = shard_map(
             lambda q_, k_, v_: flash_attention(q_, k_, v_, causal),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
@@ -578,7 +580,7 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
 
             perm = zigzag_permutation(q.shape[1], sp)
             inv = np.argsort(perm)
-            wrapped = jax.shard_map(
+            wrapped = shard_map(
                 functools.partial(zigzag_ring_attention_local,
                                   axis_name=seq_axis, causal=True),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -588,7 +590,7 @@ def sharded_attention(q, k, v, mesh, *, strategy: str = "auto",
             return o[:, inv]
     fn = {"ring": ring_attention_local,
           "ulysses": ulysses_attention_local}[strategy]
-    wrapped = jax.shard_map(
+    wrapped = shard_map(
         functools.partial(fn, axis_name=seq_axis, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)

@@ -150,7 +150,10 @@ class Estimator:
             validation_data=None,
             host_sharding: Optional[bool] = None,
             prefetch_depth: Optional[int] = None,
-            async_checkpoint: Optional[bool] = None) -> "Estimator":
+            async_checkpoint: Optional[bool] = None,
+            grad_accum_steps: Optional[int] = None,
+            compute_dtype: Optional[str] = None,
+            update_sharding=None) -> "Estimator":
         """``host_sharding`` (default auto: on under a multi-host job): XShards
         input is split by partition across hosts and each host marshals ONLY
         its own slice into a ``FeatureSet.from_host_shard`` — the multi-host
@@ -159,14 +162,48 @@ class Estimator:
         ``prefetch_depth`` / ``async_checkpoint`` override the engine
         Estimator's input-pipeline and checkpointing knobs for THIS fit only
         (``prefetch_depth=0`` forces the synchronous data path); the prior
-        config values are restored on return."""
+        config values are restored on return.
+
+        ``grad_accum_steps`` / ``compute_dtype`` / ``update_sharding`` set the
+        engine's microbatch-accumulation, bf16 mixed-precision, and ZeRO-1
+        weight-update-sharding knobs (parallel/update_sharding.py). Unlike
+        the per-fit overrides above they are STICKY: they shape the compiled
+        step and the optimizer-state/param dtype layout, which the engine
+        builds once — so set them on the model's FIRST fit; changing
+        ``compute_dtype`` after training started raises."""
         self._ensure_compiled()
-        cfg = self.model.estimator.config
+        eng = self.model.estimator
+        cfg = eng.config
+        # validate BEFORE mutating: a rejected call must leave the engine
+        # config (and the compiled-step/precision wiring that reads it)
+        # exactly as it was
+        built = eng.train_state is not None
+        if built:
+            for name, want, have in (
+                    ("grad_accum_steps",
+                     None if grad_accum_steps is None
+                     else int(grad_accum_steps), cfg.grad_accum_steps),
+                    ("update_sharding", update_sharding, cfg.update_sharding),
+                    ("compute_dtype", compute_dtype, cfg.compute_dtype)):
+                if want is not None and want != have:
+                    raise RuntimeError(
+                        f"{name} cannot change after training started: the "
+                        f"compiled step and state layout are already built")
         saved = (cfg.prefetch_depth, cfg.async_checkpoint)
         if prefetch_depth is not None:
             cfg.prefetch_depth = int(prefetch_depth)
         if async_checkpoint is not None:
             cfg.async_checkpoint = bool(async_checkpoint)
+        if (grad_accum_steps is not None
+                and int(grad_accum_steps) != cfg.grad_accum_steps):
+            cfg.grad_accum_steps = int(grad_accum_steps)
+            eng._train_step = None
+        if update_sharding is not None and update_sharding != cfg.update_sharding:
+            cfg.update_sharding = update_sharding
+            eng._train_step = None
+        if compute_dtype is not None and compute_dtype != cfg.compute_dtype:
+            cfg.compute_dtype = compute_dtype
+            eng._refresh_precision()
         _ORCA_FITS.labels(input=type(data).__name__).inc()
         # the fit span shows up in xprof captures and the span recorder; the
         # per-step DataWait/Compute breakdown comes from the engine Estimator

@@ -10,10 +10,15 @@ from ..ops.attention import (full_attention, ring_attention_local,
                              sharded_attention, ulysses_attention_local)
 from .sharding import TP_RULES, make_param_sharding, replicated
 from .pipeline import pipeline_apply, stack_stage_params
+from .update_sharding import (collective_counts, flat_exchange, flat_meta,
+                              make_comm_probe, make_update_sharding,
+                              shard_spec_over_axis, with_master_weights)
 
 __all__ = [
     "pipeline_apply", "stack_stage_params",
-    "TP_RULES", "build_mesh", "full_attention", "make_param_sharding",
-    "replicated", "ring_attention_local", "sharded_attention",
-    "ulysses_attention_local",
+    "TP_RULES", "build_mesh", "collective_counts", "flat_exchange",
+    "flat_meta", "full_attention", "make_comm_probe", "make_param_sharding",
+    "make_update_sharding", "replicated", "ring_attention_local",
+    "shard_spec_over_axis", "sharded_attention", "ulysses_attention_local",
+    "with_master_weights",
 ]

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..common.compat import axis_size, shard_map
+
 
 def stack_stage_params(params_list):
     """[per-stage pytree] → one pytree with a leading stage axis (to shard
@@ -39,7 +41,7 @@ def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name: str):
     ``x_micro``: (n_micro, micro_B, ...) — full microbatch stream, present on
     stage 0 (other stages receive via the ring).
     """
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     my_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
     n_micro = x_micro.shape[0]
@@ -101,7 +103,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
 
     param_specs = jax.tree_util.tree_map(
         lambda p: P(axis_name), stacked_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_pipeline_local, stage_fn=stage_fn,
                           axis_name=axis_name),
         mesh=mesh,

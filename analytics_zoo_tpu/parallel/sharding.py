@@ -39,7 +39,7 @@ def _path_str(path) -> str:
     return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
 
 
-def _fits(dim: Optional[int], size: int, axis, mesh) -> bool:
+def _fits(size: int, axis, mesh) -> bool:
     if axis is None:
         return True
     ax_size = 1
@@ -48,11 +48,25 @@ def _fits(dim: Optional[int], size: int, axis, mesh) -> bool:
     return size % ax_size == 0
 
 
-def _sanitize(spec: P, shape, mesh) -> P:
+def _sanitize(spec: P, shape, mesh, path: Optional[str] = None) -> P:
+    """Adapt ``spec`` to ``shape``: a single mesh axis that does not divide a
+    dim falls back to replicated on that dim (documented, tested behavior for
+    e.g. odd vocab sizes), but a *tuple* of axes whose combined size
+    over-divides a dim is a layout error in the rule itself — raise with the
+    param path so the author can fix the rule rather than silently training
+    replicated."""
     axes = list(spec) + [None] * (len(shape) - len(spec))
     out = []
     for dim, axis in zip(shape, axes[: len(shape)]):
-        out.append(axis if _fits(None, dim, axis, mesh) else None)
+        if isinstance(axis, tuple) and not _fits(dim, axis, mesh):
+            sizes = {a: mesh.shape[a] for a in axis}
+            raise ValueError(
+                f"param {path or '<unknown>'}: dim of size {dim} cannot be "
+                f"sharded over combined mesh axes {axis} (sizes {sizes}, "
+                f"product {int(np.prod(list(sizes.values())))}) — the "
+                f"combined axes must divide the dim; fix the sharding rule "
+                f"or the mesh layout")
+        out.append(axis if _fits(dim, axis, mesh) else None)
     return P(*out)
 
 
@@ -72,7 +86,7 @@ def make_param_sharding(mesh, rules: Sequence[Tuple[str, P]] = TP_RULES,
         pstr = _path_str(path)
         for needle, spec in rules:
             if needle in pstr:
-                return _sanitize(spec, shape, mesh)
+                return _sanitize(spec, shape, mesh, path=pstr)
         if fsdp_default and fsdp_size > 1 and len(shape) >= 1:
             # shard the largest divisible axis over fsdp
             order = sorted(range(len(shape)), key=lambda i: -shape[i])

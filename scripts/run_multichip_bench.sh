@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Multichip CI gate (ISSUE 5): virtual 8-way CPU mesh via
+# XLA_FLAGS=--xla_force_host_platform_device_count=8.
+#
+#   1. dryrun matrix — __graft_entry__.dryrun_multichip(8): the full
+#      dp/fsdp/tp/sp training step + pp pipeline + ep MoE forward; gates on
+#      its "step OK" line.
+#   2. bench.py --update-sharding --quick — replicated vs ZeRO-1 (flat
+#      reduce-scatter/all-gather) weight update at dp ∈ {2,4,8}; gates on
+#      sharded optimizer state ≈ replicated/dp, one grad reduce-scatter per
+#      global step with collective counts constant in grad_accum_steps, and
+#      sharded-update step HBM ≤ replicated-update HBM.
+#
+# Usage: scripts/run_multichip_bench.sh [--quick] [output.json]
+# (--quick is the default and currently the only mode; it is accepted for
+#  symmetry with the other bench gates.)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="MULTICHIP_UPDATE_SHARDING.json"
+for a in "$@"; do
+    case "$a" in
+        --quick) ;;
+        *) OUT="$a" ;;
+    esac
+done
+
+export JAX_PLATFORMS=cpu
+flags="${XLA_FLAGS:-}"
+case "$flags" in
+    *xla_force_host_platform_device_count*) ;;
+    *) flags="$flags --xla_force_host_platform_device_count=8" ;;
+esac
+export XLA_FLAGS="${flags# }"
+
+echo "[run_multichip_bench] dryrun matrix (8-way virtual mesh)" >&2
+dryrun_log="$(mktemp)"
+python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)" \
+    | tee "$dryrun_log"
+grep -q "step OK" "$dryrun_log" || {
+    echo "[run_multichip_bench] FAIL: dryrun matrix missing 'step OK'" >&2
+    exit 1
+}
+
+echo "[run_multichip_bench] update-sharding bench (gated)" >&2
+python bench.py --update-sharding --quick | tee "$OUT"
+echo "[run_multichip_bench] wrote $OUT" >&2

@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from analytics_zoo_tpu.common.compat import shard_map
 from analytics_zoo_tpu.ops.attention import full_attention, sharded_attention
 
 
@@ -121,7 +122,7 @@ def _ring_local(mesh, use_flash, causal=True):
 
     from analytics_zoo_tpu.ops.attention import ring_attention_local
 
-    return jax.shard_map(
+    return shard_map(
         functools.partial(ring_attention_local, axis_name="sp", causal=causal,
                           use_flash=use_flash),
         mesh=mesh, in_specs=(P(None, "sp", None, None),) * 3,
