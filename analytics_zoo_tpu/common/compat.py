@@ -6,13 +6,27 @@ The codebase targets the newest stable JAX API; this module papers over the
 ``shard_map``: promoted from ``jax.experimental.shard_map`` to ``jax.shard_map``
 (and its replication-check kwarg renamed ``check_rep`` → ``check_vma``) — call
 sites import :func:`shard_map` from here and always pass ``check_vma=``.
+
+``tpu_compiler_params``: the pallas-TPU compiler-options class was renamed
+``TPUCompilerParams`` → ``CompilerParams``; kernels build theirs through here
+so the TPU (non-interpret) path constructs whichever class this jax ships.
 """
 
 from __future__ import annotations
 
 import jax
 
-__all__ = ["axis_size", "shard_map"]
+__all__ = ["axis_size", "shard_map", "tpu_compiler_params"]
+
+
+def tpu_compiler_params(**kwargs):
+    """Version-portable ``pallas.tpu`` compiler params (``CompilerParams`` on
+    new jax, ``TPUCompilerParams`` on 0.4.x)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
 
 
 def axis_size(axis_name) -> int:

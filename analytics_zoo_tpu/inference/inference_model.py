@@ -143,6 +143,9 @@ class InferenceModel:
         # recompiles mid-stream), ``cache_hits`` counts dict-lookup dispatches
         self.compile_count = 0
         self.cache_hit_count = 0
+        # int8 packing wall time (quantize_int8) — startup cost the serving
+        # engine pays at warmup instead of the first request
+        self.quantize_seconds = 0.0
 
     # ------------------------------------------------------------------ loading
 
@@ -221,12 +224,18 @@ class InferenceModel:
 
         Native modules: Dense / Convolution2D kernels >= ``min_elements`` pack
         to per-output-channel int8 and the forward COMPUTES in int8 on the MXU
-        (dynamic activation quantization, int32 accumulate — ops/int8.py).
-        Imported-graph loads (no module): weight-only packing, dequantized
-        inside the compiled program (size cut only).
+        (dynamic activation quantization fused into the pallas kernel tier on
+        TPU; lax fallback elsewhere — ops/int8.py router). Imported-graph
+        loads (no module): weight-only packing, dequantized inside the
+        compiled program (size cut only).
+
+        The packing cost is timed into ``compile_stats()['quantize_seconds']``
+        so callers (the serving engine's startup warmup) can account for it
+        off the first-request path.
         """
         if self._params is None:
             raise RuntimeError("load a model before quantizing")
+        t0 = time.perf_counter()
         module = getattr(self, "_module", None)
         if module is not None and hasattr(module, "layers"):
             params = jax.device_get(self._params)
@@ -236,6 +245,7 @@ class InferenceModel:
                 self._params = jax.device_put(packed_params)
                 self._compiled.clear()
                 self._quantized = True
+                self.quantize_seconds += time.perf_counter() - t0
                 return self
             # no int8-computable layer (LSTM/embedding/custom models): fall
             # through to the generic weight-only path so the 4x size cut —
@@ -263,6 +273,7 @@ class InferenceModel:
         self._params = jax.device_put(jax.tree_util.tree_unflatten(treedef, packed))
         self._compiled.clear()
         self._quantized = True
+        self.quantize_seconds += time.perf_counter() - t0
         return self
 
     # ---------------------------------------------------------------- predicting
@@ -282,13 +293,15 @@ class InferenceModel:
         _CACHE_HITS.inc()
         return exe
 
-    def compile_stats(self) -> Dict[str, int]:
+    def compile_stats(self) -> Dict[str, Any]:
         """Bucket-cache counters (surfaced at /metrics and by the bench):
         ``compiled_shapes``/``compiles`` bound by the bucket ladder,
-        ``cache_hits`` = dispatches served by a dict lookup."""
+        ``cache_hits`` = dispatches served by a dict lookup,
+        ``quantize_seconds`` = int8 packing wall time (0.0 unquantized)."""
         return {"compiled_shapes": len(self._compiled),
                 "compiles": self.compile_count,
-                "cache_hits": self.cache_hit_count}
+                "cache_hits": self.cache_hit_count,
+                "quantize_seconds": round(self.quantize_seconds, 4)}
 
     def _bucket(self, n: int) -> int:
         for b in _buckets(self.max_batch_size):

@@ -59,7 +59,7 @@ class Convolution2D(Layer):
         x = as_compute(x)
         if is_quantized(params["kernel"]):
             y = int8_conv2d(x, params["kernel"], strides=self.strides,
-                            padding=self.padding).astype(x.dtype)
+                            padding=self.padding, out_dtype=x.dtype)
         else:
             kernel = jnp.asarray(params["kernel"], x.dtype)
             y = jax.lax.conv_general_dilated(

@@ -68,8 +68,9 @@ class Dense(Layer):
         x = as_compute(x)
         if is_quantized(params["kernel"]):
             # InferenceModel.quantize_int8 packed this kernel: int8 MXU matmul
-            # with dynamic activation quantization (ops/int8.py)
-            y = int8_matmul(x, params["kernel"]).astype(x.dtype)
+            # with dynamic activation quantization — fused in-VMEM pallas
+            # kernel on TPU, lax fallback elsewhere (ops/int8.py router)
+            y = int8_matmul(x, params["kernel"], out_dtype=x.dtype)
         else:
             kernel = jnp.asarray(params["kernel"], x.dtype)
             y = x @ kernel

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 
+from ..common.compat import tpu_compiler_params
+
 NEG_INF = -1e30
 
 #: Flash-aware rematerialization policy: under ``jax.checkpoint`` save ONLY the
@@ -147,7 +149,7 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
         # qi is NOT parallel: the lse out-block (one full row per bh) is
         # revisited by every qi step; parallel execution over qi would give
         # each core its own copy of the row and clobber other cores' slices
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(qh, kh, vh)
@@ -277,7 +279,7 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal: bool, block_q: int,
     # unlike the forward (whose lse OUT row is revisited by every qi), lse and
     # delta are read-only here and each middle-dim index owns a disjoint out
     # block, so only the innermost fold dim must stay sequential
-    dims = None if interpret else pltpu.CompilerParams(
+    dims = None if interpret else tpu_compiler_params(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     dq = pl.pallas_call(
@@ -350,17 +352,21 @@ def flash_attention(q, k, v, causal: bool = False,
 def default_blocks(t_q: Optional[int] = None,
                    t_k: Optional[int] = None) -> tuple:
     """Flash tile sizes. Read at trace time — a jitted program bakes the
-    values it saw.
+    values it saw. Resolution order:
 
-    ``ZOO_FLASH_BLOCK_Q`` / ``ZOO_FLASH_BLOCK_K`` win when set (sweeps,
-    dev/mfu_sweep.py). Otherwise ADAPTIVE: the largest power-of-two tile
-    ≤512 that divides the sequence length — on a v5e the attention-only
-    fwd+bwd runs ~4× faster at 512×512 than at a fixed 128×128
-    (LONGCTX_BENCH.json: 55.6→14.2 ms/iter at T=16384), and at the model
-    level 512-tiles are worth ~22% MFU over 256-tiles (MFU_SWEEP.json:
-    0.538 vs 0.44 on the seq-2048 TransformerLM). Falls back to 128 when
-    the length is unknown; a non-dividing length keeps the callers'
-    existing full-attention fallback behavior."""
+    1. ``ZOO_FLASH_BLOCK_Q`` / ``ZOO_FLASH_BLOCK_K`` env (sweeps,
+       dev/mfu_sweep.py) — always wins;
+    2. the on-disk tuning cache (``ops.tuning.flash_lookup``, keyed by
+       device kind + (T_q, T_k) — populated by ``tune_flash_blocks`` /
+       ``bench.py --int8-dispatch``'s MFU sweep);
+    3. ADAPTIVE: the largest power-of-two tile ≤512 that divides the
+       sequence length — on a v5e the attention-only fwd+bwd runs ~4×
+       faster at 512×512 than at a fixed 128×128 (LONGCTX_BENCH.json:
+       55.6→14.2 ms/iter at T=16384), and at the model level 512-tiles are
+       worth ~22% MFU over 256-tiles (MFU_SWEEP.json: 0.538 vs 0.44 on the
+       seq-2048 TransformerLM). Falls back to 128 when the length is
+       unknown; a non-dividing length keeps the callers' existing
+       full-attention fallback behavior."""
     import os
 
     def auto(t: Optional[int]) -> int:
@@ -373,6 +379,16 @@ def default_blocks(t_q: Optional[int] = None,
 
     eq = os.environ.get("ZOO_FLASH_BLOCK_Q")
     ek = os.environ.get("ZOO_FLASH_BLOCK_K")
+    if not (eq and ek):
+        try:      # tuned schedule for this device + sequence shape, if any
+            from .tuning import flash_lookup
+
+            tuned = flash_lookup(t_q, t_k)
+        except Exception:  # cache layer must never break an attention trace
+            tuned = None
+        if tuned is not None:
+            return (int(eq) if eq else tuned[0],
+                    int(ek) if ek else tuned[1])
     return (int(eq) if eq else auto(t_q), int(ek) if ek else auto(t_k))
 
 

@@ -1,0 +1,328 @@
+"""Fused-quantization pallas kernels — int8 matmul/conv with in-VMEM
+activation quantization (ROADMAP "Pallas kernel tier").
+
+Why this exists: the lax path in :mod:`ops.int8` is numerically right but
+structurally wrong for serving — XLA materializes the quantized activations
+(``round``/``clamp``/``convert`` → an int8 array the size of the input) and
+the f32 rescale as separate HBM round-trips around each ``dot_general``.  On
+a raw matmul int8 still wins (1.53×), but through the serving dispatch path
+those extra HBM passes inverted the win to 0.72× vs bf16.  Here the whole
+pipeline lives inside one kernel per layer:
+
+* the activation tile is quantized **in VMEM** (per-row abs-max over the
+  K-tile → int8 — finer granularity than the unfused per-full-row scheme, so
+  accuracy can only improve),
+* the MXU int8 dot runs per (M,N,K) tile with an int32 accumulator,
+* the per-row × per-output-channel rescale is applied on the f32 VMEM
+  accumulator, and only the final activation-dtype output block is written
+  back — no int8 or dequantized-f32 intermediate ever touches HBM.
+
+The conv variant folds the KH×KW taps into the grid: each program owns one
+(batch, output-row) pair and accumulates ``window @ W[kh,kw]`` per tap with
+per-output-pixel activation scales (one abs-max over channels per pixel —
+the granularity the unfused path in :mod:`ops.int8` now matches).
+
+Block sizes come from :mod:`ops.tuning` (on-disk autotuner cache keyed by
+device kind) with ``ZOO_INT8_BLOCK_M/N/K`` env overrides; shapes that do not
+tile fall back to the lax path (see :func:`ops.int8.int8_matmul`, the
+router).  On non-TPU backends the kernels run in interpreter mode for tests;
+production CPU inference keeps the lax path (an interpreted kernel is not a
+speedup).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pallas import kept optional: CPU-only deployments fall back to lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover - environment without pallas
+    _HAS_PALLAS = False
+
+from ..common.compat import tpu_compiler_params
+
+#: Fixed pre-autotuner schedule (the constants the tuner sweeps around).
+DEFAULT_BLOCK_M = 256
+DEFAULT_BLOCK_N = 256
+DEFAULT_BLOCK_K = 512
+
+# int8 VMEM tiling floor is (32, 128); the M dim only feeds the MXU rows so
+# 8 (the f32 sublane) is enough for the padded-M path. Interpreter mode has
+# no hardware tiling constraint but keeps a floor of 8 on N/K so the
+# tileable-vs-fallback decision CPU tests exercise mirrors the TPU one
+# (scaled down), instead of degenerating to 1-wide tiles.
+_MIN_M, _MIN_N, _MIN_K = 8, 128, 128
+_MIN_INTERPRET = 8
+
+
+def has_pallas() -> bool:
+    return _HAS_PALLAS
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def fused_mode() -> str:
+    """Routing decision for the int8 entry points: ``'compiled'`` (TPU),
+    ``'interpret'`` (forced kernels on CPU — tests/structural gates), or
+    ``'off'`` (lax path).
+
+    ``ZOO_INT8_FUSED``: ``0``/``off`` disables, ``1``/``on`` enables (kernels
+    interpret on non-TPU backends), ``interpret`` forces interpreter mode.
+    Default: compiled on TPU, off elsewhere — an interpreted kernel is
+    correctness-equal but orders of magnitude slower than the lax fallback.
+    """
+    if not _HAS_PALLAS:
+        return "off"
+    env = os.environ.get("ZOO_INT8_FUSED", "").strip().lower()
+    if env in ("0", "off", "false"):
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    if env in ("1", "on", "true"):
+        return "interpret" if _interpret_default() else "compiled"
+    return "off" if _interpret_default() else "compiled"
+
+
+def _pow2_floor(v: int) -> int:
+    return 1 << (int(v).bit_length() - 1)
+
+
+def _pow2_ceil(v: int) -> int:
+    return 1 << (int(v) - 1).bit_length() if v > 1 else 1
+
+
+def _shrink_to_divisor(dim: int, block: int, floor: int) -> Optional[int]:
+    """Largest power-of-two ≤ ``block`` that divides ``dim`` and is ≥
+    ``floor`` — None when no such tile exists (caller falls back to lax)."""
+    b = _pow2_floor(block)
+    while b >= floor:
+        if dim % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def resolve_blocks(m: int, n: int, k: int, dtype,
+                   block_m: Optional[int] = None,
+                   block_n: Optional[int] = None,
+                   block_k: Optional[int] = None,
+                   interpret: bool = False) -> Optional[Tuple[int, int, int]]:
+    """Resolve the (block_m, block_n, block_k) schedule for an (M,K)×(K,N)
+    fused matmul: explicit args win, then ``ZOO_INT8_BLOCK_M/N/K`` env, then
+    the tuning cache (per shape-bucket × dtype × device kind), then the fixed
+    defaults; every choice is shrunk to a power-of-two divisor of its dim.
+    Returns None when N or K cannot tile (M is padded by the caller)."""
+    if block_m is None or block_n is None or block_k is None:
+        env = tuple(os.environ.get(f"ZOO_INT8_BLOCK_{ax}")
+                    for ax in ("M", "N", "K"))
+        tuned = None
+        if not any(env):
+            from . import tuning
+
+            tuned = tuning.matmul_lookup(m, n, k, dtype)
+        block_m = block_m or (int(env[0]) if env[0] else None) or \
+            (tuned and tuned[0]) or DEFAULT_BLOCK_M
+        block_n = block_n or (int(env[1]) if env[1] else None) or \
+            (tuned and tuned[1]) or DEFAULT_BLOCK_N
+        block_k = block_k or (int(env[2]) if env[2] else None) or \
+            (tuned and tuned[2]) or DEFAULT_BLOCK_K
+    # M need not divide: the caller zero-pads the rows up to a block multiple
+    # (ragged shape-bucket edges); clamp near M so a tiny batch doesn't pay a
+    # full 256-row tile of padding compute
+    bm = max(min(_pow2_floor(block_m), _pow2_ceil(max(m, 1))),
+             1 if interpret else _MIN_M)
+    bn = _shrink_to_divisor(n, min(block_n, n),
+                            _MIN_INTERPRET if interpret else _MIN_N)
+    bk = _shrink_to_divisor(k, min(block_k, k),
+                            _MIN_INTERPRET if interpret else _MIN_K)
+    if bn is None or bk is None:
+        return None
+    return bm, bn, bk
+
+
+# --------------------------------------------------------------- fused matmul
+
+
+def _int8_matmul_kernel(x_ref, wq_ref, ws_ref, o_ref, acc_scr):
+    """One (block_m, block_n) output tile; grid dim 2 folds the K tiles.
+
+    Quantize the activation K-tile in VMEM (per-row abs-max), int8 MXU dot,
+    rescale the int32 partial by the per-row scale into the f32 accumulator;
+    the per-channel weight scale lands once on writeback."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    x = x_ref[...].astype(jnp.float32)                      # (bm, bk)
+    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) * (1.0 / 127.0)        # (bm, 1)
+    xq = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    part = jax.lax.dot_general(xq, wq_ref[...], (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+    acc_scr[:] += part.astype(jnp.float32) * scale
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        o_ref[...] = (acc_scr[:] * ws_ref[...]).astype(o_ref.dtype)
+
+
+def _fused_matmul_2d(x2, wq, ws_row, out_dtype, bm: int, bn: int, bk: int,
+                     interpret: bool):
+    m, k = x2.shape
+    n = wq.shape[1]
+    return pl.pallas_call(
+        _int8_matmul_kernel,
+        grid=(m // bm, n // bn, k // bk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda mi, ni, ki: (mi, ki)),
+            pl.BlockSpec((bk, bn), lambda mi, ni, ki: (ki, ni)),
+            pl.BlockSpec((1, bn), lambda mi, ni, ki: (0, ni)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda mi, ni, ki: (mi, ni)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        # the (mi, ni) dims each own a disjoint output block; only the K fold
+        # must stay sequential (it revisits the accumulator)
+        compiler_params=None if interpret else tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x2, wq, ws_row)
+
+
+def int8_matmul_fused(x: jnp.ndarray, packed: Dict[str, Any], *,
+                      block_m: Optional[int] = None,
+                      block_n: Optional[int] = None,
+                      block_k: Optional[int] = None,
+                      out_dtype=None,
+                      interpret: Optional[bool] = None
+                      ) -> Optional[jnp.ndarray]:
+    """``x @ W`` on the int8 MXU path with quantize+rescale fused into the
+    kernel. ``packed`` is ``ops.int8.quantize_weight`` of an (in, out)
+    kernel. Returns ``x.shape[:-1] + (out,)`` in ``out_dtype`` (default f32,
+    matching the unfused path), or **None** when the shape cannot tile — the
+    caller (the :func:`ops.int8.int8_matmul` router) falls back to lax."""
+    if not _HAS_PALLAS:
+        return None
+    interpret = _interpret_default() if interpret is None else interpret
+    wq = packed["q"]
+    k, n = wq.shape
+    lead = x.shape[:-1]
+    m = int(np.prod(lead)) if lead else 1
+    out_dtype = jnp.float32 if out_dtype is None else out_dtype
+    if m == 0:
+        return jnp.zeros(lead + (n,), out_dtype)
+    blocks = resolve_blocks(m, n, k, x.dtype, block_m, block_n, block_k,
+                            interpret=interpret)
+    if blocks is None:
+        return None
+    bm, bn, bk = blocks
+    x2 = x.reshape(m, k)
+    pad = (-m) % bm
+    if pad:     # ragged M (shape-bucket edges): zero rows quantize to zeros
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((pad, k), x2.dtype)], axis=0)
+    ws_row = packed["scale"].reshape(1, n).astype(jnp.float32)
+    y = _fused_matmul_2d(x2, wq, ws_row, out_dtype, bm, bn, bk, interpret)
+    if pad:
+        y = y[:m]
+    return y.reshape(lead + (n,))
+
+
+# ----------------------------------------------------------------- fused conv
+
+
+def _int8_conv_kernel(x_ref, wq_ref, ws_ref, o_ref, acc_scr, *,
+                      kw_total: int, wo: int):
+    """One (batch, output-row) pair; grid dim 2 folds the KH·KW taps.
+
+    Tap t = kh·KW + kw reads input row ``ho + kh`` (via the x BlockSpec index
+    map) and its stride-1 window ``[kw : kw+Wo]``; each output pixel's window
+    row is quantized with its own channel-abs-max scale (per-pixel
+    granularity), dotted against the tap's (Cin, Cout) int8 slice on the MXU,
+    and accumulated in f32 VMEM."""
+    t = pl.program_id(2)
+    nt = pl.num_programs(2)
+
+    @pl.when(t == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kw = jax.lax.rem(t, kw_total)
+    win = x_ref[0, 0, pl.ds(kw, wo), :].astype(jnp.float32)  # (Wo, Cin)
+    amax = jnp.max(jnp.abs(win), axis=1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) * (1.0 / 127.0)        # (Wo, 1)
+    xq = jnp.clip(jnp.round(win / scale), -127, 127).astype(jnp.int8)
+    part = jax.lax.dot_general(xq, wq_ref[0, 0], (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+    acc_scr[:] += part.astype(jnp.float32) * scale
+
+    @pl.when(t == nt - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_scr[:] * ws_ref[...]).astype(o_ref.dtype)
+
+
+def int8_conv2d_fused(x: jnp.ndarray, packed: Dict[str, Any], *,
+                      strides=(1, 1), padding="VALID", dilation=(1, 1),
+                      out_dtype=None,
+                      interpret: Optional[bool] = None
+                      ) -> Optional[jnp.ndarray]:
+    """NHWC × HWIO int8 conv with per-pixel activation quantization fused
+    into the kernel. Supports stride (1, 1) / dilation (1, 1) (the serving
+    conv shapes); anything else returns None and the router falls back to
+    the lax tap-decomposition in :mod:`ops.int8` — same per-pixel math."""
+    if not _HAS_PALLAS:
+        return None
+    if tuple(strides) != (1, 1) or tuple(dilation) != (1, 1):
+        return None
+    interpret = _interpret_default() if interpret is None else interpret
+    wq = packed["q"]
+    kh, kw, cin, cout = wq.shape
+    out_dtype = jnp.float32 if out_dtype is None else out_dtype
+    if isinstance(padding, str) and padding.upper() == "SAME":
+        pads = jax.lax.padtype_to_pads(x.shape[1:3], (kh, kw), (1, 1),
+                                       "SAME")
+        x = jnp.pad(x, ((0, 0),) + tuple(pads) + ((0, 0),))
+    elif not isinstance(padding, str):
+        x = jnp.pad(x, ((0, 0),) + tuple(tuple(p) for p in padding)
+                    + ((0, 0),))
+    b, h, w, _ = x.shape
+    ho, wo = h - kh + 1, w - kw + 1
+    if b == 0 or ho <= 0 or wo <= 0:
+        return None
+    ws_row = packed["scale"].reshape(1, cout).astype(jnp.float32)
+    kernel = functools.partial(_int8_conv_kernel, kw_total=kw, wo=wo)
+    y = pl.pallas_call(
+        kernel,
+        grid=(b, ho, kh * kw),
+        in_specs=[
+            # one full input row per program; the tap index selects which
+            # (block-size-1 ⇒ index == element offset along H)
+            pl.BlockSpec((1, 1, w, cin),
+                         lambda bi, hi, t: (bi, hi + t // kw, 0, 0)),
+            pl.BlockSpec((1, 1, cin, cout),
+                         lambda bi, hi, t: (t // kw, t % kw, 0, 0)),
+            pl.BlockSpec((1, cout), lambda bi, hi, t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, wo, cout),
+                               lambda bi, hi, t: (bi, hi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, ho, wo, cout), out_dtype),
+        scratch_shapes=[pltpu.VMEM((wo, cout), jnp.float32)],
+        compiler_params=None if interpret else tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, wq, ws_row)
+    return y

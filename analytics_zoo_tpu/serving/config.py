@@ -21,7 +21,12 @@ class ServingConfig:
     queue_host: str = "127.0.0.1"        # redis/host parity
     queue_port: int = 6380               # redis/port parity
     top_n: Optional[int] = None          # postprocessing topN
-    int8: bool = False                   # OpenVINO-int8 capability
+    int8: bool = False                   # OpenVINO-int8 capability; packing
+                                         # happens at engine start() (warmup),
+                                         # never on the first request
+    warmup_shape: Optional[tuple] = None # per-record input shape (no batch
+                                         # dim): engine start() pre-compiles
+                                         # the bucket ladder for it
     log_dir: Optional[str] = None        # InferenceSummary TB dir
     # --- resilience (common.resilience wiring) ---
     infer_workers: int = 1               # model-worker threads; dead ones are
@@ -65,6 +70,8 @@ class ServingConfig:
         tn = raw.get("top_n", post.get("topN"))
         flat["top_n"] = int(tn) if tn is not None else None
         flat["int8"] = bool(raw.get("int8", model.get("int8", False)))
+        ws = raw.get("warmup_shape", model.get("warmup_shape"))
+        flat["warmup_shape"] = tuple(int(d) for d in ws) if ws else None
         flat["log_dir"] = raw.get("log_dir")
         for key in ("infer_workers", "heartbeat_timeout_s",
                     "http_max_inflight", "breaker_failure_threshold",

@@ -666,6 +666,240 @@ def run_data_pipeline(platform: str | None = None, n_records: int = 1024,
     }
 
 
+def fused_dispatch_structure(im, x) -> dict:
+    """Structural no-unfused-quantize-op audit of an ``InferenceModel``'s
+    dispatch computation with the fused int8 kernel tier forced on.
+
+    Walks the jaxpr of the exact computation ``predict`` compiles and
+    asserts the fused invariants the timing win rests on (CPU-checkable —
+    this is what ``--int8-dispatch --quick`` gates so the 0.72× regression
+    can't silently return):
+
+    * ≥1 ``pallas_call`` (the fused kernels actually dispatched);
+    * no standalone quantize ops (``round``/``clamp``) outside kernel
+      bodies — the unfused path's HBM-materialized activation quantization;
+    * no int8 intermediate produced outside kernel bodies (weights ENTER as
+      int8 arguments; nothing int8 may be computed between ops, which is
+      exactly an int8 tensor round-tripping HBM).
+    """
+    import jax
+
+    apply, params, state = im.device_apply()
+    jaxpr = jax.make_jaxpr(lambda p, s, xx: apply(p, s, xx))(params, state, x)
+    counts = {"pallas_calls": 0, "quantize_ops_outside_kernels": 0,
+              "int8_intermediates_outside_kernels": 0}
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                counts["pallas_calls"] += 1
+                continue                    # kernel body = VMEM, not HBM
+            if eqn.primitive.name in ("round", "clamp"):
+                counts["quantize_ops_outside_kernels"] += 1
+            for v in eqn.outvars:
+                if str(getattr(v.aval, "dtype", "")) == "int8":
+                    counts["int8_intermediates_outside_kernels"] += 1
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    counts["fused_invariants_hold"] = bool(
+        counts["pallas_calls"] >= 1
+        and counts["quantize_ops_outside_kernels"] == 0
+        and counts["int8_intermediates_outside_kernels"] == 0)
+    return counts
+
+
+def run_int8_dispatch(hidden: Optional[int] = None,
+                      batch: Optional[int] = None,
+                      iters: Optional[int] = None) -> dict:
+    """Raw-matmul vs through-dispatch int8/bf16 ratios (ISSUE 6 acceptance).
+
+    The regression this guards: int8 measured 1.53× on a bare matmul but
+    0.72× through the serving dispatch path — the unfused activation
+    quantize/rescale ran as separate HBM round-trips around each dot. With
+    the fused kernel tier the through-dispatch ratio must stay within 0.85×
+    of the raw ratio. Three measurements, identical timing discipline:
+
+    * ``raw``: device-resident chained matmul loop, bf16 vs int8;
+    * ``dispatch``: ``InferenceModel.predict`` end-to-end (pad + executable
+      lookup + transfers), bf16 vs quantized;
+    * ``structure``: the :func:`fused_dispatch_structure` jaxpr audit with
+      the fused tier forced on (the CPU-checkable invariant).
+
+    On TPU the fused tier is autotuned first (``ops.tuning``) so dispatch
+    runs tuned blocks; the sweep winner rides the artifact.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    _enable_persistent_compile_cache()
+
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+    from analytics_zoo_tpu.nn.module import compute_dtype, set_policy
+    from analytics_zoo_tpu.ops import int8 as int8_ops
+    from analytics_zoo_tpu.ops import tuning
+
+    on_tpu = jax.default_backend() == "tpu"
+    hidden = hidden or (8192 if on_tpu else 512)
+    batch = batch or (8192 if on_tpu else 256)
+    iters = iters or (30 if on_tpu else 5)
+    from analytics_zoo_tpu.ops.int8_fused import fused_mode
+
+    rng = np.random.default_rng(3)
+    out: dict = {"metric": "int8 dispatch vs raw-matmul ratio",
+                 "hidden": hidden, "batch": batch, "iters": iters,
+                 "platform": jax.default_backend(),
+                 # the routing mode the raw/dispatch TIMINGS run under (the
+                 # structural audit below forces its own, recorded separately)
+                 "fused_mode": fused_mode(), "tuning": None}
+
+    # --- autotune the fused schedule for this shape bucket (TPU) ----------
+    if on_tpu:
+        try:
+            out["tuning"] = tuning.tune_int8_matmul(
+                batch, hidden, hidden, dtype=jnp.bfloat16)
+        except Exception as e:
+            print(f"[bench] int8 tuning sweep failed: {e}", file=sys.stderr)
+    else:
+        out["tuning"] = {"skipped": "tuned on TPU only (interpreter probe "
+                                    "timing carries no signal)"}
+
+    # --- raw matmul: device-resident chained loop -------------------------
+    x_np = rng.normal(size=(batch, hidden)).astype(np.float32)
+    w_np = rng.normal(size=(hidden, hidden)).astype(np.float32)
+    packed = int8_ops.quantize_weight(w_np)
+    packed = {"q": jax.device_put(packed["q"]),
+              "scale": jax.device_put(packed["scale"])}
+    x_bf = jax.device_put(jnp.asarray(x_np, jnp.bfloat16))
+    w_bf = jax.device_put(jnp.asarray(w_np, jnp.bfloat16))
+
+    def timed_loop(step_fn, *args) -> float:
+        def loop(*a):
+            def body(_, carry):
+                xc, acc = carry
+                y = step_fn(xc, *a[1:])
+                # serialize iterations: next input depends on this output by
+                # an amount too small to change values but opaque to DCE
+                eps = jnp.max(y.astype(jnp.float32)) * 1e-30
+                return (a[0] + eps.astype(a[0].dtype), acc + eps)
+
+            _, acc = jax.lax.fori_loop(0, iters, body,
+                                       (a[0], jnp.float32(0)))
+            return acc
+
+        compiled = jax.jit(loop).lower(*args).compile()
+        float(compiled(*args))              # warm, device-resident
+        t0 = time.perf_counter()
+        float(compiled(*args))
+        return (time.perf_counter() - t0) / iters
+
+    raw_bf16_s = timed_loop(
+        lambda xc, w: jax.lax.dot(xc, w,
+                                  preferred_element_type=jnp.float32),
+        x_bf, w_bf)
+    raw_int8_s = timed_loop(
+        lambda xc: int8_ops.int8_matmul(xc, packed, out_dtype=jnp.bfloat16),
+        x_bf)
+    out["raw"] = {"bf16_ms": round(raw_bf16_s * 1e3, 3),
+                  "int8_ms": round(raw_int8_s * 1e3, 3),
+                  "int8_over_bf16": round(raw_bf16_s / raw_int8_s, 3)}
+
+    # --- through-dispatch: the InferenceModel predict path ----------------
+    def build_im():
+        m = Sequential([
+            L.Dense(hidden, activation="relu", input_shape=(hidden,)),
+            L.Dense(hidden, activation="relu"),
+            L.Dense(128, activation="softmax"),
+        ])
+        m.compile(optimizer="sgd", loss="mse")
+        xw = rng.normal(size=(32, hidden)).astype(np.float32)
+        m.fit(xw, np.zeros((32, 128), np.float32), batch_size=32, nb_epoch=1)
+        return InferenceModel(max_batch_size=batch).load(m)
+
+    def measure_dispatch(im):
+        n = max(2, min(iters, 5)) if x_np.nbytes > 2 ** 26 else iters
+        im.predict(x_np)                    # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            y = im.predict(x_np)
+        return (time.perf_counter() - t0) / n, y
+
+    prev = compute_dtype()
+    set_policy(compute_dtype="bfloat16")
+    try:
+        im_f = build_im()
+        disp_bf16_s, y_f = measure_dispatch(im_f)
+        im_q = build_im().quantize_int8()
+        disp_int8_s, y_q = measure_dispatch(im_q)
+    finally:
+        set_policy(compute_dtype=prev)
+    y_f = np.asarray(y_f, np.float32)
+    y_q = np.asarray(y_q, np.float32)
+    out["dispatch"] = {
+        "bf16_ms": round(disp_bf16_s * 1e3, 3),
+        "int8_ms": round(disp_int8_s * 1e3, 3),
+        "int8_over_bf16": round(disp_bf16_s / disp_int8_s, 3),
+        "argmax_agreement": float((y_f.argmax(-1) == y_q.argmax(-1)).mean()),
+        "max_prob_diff": round(float(np.max(np.abs(y_f - y_q))), 5),
+        "quantize_seconds": im_q.compile_stats()["quantize_seconds"],
+    }
+    out["dispatch_over_raw"] = round(
+        out["dispatch"]["int8_over_bf16"] / out["raw"]["int8_over_bf16"], 3)
+
+    # --- structural audit: fused tier forced on (CPU-checkable) ----------
+    env_prev = os.environ.get("ZOO_INT8_FUSED")
+    os.environ["ZOO_INT8_FUSED"] = "1" if on_tpu else "interpret"
+    try:
+        out["structure_mode"] = fused_mode()
+        out["structure"] = fused_dispatch_structure(
+            im_q, jnp.asarray(x_np[: min(batch, 8)]))
+    finally:
+        if env_prev is None:
+            os.environ.pop("ZOO_INT8_FUSED", None)
+        else:
+            os.environ["ZOO_INT8_FUSED"] = env_prev
+    return out
+
+
+def run_mfu_batch_sweep(batches=(4, 16), seq_len: int = 2048,
+                        hidden: int = 1024, n_block: int = 8) -> dict:
+    """MFU at the production batch points {4, 16} with TUNED flash blocks
+    (ISSUE 6: MFU collapsed 0.53→0.18 going batch 4→16 under the fixed
+    block schedule). Tunes the flash (block_q, block_k) schedule for this
+    sequence shape first (persisted in the ops.tuning cache, so the model
+    layer's ``default_blocks`` picks it up at trace time), then measures
+    each batch via ``run_transformer_mfu`` — whose OOM ladder already
+    retries under ``FLASH_REMAT_POLICY`` when the plain variant doesn't
+    fit. Requires an accelerator: interpret-mode MFU carries no signal."""
+    import jax
+
+    from analytics_zoo_tpu.ops import tuning
+
+    if jax.default_backend() == "cpu":
+        return {"skipped": "requires accelerator (interpret-mode MFU "
+                           "carries no signal)"}
+    out: dict = {"seq_len": seq_len, "hidden": hidden, "n_block": n_block,
+                 "entries": {}}
+    try:
+        out["flash_tuning"] = tuning.tune_flash_blocks(
+            seq_len, seq_len, batch=2, heads=8, d=hidden // 8)
+    except Exception as e:
+        print(f"[bench] flash tuning sweep failed: {e}", file=sys.stderr)
+        out["flash_tuning"] = None
+    for b in batches:
+        try:
+            out["entries"][str(b)] = run_transformer_mfu(
+                seq_len=seq_len, batch=b, hidden=hidden, n_block=n_block)
+        except Exception as e:
+            print(f"[bench] mfu batch={b} failed: {e}", file=sys.stderr)
+            out["entries"][str(b)] = {"error": str(e)[:500]}
+    return out
+
+
 def run_update_sharding(dp_sizes=(2, 4, 8), accum_steps=(1, 4),
                         steps: int = 20) -> dict:
     """ZeRO-1 weight-update-sharding micro-bench (ISSUE 5 acceptance):
@@ -913,6 +1147,62 @@ if __name__ == "__main__":
                   + ", ".join(
                       f"dp={e['dp']} opt-ratio {e['opt_state_ratio']}"
                       for e in us["entries"]), file=sys.stderr)
+        sys.exit(0)
+    if "--int8-dispatch" in sys.argv:
+        # fused-quantization kernel tier bench (ISSUE 6): raw vs dispatch
+        # int8/bf16 ratios + structural audit + MFU at batch {4,16} with
+        # tuned blocks; artifact -> KERNEL_BENCH.json. Quick mode is pinned
+        # by the caller (run_serving_bench.sh exports JAX_PLATFORMS=cpu);
+        # full mode probes the accelerator like every other entry so a
+        # wedged tunnel can't hang the run in PJRT init.
+        if "--quick" not in sys.argv and not _wait_for_accelerator():
+            print("[bench] accelerator unreachable; int8-dispatch falling "
+                  "back to cpu (structural audit only carries signal)",
+                  file=sys.stderr)
+            import jax as _jax
+
+            _jax.config.update("jax_platforms", "cpu")
+        kb = run_int8_dispatch()
+        try:
+            kb["mfu_sweep"] = run_mfu_batch_sweep()
+        except Exception as e:   # additive entry; never break the gate run
+            print(f"[bench] mfu sweep failed: {e}", file=sys.stderr)
+            kb["mfu_sweep"] = {"error": str(e)[:500]}
+        if "--quick" not in sys.argv:
+            # quick mode is the CI gate and, like the serving quick gate,
+            # never touches the committed artifact (a CPU quick run must not
+            # clobber TPU-measured ratios/MFU)
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "KERNEL_BENCH.json"), "w") as f:
+                json.dump(kb, f, indent=1)
+        print(json.dumps(kb))
+        if "--quick" in sys.argv:
+            st = kb["structure"]
+            # structural gate (CPU-checkable): the fused dispatch path must
+            # contain pallas kernels and NO standalone quantize ops / int8
+            # HBM intermediates — the shape of the 0.72x regression
+            assert st["fused_invariants_hold"], (
+                f"fused-dispatch invariants violated: {st}")
+            # the bench model is UNTRAINED (near-uniform 128-class softmax:
+            # argmax sits on a knife's edge), so the accuracy gate here is
+            # deliberately loose; the reference-grade <0.1% disagreement bar
+            # lives in tests/test_inference.py on a shaped model
+            assert kb["dispatch"]["argmax_agreement"] >= 0.95, (
+                f"int8 dispatch disagrees with bf16: {kb['dispatch']}")
+            if kb["platform"] == "tpu":
+                # timing gates only where the MXU int8 path is real:
+                # dispatch must keep >= 0.85x of the raw-matmul win, and
+                # batch-16 MFU must beat the recorded 0.18 collapse
+                assert kb["dispatch_over_raw"] >= 0.85, (
+                    f"dispatch ratio {kb['dispatch']['int8_over_bf16']} < "
+                    f"0.85x raw {kb['raw']['int8_over_bf16']}")
+                m16 = (kb.get("mfu_sweep", {}).get("entries", {})
+                       .get("16", {}).get("mfu"))
+                assert m16 is None or m16 > 0.18, (
+                    f"batch-16 MFU {m16} not above the recorded 0.18")
+            print("[bench] int8-dispatch quick gate OK: "
+                  f"pallas_calls={st['pallas_calls']}, dispatch/raw="
+                  f"{kb['dispatch_over_raw']}", file=sys.stderr)
         sys.exit(0)
     if "--data-pipeline" in sys.argv:
         # standalone input-pipeline micro-bench, ALWAYS on the CPU backend:

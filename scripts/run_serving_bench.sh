@@ -12,7 +12,15 @@
 #                                           # format and contains the
 #                                           # request-span histogram
 #                                           # (zoo_span_duration_seconds);
-#                                           # never writes the artifact
+#                                           # then gates the int8 kernel tier
+#                                           # structurally (bench.py
+#                                           # --int8-dispatch --quick: fused
+#                                           # dispatch contains pallas calls,
+#                                           # no standalone quantize ops / no
+#                                           # int8 HBM intermediates — the
+#                                           # shape of the 0.72x dispatch
+#                                           # regression); never writes the
+#                                           # artifacts
 #
 # SERVING_BENCH_TIMEOUT (seconds, default 900) caps the run so a wedged
 # accelerator tunnel can never hang CI.
@@ -21,7 +29,11 @@ cd "$(dirname "$0")/.."
 
 TIMEOUT="${SERVING_BENCH_TIMEOUT:-900}"
 if [[ "${1:-}" == "--quick" ]]; then
-    exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
         python serving_bench.py --quick
+    # int8 kernel-tier structural gate (writes KERNEL_BENCH.json for the
+    # CPU leg; the TPU run overwrites it with real ratios + MFU)
+    exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+        python bench.py --int8-dispatch --quick
 fi
 exec timeout -k 10 "$TIMEOUT" python serving_bench.py "$@"

@@ -1,0 +1,380 @@
+"""Fused-quantization pallas kernel tier tests (interpret mode on CPU).
+
+Differential coverage: the fused int8 matmul/conv kernels
+(ops/int8_fused.py) vs the unfused lax oracle (ops/int8.py) and vs f32;
+the structural no-unfused-quantize-op invariant of the fused dispatch path
+(the jaxpr audit ``bench.fused_dispatch_structure`` that the serving quick
+gate runs); the block-schedule tuning cache (ops/tuning.py); and the
+serving-engine startup warmup that moved int8 packing off the first
+request. All CPU-safe (pallas interpreter) — these run in tier-1.
+"""
+
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.ops import int8 as int8_ops
+from analytics_zoo_tpu.ops import int8_fused, tuning
+from analytics_zoo_tpu.ops.int8 import quantize_weight
+
+pytestmark = pytest.mark.pallas
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "zoo_bench", os.path.join(os.path.dirname(__file__), "..",
+                                  "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _packed(w):
+    return {k: jnp.asarray(v) for k, v in quantize_weight(w).items()}
+
+
+@pytest.fixture()
+def fused_interpret(monkeypatch):
+    """Force the router onto the fused kernels (interpreter on CPU)."""
+    monkeypatch.setenv("ZOO_INT8_FUSED", "interpret")
+
+
+@pytest.fixture()
+def tuning_cache(tmp_path, monkeypatch):
+    """Isolated on-disk tuning cache per test."""
+    path = str(tmp_path / "tuning.json")
+    monkeypatch.setenv("ZOO_TPU_TUNING_CACHE", path)
+    tuning.invalidate()
+    yield path
+    tuning.invalidate()
+
+
+# ------------------------------------------------------------ matmul numerics
+
+
+def test_fused_matmul_matches_unfused_and_f32(np_rng):
+    x = (np_rng.normal(size=(16, 96)) * 3).astype(np.float32)
+    w = np_rng.normal(size=(96, 48)).astype(np.float32)
+    packed = _packed(w)
+    ref = np.asarray(int8_ops.int8_matmul_unfused(jnp.asarray(x), packed))
+    fused = int8_fused.int8_matmul_fused(
+        jnp.asarray(x), packed, block_m=8, block_n=16, block_k=32,
+        interpret=True)
+    assert fused is not None and fused.shape == (16, 48)
+    f32 = x @ w
+    scale = np.max(np.abs(f32))
+    # int8 quantization error bound vs exact f32 (per-K-tile scales are a
+    # FINER granularity than the unfused per-row scheme, so the fused error
+    # may differ from — but not exceed the class of — the unfused one)
+    assert np.max(np.abs(np.asarray(fused) - f32)) / scale < 0.03
+    assert np.max(np.abs(ref - f32)) / scale < 0.03
+    # and the two int8 schemes agree with each other to quant-error scale
+    assert np.max(np.abs(np.asarray(fused) - ref)) / scale < 0.03
+
+
+def test_fused_matmul_bf16_activation(np_rng):
+    x = np_rng.normal(size=(8, 64)).astype(np.float32)
+    w = np_rng.normal(size=(64, 32)).astype(np.float32)
+    packed = _packed(w)
+    y = int8_fused.int8_matmul_fused(
+        jnp.asarray(x, jnp.bfloat16), packed, block_m=8, block_n=16,
+        block_k=32, out_dtype=jnp.bfloat16, interpret=True)
+    assert y.dtype == jnp.bfloat16
+    f32 = x @ w
+    assert (np.max(np.abs(np.asarray(y, np.float32) - f32))
+            / np.max(np.abs(f32)) < 0.05)
+
+
+def test_fused_matmul_ragged_and_empty_batch(np_rng):
+    """Shape-bucket edges: M smaller than a block (zero-pad rows) and the
+    empty batch both go through without touching the lax fallback."""
+    w = np_rng.normal(size=(64, 32)).astype(np.float32)
+    packed = _packed(w)
+    x = np_rng.normal(size=(3, 64)).astype(np.float32)
+    y = int8_fused.int8_matmul_fused(
+        jnp.asarray(x), packed, block_m=8, block_n=16, block_k=32,
+        interpret=True)
+    full = int8_fused.int8_matmul_fused(
+        jnp.asarray(np.concatenate([x, np.zeros((5, 64), np.float32)])),
+        packed, block_m=8, block_n=16, block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(full)[:3],
+                               rtol=0, atol=1e-5)
+    empty = int8_fused.int8_matmul_fused(
+        jnp.zeros((0, 64), jnp.float32), packed, interpret=True)
+    assert empty.shape == (0, 32)
+
+
+def test_fused_matmul_3d_leading_dims(np_rng):
+    x = np_rng.normal(size=(2, 4, 64)).astype(np.float32)
+    w = np_rng.normal(size=(64, 16)).astype(np.float32)
+    packed = _packed(w)
+    y = int8_fused.int8_matmul_fused(
+        jnp.asarray(x), packed, block_m=8, block_n=16, block_k=32,
+        interpret=True)
+    assert y.shape == (2, 4, 16)
+    f32 = x @ w
+    assert np.max(np.abs(np.asarray(y) - f32)) / np.max(np.abs(f32)) < 0.03
+
+
+def test_router_falls_back_when_untileable(fused_interpret, np_rng):
+    """K that no power-of-two tile divides → int8_matmul silently uses the
+    lax path (identical results, no crash)."""
+    x = np_rng.normal(size=(4, 33)).astype(np.float32)
+    w = np_rng.normal(size=(33, 7)).astype(np.float32)
+    packed = _packed(w)
+    y = int8_ops.int8_matmul(jnp.asarray(x), packed)
+    ref = int8_ops.int8_matmul_unfused(jnp.asarray(x), packed)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-6)
+
+
+def test_router_disabled_by_env(monkeypatch, np_rng):
+    monkeypatch.setenv("ZOO_INT8_FUSED", "0")
+    assert int8_fused.fused_mode() == "off"
+    monkeypatch.setenv("ZOO_INT8_FUSED", "interpret")
+    assert int8_fused.fused_mode() == "interpret"
+    monkeypatch.delenv("ZOO_INT8_FUSED")
+    # default on CPU: lax path (an interpreted kernel is not a speedup)
+    assert int8_fused.fused_mode() == "off"
+
+
+# -------------------------------------------------------------- conv numerics
+
+
+@pytest.mark.parametrize("padding", ["VALID", "SAME"])
+def test_fused_conv_matches_unfused_per_pixel(padding, np_rng):
+    x = np_rng.normal(size=(2, 9, 9, 16)).astype(np.float32)
+    w = np_rng.normal(size=(3, 3, 16, 24)).astype(np.float32)
+    packed = _packed(w)
+    ref = int8_ops.int8_conv2d_unfused(jnp.asarray(x), packed,
+                                       strides=(1, 1), padding=padding)
+    fused = int8_fused.int8_conv2d_fused(jnp.asarray(x), packed,
+                                         strides=(1, 1), padding=padding,
+                                         interpret=True)
+    # same per-pixel scale scheme tap-for-tap: bit-near (f32 assoc. only)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fused_conv_rejects_strided(np_rng):
+    x = np_rng.normal(size=(1, 8, 8, 8)).astype(np.float32)
+    packed = _packed(np_rng.normal(size=(3, 3, 8, 8)).astype(np.float32))
+    assert int8_fused.int8_conv2d_fused(
+        jnp.asarray(x), packed, strides=(2, 2), padding="VALID",
+        interpret=True) is None
+
+
+@pytest.mark.parametrize("strides,dilation", [((1, 1), (1, 1)),
+                                              ((2, 2), (1, 1)),
+                                              ((1, 1), (2, 2))])
+def test_int8_conv2d_accuracy_vs_f32(strides, dilation, np_rng):
+    """Satellite: per-pixel activation scales track f32 conv within int8
+    quant error — including strided/dilated variants (lax fallback)."""
+    x = np_rng.normal(size=(2, 12, 12, 8)).astype(np.float32)
+    w = np_rng.normal(size=(3, 3, 8, 16)).astype(np.float32)
+    packed = _packed(w)
+    got = int8_ops.int8_conv2d(jnp.asarray(x), packed, strides=strides,
+                               padding="SAME", dilation=dilation)
+    want = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), strides, "SAME",
+        rhs_dilation=dilation, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    assert got.shape == want.shape
+    rel = (np.max(np.abs(np.asarray(got) - np.asarray(want)))
+           / np.max(np.abs(np.asarray(want))))
+    assert rel < 0.03, f"int8 conv rel err {rel} vs f32"
+
+
+def test_per_pixel_scales_beat_per_image_on_hdr_input(np_rng):
+    """The regression the granularity fix targets: one very bright pixel
+    used to blow up EVERY pixel's quantization step (per-image abs-max).
+    Per-pixel scales keep the rest of the image accurate."""
+    x = np_rng.normal(size=(1, 8, 8, 8)).astype(np.float32)
+    x[0, 0, 0, 0] = 500.0                      # high-dynamic-range outlier
+    w = np_rng.normal(size=(3, 3, 8, 8)).astype(np.float32)
+    packed = _packed(w)
+    want = np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")))
+
+    # the old per-image scheme, inline for comparison
+    amax = np.max(np.abs(x))
+    s_img = max(amax, 1e-12) / 127.0
+    xq = np.clip(np.round(x / s_img), -127, 127).astype(np.int8)
+    per_image = np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(xq), packed["q"], (1, 1), "VALID",
+        preferred_element_type=jnp.int32,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    ).astype(np.float32) * s_img * np.asarray(packed["scale"]).reshape(-1)
+
+    per_pixel = np.asarray(int8_ops.int8_conv2d_unfused(
+        jnp.asarray(x), packed, strides=(1, 1), padding="VALID"))
+    # compare away from the outlier's receptive field
+    sl = (0, slice(3, None), slice(3, None))
+    err_pix = np.max(np.abs(per_pixel[sl] - want[sl]))
+    err_img = np.max(np.abs(per_image[sl] - want[sl]))
+    assert err_pix < err_img / 5, (
+        f"per-pixel {err_pix} not ≪ per-image {err_img}")
+
+
+# ------------------------------------------------------- layer + model routes
+
+
+def _fitted_mlp(np_rng, hidden=64, features=32, classes=8):
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+
+    m = Sequential([
+        L.Dense(hidden, activation="relu", input_shape=(features,)),
+        L.Dense(hidden, activation="relu"),
+        L.Dense(classes, activation="softmax"),
+    ])
+    m.compile(optimizer="sgd", loss="mse")
+    x = np_rng.normal(size=(32, features)).astype(np.float32)
+    m.fit(x, np.zeros((32, classes), np.float32), batch_size=16, nb_epoch=1)
+    return m
+
+
+def test_quantized_model_fused_vs_lax_paths_agree(zoo_ctx, fused_interpret,
+                                                  np_rng, monkeypatch):
+    from analytics_zoo_tpu.inference import InferenceModel
+
+    model = _fitted_mlp(np_rng)
+    im = InferenceModel(max_batch_size=16).load(model)
+    im.quantize_int8(min_elements=64)
+    x = np_rng.normal(size=(8, 32)).astype(np.float32)
+    fused_out = im.predict(x)
+    monkeypatch.setenv("ZOO_INT8_FUSED", "0")
+    im._compiled.clear()
+    lax_out = im.predict(x)
+    np.testing.assert_allclose(fused_out, lax_out, rtol=0.05, atol=0.01)
+    assert float((fused_out.argmax(-1) == lax_out.argmax(-1)).mean()) == 1.0
+
+
+def test_fused_dispatch_structure_invariants(zoo_ctx, fused_interpret,
+                                             np_rng):
+    """The jaxpr audit the serving quick gate runs: with the fused tier on,
+    the quantized dispatch path has pallas kernels and NO standalone
+    quantize ops or int8 HBM intermediates; with it off, the unfused ops
+    are detected (the detector is falsifiable)."""
+    bench = _load_bench()
+    from analytics_zoo_tpu.inference import InferenceModel
+
+    im = InferenceModel(max_batch_size=16).load(_fitted_mlp(np_rng))
+    im.quantize_int8(min_elements=64)
+    x = jnp.asarray(np_rng.normal(size=(8, 32)).astype(np.float32))
+    st = bench.fused_dispatch_structure(im, x)
+    assert st["fused_invariants_hold"], st
+    assert st["pallas_calls"] == 3          # one per quantized Dense
+    os.environ["ZOO_INT8_FUSED"] = "0"
+    try:
+        st_off = bench.fused_dispatch_structure(im, x)
+    finally:
+        os.environ["ZOO_INT8_FUSED"] = "interpret"
+    assert not st_off["fused_invariants_hold"]
+    assert st_off["quantize_ops_outside_kernels"] > 0
+    assert st_off["int8_intermediates_outside_kernels"] > 0
+
+
+# -------------------------------------------------------------- tuning cache
+
+
+def test_tune_int8_matmul_persists_and_is_used(tuning_cache, np_rng):
+    best = tuning.tune_int8_matmul(
+        8, 32, 64, dtype=np.float32,
+        candidates=((8, 16, 32), (8, 32, 64)), interpret=True, iters=1)
+    assert best is not None and os.path.exists(tuning_cache)
+    looked = tuning.matmul_lookup(8, 32, 64, np.float32)
+    assert looked == (best["block_m"], best["block_n"], best["block_k"])
+    # same shape BUCKET (pow2 ladder) answers the lookup for m in (5..8]
+    assert tuning.matmul_lookup(5, 32, 64, np.float32) == looked
+    # resolve_blocks picks the tuned schedule up with no explicit blocks
+    blocks = int8_fused.resolve_blocks(8, 32, 64, np.float32,
+                                       interpret=True)
+    assert blocks == looked
+    # sweep details ride the cache entry (scored candidates + memory fields)
+    raw = tuning.lookup(tuning.MATMUL_OP,
+                        tuning.matmul_key(8, 32, 64, np.float32))
+    assert [e for e in raw["swept"] if "elapsed_ms" in e]
+
+
+def test_tuning_env_override_wins(tuning_cache, monkeypatch):
+    tuning.record(tuning.MATMUL_OP,
+                  tuning.matmul_key(8, 32, 64, np.float32),
+                  {"block_m": 8, "block_n": 16, "block_k": 32})
+    monkeypatch.setenv("ZOO_INT8_BLOCK_M", "4")
+    monkeypatch.setenv("ZOO_INT8_BLOCK_N", "32")
+    monkeypatch.setenv("ZOO_INT8_BLOCK_K", "64")
+    blocks = int8_fused.resolve_blocks(8, 32, 64, np.float32,
+                                       interpret=True)
+    assert blocks == (4, 32, 64)
+
+
+def test_tuning_counters_and_corrupt_cache(tuning_cache):
+    from analytics_zoo_tpu.common import telemetry as _tm
+
+    def counter_val(name, op):
+        fam = _tm.snapshot().get(name, {})
+        return fam.get("samples", {}).get(f'op="{op}"', 0)
+
+    tuning.matmul_lookup(8, 32, 64, np.float32)      # miss: nothing tuned
+    tuning.record(tuning.MATMUL_OP,
+                  tuning.matmul_key(8, 32, 64, np.float32),
+                  {"block_m": 8, "block_n": 16, "block_k": 32})
+    assert tuning.matmul_lookup(8, 32, 64, np.float32) == (8, 16, 32)
+    # corrupt cache file must read as empty, never raise
+    with open(tuning_cache, "w") as f:
+        f.write("{not json")
+    tuning.invalidate()
+    assert tuning.matmul_lookup(8, 32, 64, np.float32) is None
+
+
+def test_flash_default_blocks_consults_tuning_cache(tuning_cache,
+                                                    monkeypatch):
+    from analytics_zoo_tpu.ops.flash_attention import default_blocks
+
+    monkeypatch.delenv("ZOO_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.delenv("ZOO_FLASH_BLOCK_K", raising=False)
+    assert default_blocks(1024, 1024) == (512, 512)     # adaptive default
+    tuning.record(tuning.FLASH_OP,
+                  tuning.flash_key(1024, 1024, np.dtype("bfloat16")),
+                  {"block_q": 256, "block_k": 128})
+    assert default_blocks(1024, 1024) == (256, 128)     # tuned wins
+    monkeypatch.setenv("ZOO_FLASH_BLOCK_Q", "128")
+    assert default_blocks(1024, 1024) == (128, 128)     # env wins over tuned
+
+
+def test_tune_flash_blocks_sweep(tuning_cache):
+    best = tuning.tune_flash_blocks(
+        128, 128, batch=1, heads=2, d=16, causal=True, with_backward=False,
+        candidates=((32, 32), (64, 64)), interpret=True, iters=1)
+    assert best is not None
+    assert tuning.flash_lookup(128, 128) == (best["block_q"],
+                                             best["block_k"])
+
+
+# -------------------------------------------------------- engine warmup path
+
+
+def test_engine_start_owns_quantize_cost(zoo_ctx, np_rng):
+    """Satellite: int8 packing happens at engine warmup, not construction
+    and not the first request; the cost is visible in stats()."""
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.serving import ServingConfig
+    from analytics_zoo_tpu.serving.engine import ClusterServing
+
+    im = InferenceModel(max_batch_size=8).load(_fitted_mlp(np_rng))
+    cs = ClusterServing(model=im,
+                        config=ServingConfig(int8=True, warmup_shape=(32,)))
+    assert not im.is_quantized           # construction stays cheap
+    cs._warm_model()                     # what start() runs before threads
+    assert im.is_quantized
+    stats = cs.stats()
+    assert stats["quantize_seconds"] > 0
+    # the warmup predict compiled the bucket ladder: first real request is
+    # a cache hit, not a compile
+    compiles_before = im.compile_stats()["compiles"]
+    im.predict(np_rng.normal(size=(4, 32)).astype(np.float32))
+    assert im.compile_stats()["compiles"] == compiles_before
