@@ -1,0 +1,39 @@
+"""Graph-lint: rule-driven static analysis for graphs and host code.
+
+Two layers over one rule engine (:mod:`analysis.core`):
+
+* **Graph layer** (:mod:`analysis.graphlint` + :mod:`analysis.rules`) —
+  rules walk traced jaxprs and compiled HLO text and emit structured
+  findings: collective budgets (the ZeRO-1 one-reduce-scatter/one-all-gather
+  invariant), fused-int8 dispatch structure (the PR-6 no-HBM-intermediate
+  guarantee), host↔device transfers inside jitted steps, large constants
+  baked into the jaxpr, dtype-discipline leaks, and recompilation hazards.
+* **Host layer** (:mod:`analysis.astlint`) — an AST lint for the Python-side
+  hazards around the traced region: tracer leaks, wall-clock/RNG reads
+  inside jitted functions, telemetry-registry mutation outside its lock,
+  unregistered ``chaos_point`` sites. Inline suppressions:
+  ``# zoo-lint: disable=<rule> — reason``.
+
+Wired three ways: the CLI (``python -m analytics_zoo_tpu.analysis``,
+``scripts/run_lint.sh``) lints the package; ``TrainConfig.graph_checks``
+runs graph rules against the traced step at ``Estimator.fit`` start; and
+``InferenceModel``/serving warmup run the fused-dispatch rule at model-load
+time. Findings are counted into
+``zoo_analysis_findings_total{rule,severity}``.
+
+See docs/programming-guide/static-analysis.md for the rule catalog and how
+to write a rule.
+"""
+
+from .core import (Finding, GraphLintError, Rule, RuleContext, all_rules,
+                   enforce, finding, get_rule, register, report)
+from .graphlint import (SignatureTracker, lint_hlo, lint_jaxpr,
+                        lint_signatures, lint_traced, walk_eqns)
+from .astlint import lint_file, lint_package, lint_source
+
+__all__ = [
+    "Finding", "GraphLintError", "Rule", "RuleContext", "SignatureTracker",
+    "all_rules", "enforce", "finding", "get_rule", "lint_file", "lint_hlo",
+    "lint_jaxpr", "lint_package", "lint_signatures", "lint_source",
+    "lint_traced", "register", "report", "walk_eqns",
+]

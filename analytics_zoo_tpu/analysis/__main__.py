@@ -1,0 +1,71 @@
+"""CLI: lint the package's host code.
+
+    python -m analytics_zoo_tpu.analysis                # lint the package
+    python -m analytics_zoo_tpu.analysis path1 path2    # lint files/dirs
+    python -m analytics_zoo_tpu.analysis --json         # machine-readable
+    python -m analytics_zoo_tpu.analysis --list-rules   # full rule catalog
+
+Exit status: 1 when any unsuppressed error-severity finding remains, else 0
+(``scripts/run_lint.sh`` gates CI on this). Graph-layer rules need a traced
+computation and therefore run at fit/model-load/bench time, not here —
+``--list-rules`` still catalogs them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import all_rules
+from .astlint import lint_file, lint_package
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m analytics_zoo_tpu.analysis",
+        description="Graph-lint host-layer CLI (AST rules; see "
+                    "docs/programming-guide/static-analysis.md)")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint (default: the "
+                             "analytics_zoo_tpu package)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as one JSON object")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog (all layers) and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id:24s} [{rule.layer}/{rule.severity}] {rule.doc}")
+        return 0
+
+    # default target: the analytics_zoo_tpu package this module lives in
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = args.paths or [pkg_root]
+    findings, suppressed = [], 0
+    for path in paths:
+        if os.path.isdir(path):
+            fs, ns = lint_package(path)
+        else:
+            fs, ns = lint_file(path)
+        findings.extend(fs)
+        suppressed += ns
+
+    errors = [f for f in findings if f.severity == "error"]
+    if args.json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "suppressed": suppressed, "errors": len(errors)}, indent=1))
+    else:
+        for f in findings:
+            print(f)
+        print(f"[zoo-lint] {len(findings)} finding(s) "
+              f"({len(errors)} error(s)), {suppressed} suppressed",
+              file=sys.stderr)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

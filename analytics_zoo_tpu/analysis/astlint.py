@@ -1,0 +1,490 @@
+"""Host layer: AST lint for this codebase's Python-side hazards.
+
+Graph rules see what XLA sees; these rules see what XLA *can't* — bugs that
+live in the host code around the traced region:
+
+* ``tracer-leak`` — ``float()``/``int()``/``bool()``/``np.asarray``/
+  ``.item()``/``jax.device_get`` applied to a local value inside a traced
+  function. Under jit these either raise ``TracerConversionError`` at first
+  dispatch or (worse) silently force a host sync per step.
+* ``wallclock-in-jit`` — ``time.*``/``random.*``/``np.random.*``/
+  ``datetime.now`` inside a traced function: the value is frozen at trace
+  time, so the "random"/"current" value is a compile-time constant replayed
+  on every step.
+* ``telemetry-lock`` — mutation of the telemetry registry's guarded dicts
+  (``_families``/``_collectors``/``_children``) outside a ``with *_lock``
+  block (the scrape path copies under that lock; an unguarded write races
+  it).
+* ``chaos-site`` — ``chaos_point("name")`` call sites whose name is not in
+  :data:`analytics_zoo_tpu.common.chaos.KNOWN_SITES`: a typo'd site silently
+  never fires, so the chaos drill that targets it tests nothing.
+
+Traced-function detection is heuristic by construction (Python is not a
+dataflow graph): a function is considered traced when it is (a) decorated
+with ``jit``/``pmap``/a ``functools.partial(jit, ...)``, (b) passed by name
+or inline (lambda / ``functools.partial(name, ...)``) to a trace-inducing
+wrapper (``jit``, ``pmap``, ``shard_map``, ``pallas_call``, ``scan``,
+``fori_loop``, ``while_loop``, ``cond``, ``switch``, ``remat``/
+``checkpoint``, ``grad``/``value_and_grad``, ``vmap``, ``make_jaxpr``,
+``eval_shape``), or (c) defined inside such a function. False positives are
+silenced inline with a justified ``# zoo-lint: disable=<rule> — reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import (Finding, Rule, RuleContext, all_rules, finding, get_rule,
+                   register, report)
+
+_SUPPRESS_RE = re.compile(r"zoo-lint:\s*disable=([\w,-]+)")
+
+#: callables whose function-valued arguments get traced, mapped to the
+#: positional slots that actually hold functions — marking every argument
+#: would tag scan's carry / fori_loop's bounds as traced functions, and a
+#: host-side function sharing that name would false-positive the CI gate
+TRACE_WRAPPERS: Dict[str, Tuple[int, ...]] = {
+    "jit": (0,), "pmap": (0,), "shard_map": (0,), "pallas_call": (0,),
+    "scan": (0,), "remat": (0,), "checkpoint": (0,), "grad": (0,),
+    "value_and_grad": (0,), "vmap": (0,), "xmap": (0,), "make_jaxpr": (0,),
+    "eval_shape": (0,),
+    "fori_loop": (2,),            # (lower, upper, body_fun, init)
+    "while_loop": (0, 1),         # (cond_fun, body_fun, init)
+    "cond": (1, 2),               # (pred, true_fun, false_fun, *operands)
+    "switch": (1,),               # (index, branches, *operands)
+}
+#: keyword names that hold functions in the wrappers above
+_FN_KEYWORDS = frozenset(("f", "fun", "fn", "body_fun", "cond_fun",
+                          "true_fun", "false_fun", "branches", "kernel",
+                          "body"))
+
+_CAST_BUILTINS = frozenset(("float", "int", "bool", "complex"))
+_NP_BASES = frozenset(("np", "numpy", "onp"))
+_NP_MATERIALIZERS = frozenset(("asarray", "array", "ascontiguousarray"))
+_HOST_METHODS = frozenset(("item", "tolist"))
+_WALLCLOCK: Tuple[Tuple[str, Optional[frozenset]], ...] = (
+    # (base name — the chain ROOT, so jax.random stays allowed — and the
+    # attr set; None = any attribute)
+    ("time", frozenset(("time", "time_ns", "perf_counter",
+                        "perf_counter_ns", "monotonic", "monotonic_ns"))),
+    ("datetime", frozenset(("now", "utcnow", "today"))),
+    ("random", None),
+    ("uuid", frozenset(("uuid1", "uuid4"))),
+    ("os", frozenset(("urandom",))),
+)
+_LOCK_GUARDED_ATTRS = frozenset(("_families", "_collectors", "_children"))
+_MUTATING_METHODS = frozenset(("append", "pop", "clear", "update",
+                               "setdefault", "remove", "extend"))
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """``a.b.c`` -> ["a", "b", "c"] (empty when the base isn't a Name)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def _call_target_name(func: ast.AST) -> Optional[str]:
+    """Terminal callable name of ``jax.jit`` / ``jit`` / ``jax.lax.scan``."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+@dataclasses.dataclass
+class SourceArtifact:
+    """One parsed module plus the derived facts the AST rules share."""
+
+    path: str
+    src: str
+    tree: ast.Module
+    lines: List[str]
+    parents: Dict[int, ast.AST]                 # id(node) -> parent
+    traced_fns: List[ast.AST]                   # FunctionDef/Lambda nodes
+    chaos_sites: frozenset
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+
+def _build_artifact(src: str, path: str,
+                    chaos_sites: Optional[Iterable[str]]) -> SourceArtifact:
+    tree = ast.parse(src, filename=path)
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+
+    # --- pass 1: which function names / inline defs get traced ------------
+    traced_names: Set[str] = set()
+    traced_nodes: List[ast.AST] = []
+
+    def note_fn_arg(arg: ast.AST) -> None:
+        if isinstance(arg, ast.Name):
+            traced_names.add(arg.id)
+        elif isinstance(arg, ast.Lambda):
+            traced_nodes.append(arg)
+        elif isinstance(arg, (ast.List, ast.Tuple)):
+            for elt in arg.elts:        # switch's branches list
+                note_fn_arg(elt)
+        elif isinstance(arg, ast.Call):
+            # functools.partial(kernel, ...) passed inline to a wrapper
+            if _call_target_name(arg.func) == "partial" and arg.args:
+                note_fn_arg(arg.args[0])
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn_slots = TRACE_WRAPPERS.get(_call_target_name(node.func))
+            if fn_slots is not None:
+                for i in fn_slots:
+                    if i < len(node.args):
+                        note_fn_arg(node.args[i])
+                for kw in node.keywords:
+                    if kw.arg in _FN_KEYWORDS:
+                        note_fn_arg(kw.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                name = (_call_target_name(dec.func)
+                        if isinstance(dec, ast.Call)
+                        else _call_target_name(dec))
+                if name in TRACE_WRAPPERS:
+                    traced_nodes.append(node)
+                elif (isinstance(dec, ast.Call) and name == "partial"
+                        and dec.args
+                        and _call_target_name(dec.args[0]) in TRACE_WRAPPERS):
+                    traced_nodes.append(node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in traced_names:
+            traced_nodes.append(node)
+    # a def nested inside a traced function is traced too
+    expanded: List[ast.AST] = []
+    seen: Set[int] = set()
+    for fn in traced_nodes:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and id(node) not in seen:
+                seen.add(id(node))
+                expanded.append(node)
+    return SourceArtifact(path=path, src=src, tree=tree,
+                          lines=src.splitlines(), parents=parents,
+                          traced_fns=expanded,
+                          chaos_sites=frozenset(chaos_sites or ()))
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    """Parameters + names assigned inside ``fn`` (the values that are traced
+    at runtime; module globals/constants are not)."""
+    out: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (args.args + args.posonlyargs + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            out.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            tgt = getattr(node, "target", None)
+            if tgt is not None:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+    out.discard("self")
+    return out
+
+
+def _refs_local(node: ast.AST, local: Set[str]) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in local
+               for n in ast.walk(node))
+
+
+# ------------------------------------------------------------------ AST rules
+
+@register
+class TracerLeakRule(Rule):
+    id = "tracer-leak"
+    layer = "ast"
+    severity = "error"
+    doc = ("float()/int()/bool()/np.asarray/.item()/jax.device_get applied "
+           "to a local value inside a traced function — raises under jit or "
+           "forces a per-step host sync")
+
+    def check(self, art: SourceArtifact, ctx: RuleContext
+              ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn in art.traced_fns:
+            local = _local_names(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                # the call must consume a LOCAL of the traced function — a
+                # float()/np.asarray() of a module constant is trace-time
+                # static and perfectly fine
+                label = None
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in _CAST_BUILTINS:
+                    if node.args and _refs_local(node.args[0], local):
+                        label = f"{node.func.id}()"
+                elif isinstance(node.func, ast.Attribute):
+                    chain = _attr_chain(node.func)
+                    arg_is_local = bool(node.args
+                                        and _refs_local(node.args[0], local))
+                    if (len(chain) >= 2 and chain[0] in _NP_BASES
+                            and chain[-1] in _NP_MATERIALIZERS
+                            and arg_is_local):
+                        label = ".".join(chain)
+                    elif chain and chain[-1] == "device_get" \
+                            and arg_is_local:
+                        label = "jax.device_get"
+                    elif node.func.attr in _HOST_METHODS and not node.args \
+                            and _refs_local(node.func.value, local):
+                        label = f".{node.func.attr}()"
+                if label is None:
+                    continue
+                out.append(finding(
+                    self.id, self.severity,
+                    f"{art.path}:{node.lineno}",
+                    f"{label} on a traced value inside a jitted function "
+                    f"— concretizes a tracer (TracerConversionError or a "
+                    f"per-step host sync)"))
+        return out
+
+
+@register
+class WallclockRule(Rule):
+    id = "wallclock-in-jit"
+    layer = "ast"
+    severity = "error"
+    doc = ("time/random/datetime/uuid reads inside a traced function — the "
+           "value freezes at trace time and replays every step")
+
+    def check(self, art: SourceArtifact, ctx: RuleContext
+              ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn in art.traced_fns:
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                chain = _attr_chain(node.func)
+                if len(chain) < 2:
+                    continue
+                # stdlib module reads (chain ROOT match — `jax.random.*` is
+                # the trace-safe PRNG and must not match) plus np.random.*
+                hit = any(chain[0] == base and (attrs is None
+                                                or chain[-1] in attrs)
+                          for base, attrs in _WALLCLOCK)
+                hit = hit or (chain[0] in _NP_BASES and len(chain) >= 3
+                              and chain[1] == "random")
+                if hit:
+                    out.append(finding(
+                        self.id, self.severity,
+                        f"{art.path}:{node.lineno}",
+                        f"{'.'.join(chain)} inside a jitted function — "
+                        f"evaluated once at trace time, constant "
+                        f"thereafter"))
+        return out
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    """True when a ``with`` context expression is a lock: the terminal
+    name/attribute ends with ``_lock`` (``self._lock``, ``reg._scrape_lock``,
+    ``self._lock()``) — NOT a substring match over the whole expression, so
+    ``open(path + "_lock")`` doesn't count as guarded."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    chain = _attr_chain(expr)
+    return bool(chain) and chain[-1].endswith("_lock")
+
+
+@register
+class TelemetryLockRule(Rule):
+    id = "telemetry-lock"
+    layer = "ast"
+    severity = "error"
+    doc = ("mutation of a lock-guarded registry dict (_families/_collectors/"
+           "_children) outside a `with *_lock` block — races the scrape's "
+           "copy-under-lock")
+
+    def _guarded_target(self, node: ast.AST) -> Optional[str]:
+        """The watched attr when ``node`` mutates one, else None."""
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Attribute) \
+                        and t.value.attr in _LOCK_GUARDED_ATTRS:
+                    return t.value.attr
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Attribute) \
+                        and t.value.attr in _LOCK_GUARDED_ATTRS:
+                    return t.value.attr
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATING_METHODS \
+                and isinstance(node.func.value, ast.Attribute) \
+                and node.func.value.attr in _LOCK_GUARDED_ATTRS:
+            return node.func.value.attr
+        return None
+
+    def check(self, art: SourceArtifact, ctx: RuleContext
+              ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(art.tree):
+            attr = self._guarded_target(node)
+            if attr is None:
+                continue
+            under_lock = any(
+                isinstance(anc, ast.With)
+                and any(_is_lock_expr(item.context_expr)
+                        for item in anc.items)
+                for anc in art.ancestors(node))
+            if not under_lock:
+                out.append(finding(
+                    self.id, self.severity,
+                    f"{art.path}:{node.lineno}",
+                    f"mutation of lock-guarded {attr!r} outside a "
+                    f"`with *_lock` block — races the scrape path's "
+                    f"copy-under-lock"))
+        return out
+
+
+@register
+class ChaosSiteRule(Rule):
+    id = "chaos-site"
+    layer = "ast"
+    severity = "error"
+    doc = ("chaos_point() call with a site name not registered in "
+           "common.chaos.KNOWN_SITES — a typo'd site never fires and the "
+           "drill that targets it tests nothing")
+
+    def check(self, art: SourceArtifact, ctx: RuleContext
+              ) -> Iterable[Finding]:
+        if not art.chaos_sites:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(art.tree):
+            if not (isinstance(node, ast.Call)
+                    and _call_target_name(node.func) == "chaos_point"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            site = node.args[0].value
+            if site not in art.chaos_sites:
+                out.append(finding(
+                    self.id, self.severity,
+                    f"{art.path}:{node.lineno}",
+                    f"chaos_point site {site!r} is not registered in "
+                    f"common.chaos.KNOWN_SITES — register it (or fix the "
+                    f"typo) so schedules can target it"))
+        return out
+
+
+# -------------------------------------------------------------- entry points
+
+def _suppressed(f: Finding, lines: List[str]) -> bool:
+    """``# zoo-lint: disable=<rule>[,rule2]`` on the finding's line or on a
+    pure-comment line immediately above silences it (``disable=all`` too)."""
+    try:
+        lineno = int(f.location.rsplit(":", 1)[1])
+    except (IndexError, ValueError):
+        return False
+    candidates = []
+    if 1 <= lineno <= len(lines):
+        candidates.append(lines[lineno - 1])
+    if lineno >= 2 and lines[lineno - 2].lstrip().startswith("#"):
+        candidates.append(lines[lineno - 2])
+    for line in candidates:
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",")}
+            if "all" in rules or f.rule in rules:
+                return True
+    return False
+
+
+def default_chaos_sites() -> frozenset:
+    """The registered chaos sites (import kept lazy: astlint must be usable
+    on a source tree without importing it)."""
+    try:
+        from ..common.chaos import KNOWN_SITES
+
+        return frozenset(KNOWN_SITES)
+    except Exception:  # pragma: no cover - partial checkouts
+        return frozenset()
+
+
+def lint_source(src: str, path: str = "<string>",
+                chaos_sites: Optional[Iterable[str]] = None,
+                rules: Optional[Sequence[Any]] = None,
+                ) -> Tuple[List[Finding], int]:
+    """Lint one module's source. Returns ``(findings, n_suppressed)`` —
+    findings already have inline suppressions applied and are counted into
+    telemetry."""
+    sites = (frozenset(chaos_sites) if chaos_sites is not None
+             else default_chaos_sites())
+    art = _build_artifact(src, path, sites)
+    selected = (all_rules("ast") if rules is None else
+                [get_rule(r) if isinstance(r, str) else r for r in rules])
+    raw: List[Finding] = []
+    ctx = RuleContext(where=path)
+    for rule in selected:
+        if rule.layer == "ast":
+            raw.extend(rule.check(art, ctx))
+    # a node inside a nested def is reachable from BOTH its own traced_fns
+    # entry and every enclosing one (the enclosing walk is what catches
+    # closure-variable leaks) — identical findings collapse to one
+    raw = list(dict.fromkeys(raw))
+    kept = [f for f in raw if not _suppressed(f, art.lines)]
+    return report(kept), len(raw) - len(kept)
+
+
+def lint_file(path: str, **kw) -> Tuple[List[Finding], int]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, path=path, **kw)
+
+
+def lint_package(root: str, **kw) -> Tuple[List[Finding], int]:
+    """Lint every ``.py`` file under ``root`` (skips ``__pycache__``).
+    Returns ``(findings, n_suppressed)`` sorted by location."""
+    findings: List[Finding] = []
+    suppressed = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            fs, ns = lint_file(os.path.join(dirpath, fname), **kw)
+            findings.extend(fs)
+            suppressed += ns
+    findings.sort(key=lambda f: f.location)
+    return findings, suppressed

@@ -1,0 +1,188 @@
+"""Graph layer: trace/walk jaxprs, run jaxpr/HLO rules, track signatures.
+
+Entry points:
+
+* :func:`lint_traced` — trace ``fn(*args)`` with ``jax.make_jaxpr`` (no XLA
+  compile) and run the jaxpr-layer rules. This is what
+  ``TrainConfig.graph_checks`` runs at ``Estimator.fit`` start and what the
+  serving warmup runs against the quantized dispatch computation.
+* :func:`lint_jaxpr` — same, for an already-traced ``ClosedJaxpr``.
+* :func:`lint_hlo` — run the HLO-layer rules over compiled HLO text (the
+  bench gates, which need post-partitioner collective placement).
+* :class:`SignatureTracker` — runtime recompilation-hazard tracker for
+  jitted callables (fed by ``InferenceModel``/``Estimator`` dispatch keys,
+  evaluated by the ``recompile-hazard`` rule).
+
+The walker (:func:`walk_eqns`) is the one shared piece of jaxpr mechanics:
+it recurses into every sub-jaxpr carried in equation params (scan/while/cond
+bodies, shard_map, custom-vjp closures) and tags each equation with whether
+it sits inside a ``pallas_call`` kernel body (kernel bodies are VMEM — HBM
+structure rules must not look inside them) and whether it sits inside a
+``scan``/``while`` body (a collective there executes once per iteration, not
+once per step).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import (Any, Callable, Iterable, Iterator, List, NamedTuple,
+                    Optional, Sequence, Tuple)
+
+from .core import (Finding, Rule, RuleContext, all_rules, enforce, report)
+
+logger = logging.getLogger("analytics_zoo_tpu.analysis")
+
+
+class EqnSite(NamedTuple):
+    """One equation plus its structural position in the walk."""
+
+    eqn: Any                      # jax.core.JaxprEqn
+    in_kernel: bool               # inside a pallas_call body (VMEM land)
+    in_loop: bool                 # inside a scan/while body (runs per-iter)
+
+
+_LOOP_PRIMITIVES = frozenset(("scan", "while"))
+
+
+def walk_eqns(jaxpr, in_kernel: bool = False,
+              in_loop: bool = False) -> Iterator[EqnSite]:
+    """Yield every equation of ``jaxpr`` (a ``Jaxpr``, not closed) and of all
+    sub-jaxprs reachable through equation params, depth-first."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        yield EqnSite(eqn, in_kernel, in_loop)
+        sub_kernel = in_kernel or name == "pallas_call"
+        sub_loop = in_loop or name in _LOOP_PRIMITIVES
+        for sub in _sub_jaxprs(eqn):
+            yield from walk_eqns(sub, sub_kernel, sub_loop)
+
+
+def _sub_jaxprs(eqn) -> Iterator[Any]:
+    for v in eqn.params.values():
+        for sub in _as_jaxprs(v):
+            yield sub
+
+
+def _as_jaxprs(v) -> Iterator[Any]:
+    # params hold Jaxpr, ClosedJaxpr, or (nested) sequences of either
+    if hasattr(v, "jaxpr"):          # ClosedJaxpr
+        yield v.jaxpr
+    elif hasattr(v, "eqns"):         # raw Jaxpr
+        yield v
+    elif isinstance(v, (tuple, list)):
+        for item in v:
+            yield from _as_jaxprs(item)
+
+
+# --------------------------------------------------------------- entry points
+
+def _select(rules: Optional[Sequence[Any]], layer: str) -> List[Rule]:
+    if rules is None:
+        return all_rules(layer)
+    from .core import get_rule
+
+    out = []
+    for r in rules:
+        rule = get_rule(r) if isinstance(r, str) else r
+        if rule.layer == layer:
+            out.append(rule)
+    return out
+
+
+def lint_jaxpr(closed_jaxpr, ctx: Optional[RuleContext] = None,
+               rules: Optional[Sequence[Any]] = None) -> List[Finding]:
+    """Run jaxpr-layer rules over a ``ClosedJaxpr``; returns findings
+    (already counted into telemetry)."""
+    ctx = ctx or RuleContext()
+    findings: List[Finding] = []
+    for rule in _select(rules, "jaxpr"):
+        findings.extend(rule.check(closed_jaxpr, ctx))
+    return report(findings)
+
+
+def lint_traced(fn: Callable, *args, ctx: Optional[RuleContext] = None,
+                rules: Optional[Sequence[Any]] = None) -> List[Finding]:
+    """Trace ``fn(*args)`` (``jax.make_jaxpr`` — no compile, no execution)
+    and lint the result. ``args`` may be concrete arrays or ShapeDtypeStructs
+    — tracing only reads avals."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    return lint_jaxpr(closed, ctx=ctx, rules=rules)
+
+
+def lint_hlo(hlo_text: str, ctx: Optional[RuleContext] = None,
+             rules: Optional[Sequence[Any]] = None) -> List[Finding]:
+    """Run HLO-layer rules over compiled HLO (or lowered StableHLO) text."""
+    ctx = ctx or RuleContext()
+    findings: List[Finding] = []
+    for rule in _select(rules, "hlo"):
+        findings.extend(rule.check(hlo_text, ctx))
+    return report(findings)
+
+
+def lint_signatures(signatures: Iterable[Any],
+                    ctx: Optional[RuleContext] = None,
+                    rules: Optional[Sequence[Any]] = None) -> List[Finding]:
+    """Run signature-layer rules (recompilation hazards) over a recorded
+    set of dispatch signatures."""
+    ctx = ctx or RuleContext()
+    sigs = list(signatures)
+    findings: List[Finding] = []
+    for rule in _select(rules, "signatures"):
+        findings.extend(rule.check(sigs, ctx))
+    return report(findings)
+
+
+# --------------------------------------------------------- signature tracking
+
+class SignatureTracker:
+    """Recompilation-hazard tracker for one jitted callable.
+
+    ``jit`` re-traces (and XLA re-compiles) per distinct (shape, dtype)
+    signature; a dispatch site whose signature count keeps growing is
+    compiling mid-traffic — the hazard the pow2 bucket ladder exists to
+    bound. Callers :meth:`add` each dispatch key; once the distinct count
+    exceeds ``max_distinct`` the tracker flags ONCE — the
+    ``recompile-hazard`` finding is logged and counted into telemetry at
+    the crossing, never again for the same tracker.
+
+    ``max_distinct`` defaults to ``log2(max_batch)+1`` when built via
+    :meth:`for_bucket_ladder` — the executable count the ladder promises.
+    """
+
+    def __init__(self, name: str, max_distinct: int):
+        self.name = name
+        self.max_distinct = int(max_distinct)
+        self._sigs: set = set()
+        self._flagged = False
+
+    @classmethod
+    def for_bucket_ladder(cls, name: str, max_batch: int,
+                          shapes_per_bucket: int = 1) -> "SignatureTracker":
+        ladder = max_batch.bit_length() + (0 if max_batch &
+                                           (max_batch - 1) == 0 else 1)
+        return cls(name, max(1, ladder) * max(1, shapes_per_bucket))
+
+    def add(self, signature: Any) -> bool:
+        """Record one dispatch signature; returns True the single time the
+        distinct count first exceeds the bound."""
+        self._sigs.add(signature)
+        if len(self._sigs) > self.max_distinct and not self._flagged:
+            self._flagged = True
+            ctx = RuleContext(where=self.name,
+                              max_signatures=self.max_distinct)
+            for f in lint_signatures(self._sigs, ctx=ctx):
+                logger.warning("graph-lint: %s", f)
+            return True
+        return False
+
+    @property
+    def distinct(self) -> int:
+        return len(self._sigs)
+
+
+__all__ = [
+    "EqnSite", "SignatureTracker", "enforce", "lint_hlo", "lint_jaxpr",
+    "lint_signatures", "lint_traced", "walk_eqns",
+]

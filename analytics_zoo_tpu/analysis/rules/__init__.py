@@ -1,0 +1,20 @@
+"""Rule catalog: importing this package registers every shipped rule.
+
+Graph layer (jaxpr/HLO): :mod:`collectives` (ZeRO-1 collective budgets),
+:mod:`fused_int8` (the PR-6 fused-dispatch structure), :mod:`graph_hygiene`
+(host transfers, baked-in constants, dtype discipline, recompilation
+hazards). Host layer (AST): the rules live in :mod:`analysis.astlint`
+alongside their traversal machinery and are registered by this import too.
+"""
+
+from . import collectives, fused_int8, graph_hygiene  # noqa: F401
+from .. import astlint  # noqa: F401  (registers the AST rules)
+
+from .collectives import collective_counts, jaxpr_collective_counts
+from .fused_int8 import fused_dispatch_report, fused_structure_counts
+
+__all__ = [
+    "collective_counts", "collectives", "fused_dispatch_report",
+    "fused_int8", "fused_structure_counts", "graph_hygiene",
+    "jaxpr_collective_counts",
+]

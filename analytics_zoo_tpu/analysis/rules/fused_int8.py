@@ -1,0 +1,123 @@
+"""Fused-int8 dispatch structure rule: the PR-6 regression class, as a rule.
+
+The fused kernel tier's timing win rests on three structural facts about the
+computation ``InferenceModel.predict`` compiles (see ``ops/int8_fused.py``):
+the fused pallas kernels actually dispatch, no standalone quantize ops
+(``round``/``clamp`` — the unfused path's HBM-materialized activation
+quantization) run outside kernel bodies, and no int8 intermediate is
+produced outside kernel bodies (weights ENTER as int8 arguments; an int8
+tensor computed between ops is exactly an int8 round-trip through HBM).
+
+This used to live as ``bench.fused_dispatch_structure`` and only ran under
+``--int8-dispatch``; as a rule it also runs at model-load/warmup time
+(``InferenceModel.check_fused_dispatch``, the serving engine's
+``_warm_model``) so the 0.72× regression class is caught before traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+from ..core import Finding, Rule, RuleContext, register
+from ..graphlint import walk_eqns
+
+_QUANTIZE_PRIMITIVES = frozenset(("round", "clamp"))
+
+
+def fused_structure_counts(closed_jaxpr) -> Dict[str, Any]:
+    """The structural census the rule (and the bench artifact) reports:
+    pallas calls, quantize ops outside kernels, int8 intermediates outside
+    kernels, plus the combined ``fused_invariants_hold`` verdict."""
+    counts = {"pallas_calls": 0, "quantize_ops_outside_kernels": 0,
+              "int8_intermediates_outside_kernels": 0}
+    for site in walk_eqns(closed_jaxpr.jaxpr):
+        if site.eqn.primitive.name == "pallas_call":
+            counts["pallas_calls"] += 1
+            continue
+        if site.in_kernel:
+            continue            # kernel body = VMEM, not HBM
+        if site.eqn.primitive.name in _QUANTIZE_PRIMITIVES:
+            counts["quantize_ops_outside_kernels"] += 1
+        for v in site.eqn.outvars:
+            if str(getattr(v.aval, "dtype", "")) == "int8":
+                counts["int8_intermediates_outside_kernels"] += 1
+    counts["fused_invariants_hold"] = bool(
+        counts["pallas_calls"] >= 1
+        and counts["quantize_ops_outside_kernels"] == 0
+        and counts["int8_intermediates_outside_kernels"] == 0)
+    return counts
+
+
+@register
+class FusedDispatchRule(Rule):
+    """Fused-int8 dispatch structure (active when ``ctx.fused_expected``)."""
+
+    id = "fused-int8-dispatch"
+    layer = "jaxpr"
+    severity = "error"
+    doc = ("With the fused int8 tier expected on: the dispatch jaxpr must "
+           "contain pallas kernels, no standalone quantize ops, and no "
+           "int8 intermediates outside kernel bodies (the 0.72x HBM "
+           "round-trip regression shape)")
+
+    def check(self, closed_jaxpr, ctx: RuleContext) -> Iterable[Finding]:
+        if not ctx.fused_expected:
+            return []
+        c = fused_structure_counts(closed_jaxpr)
+        out: List[Finding] = []
+        if c["pallas_calls"] < 1:
+            out.append(self.emit(
+                ctx, "fused int8 tier expected but no pallas_call in the "
+                     "dispatch computation — kernels are not dispatching "
+                     "(shape fell back to lax, or routing is broken)",
+                pallas_calls=0))
+        if c["quantize_ops_outside_kernels"]:
+            out.append(self.emit(
+                ctx, f"{c['quantize_ops_outside_kernels']} standalone "
+                     f"quantize op(s) (round/clamp) outside kernel bodies — "
+                     f"activation quantization is materializing in HBM",
+                count=c["quantize_ops_outside_kernels"]))
+        if c["int8_intermediates_outside_kernels"]:
+            out.append(self.emit(
+                ctx, f"{c['int8_intermediates_outside_kernels']} int8 "
+                     f"intermediate(s) produced outside kernel bodies — "
+                     f"int8 tensors are round-tripping HBM",
+                count=c["int8_intermediates_outside_kernels"]))
+        return out
+
+
+def _trace_dispatch(im, x):
+    """Trace the exact computation ``InferenceModel.predict`` compiles."""
+    import jax
+
+    apply, params, state = im.device_apply()
+    return jax.make_jaxpr(lambda p, s, xx: apply(p, s, xx))(params, state, x)
+
+
+def lint_fused_dispatch(im, x, ctx: Optional[RuleContext] = None
+                        ) -> List[Finding]:
+    """Run the fused-dispatch rule over an ``InferenceModel``'s dispatch
+    computation (the model-load/warmup check). Returns findings."""
+    from ..graphlint import lint_jaxpr
+
+    ctx = ctx or RuleContext(where="int8.dispatch", fused_expected=True)
+    return lint_jaxpr(_trace_dispatch(im, x), ctx=ctx,
+                      rules=["fused-int8-dispatch"])
+
+
+def fused_dispatch_report(im, x, ctx: Optional[RuleContext] = None
+                          ) -> Dict[str, Any]:
+    """Audit an ``InferenceModel``'s dispatch computation with the fused
+    tier expected on: traces ``im.device_apply()`` on ``x`` and returns the
+    structural counts plus the rule findings (``"findings"``, as dicts).
+
+    This is the bench's ``--int8-dispatch`` structure entry (the old
+    ``bench.fused_dispatch_structure``, now on the shared engine)."""
+    from ..graphlint import lint_jaxpr
+
+    closed = _trace_dispatch(im, x)
+    ctx = ctx or RuleContext(where="int8.dispatch", fused_expected=True)
+    findings = lint_jaxpr(closed, ctx=ctx, rules=["fused-int8-dispatch"])
+    out = fused_structure_counts(closed)
+    out["findings"] = [f.as_dict() for f in findings]
+    return out

@@ -1,0 +1,159 @@
+"""Graph hygiene rules: host round-trips, baked-in weights, dtype leaks,
+recompilation hazards.
+
+These are the "slow but correct" hazards — nothing crashes, the profile just
+quietly decays:
+
+* a host callback inside a jitted step serializes the device pipeline on a
+  host round-trip every step;
+* a large constant baked into the jaxpr (weights captured by closure instead
+  of passed as arguments) is re-uploaded per executable, bloats the
+  serialized program, and defeats donation;
+* an f32 matmul inside a declared-bf16 region runs the MXU at half rate; a
+  silent f64 promotion runs it off the MXU entirely;
+* a jitted callable whose distinct (shape, dtype) signatures outgrow the
+  pow2 bucket ladder compiles mid-traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Sequence
+
+import numpy as np
+
+from ..core import Finding, Rule, RuleContext, register
+from ..graphlint import walk_eqns
+
+# primitives that force a host round-trip (host callback) mid-program.
+# NOT listed: "device_put" — jnp.asarray of ANY trace-time constant stages
+# one (it is constant placement, done once at compile, not a per-dispatch
+# transfer); the harmful case (a large closure-captured array) is exactly
+# what the large-constant rule flags.
+_HOST_PRIMITIVES = frozenset((
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback_call", "outside_call",
+))
+# MXU contraction ops the compute-dtype policy is supposed to govern
+_MXU_PRIMITIVES = frozenset(("dot_general", "conv_general_dilated"))
+
+
+@register
+class HostTransferRule(Rule):
+    """Host↔device transfers / host callbacks inside a jitted computation."""
+
+    id = "host-transfer"
+    layer = "jaxpr"
+    severity = "error"
+    doc = ("Host callbacks (pure/io/debug_callback) inside a jitted step — "
+           "every dispatch pays a host round-trip that serializes the "
+           "device pipeline")
+
+    def check(self, closed_jaxpr, ctx: RuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for site in walk_eqns(closed_jaxpr.jaxpr):
+            if site.in_kernel:
+                continue
+            name = site.eqn.primitive.name
+            if name in _HOST_PRIMITIVES:
+                out.append(self.emit(
+                    ctx, f"{name} inside the traced computation — host "
+                         f"round-trip on every dispatch"
+                         + (" (inside a loop body: per-iteration!)"
+                            if site.in_loop else ""),
+                    primitive=name, in_loop=site.in_loop))
+        return out
+
+
+@register
+class LargeConstantRule(Rule):
+    """Large arrays baked into the jaxpr as constants."""
+
+    id = "large-constant"
+    layer = "jaxpr"
+    severity = "error"
+    doc = ("Constants >= const_bytes_limit baked into the traced program "
+           "(weights captured by closure instead of passed as arguments): "
+           "re-uploaded per executable, undonatable, bloats the program")
+
+    def check(self, closed_jaxpr, ctx: RuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for const in closed_jaxpr.consts:
+            nbytes = getattr(const, "nbytes", None)
+            if nbytes is None:
+                try:
+                    nbytes = np.asarray(const).nbytes
+                except Exception:
+                    continue
+            if nbytes >= ctx.const_bytes_limit:
+                shape = tuple(getattr(const, "shape", ()))
+                dtype = str(getattr(const, "dtype", type(const).__name__))
+                out.append(self.emit(
+                    ctx, f"constant {dtype}{shape} ({nbytes} bytes) baked "
+                         f"into the jaxpr — pass it as an argument instead "
+                         f"of capturing it by closure",
+                    nbytes=int(nbytes), shape=shape, dtype=dtype))
+        return out
+
+
+@register
+class DtypeDisciplineRule(Rule):
+    """bf16-region f32 compute leaks and silent f64 promotion."""
+
+    id = "dtype-discipline"
+    layer = "jaxpr"
+    severity = "warning"
+    doc = ("f32 MXU ops inside a declared-bf16 region (half-rate matmuls) "
+           "and silent f64 promotion anywhere (error)")
+
+    def check(self, closed_jaxpr, ctx: RuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        want_bf16 = str(ctx.compute_dtype or "") in ("bfloat16", "bf16")
+        f32_mxu = 0
+        for site in walk_eqns(closed_jaxpr.jaxpr):
+            if site.in_kernel:
+                continue
+            eqn = site.eqn
+            for v in eqn.outvars:
+                if str(getattr(v.aval, "dtype", "")) == "float64":
+                    out.append(self.emit(
+                        ctx, f"float64 output of {eqn.primitive.name} — "
+                             f"silent f64 promotion (runs off the MXU)",
+                        severity="error", primitive=eqn.primitive.name))
+                    break
+            if want_bf16 and eqn.primitive.name in _MXU_PRIMITIVES:
+                in_dts = {str(getattr(v, "aval", None) and v.aval.dtype)
+                          for v in eqn.invars
+                          if getattr(v, "aval", None) is not None}
+                if in_dts and in_dts <= {"float32"}:
+                    f32_mxu += 1
+        if f32_mxu:
+            out.append(self.emit(
+                ctx, f"{f32_mxu} f32 contraction op(s) inside a "
+                     f"declared-bfloat16 region — the compute-dtype policy "
+                     f"is not reaching them (half-rate MXU)",
+                count=f32_mxu))
+        return out
+
+
+@register
+class RecompileRule(Rule):
+    """Distinct dispatch signatures vs the bucket-ladder bound."""
+
+    id = "recompile-hazard"
+    layer = "signatures"
+    severity = "warning"
+    doc = ("A jitted callable's distinct (shape, dtype) signatures exceed "
+           "the pow2 bucket-ladder bound — it is compiling mid-traffic")
+
+    def check(self, signatures: Sequence[Any],
+              ctx: RuleContext) -> Iterable[Finding]:
+        if ctx.max_signatures is None:
+            return []
+        n = len(set(signatures))
+        if n <= ctx.max_signatures:
+            return []
+        return [self.emit(
+            ctx, f"{n} distinct dispatch signatures exceed the bucket-"
+                 f"ladder bound of {ctx.max_signatures} — this callable "
+                 f"recompiles under live traffic (bucket/pad its inputs)",
+            distinct=n, bound=ctx.max_signatures)]

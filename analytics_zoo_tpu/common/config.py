@@ -167,6 +167,15 @@ class TrainConfig:
                                             # per-leaf GSPMD placement otherwise;
                                             # "flat"/"gspmd" force a path. See
                                             # parallel/update_sharding.py
+    graph_checks: Optional[str] = None      # trace-time static analysis of the
+                                            # train step at fit() start
+                                            # (analysis/ graph rules: collective
+                                            # budget under update_sharding,
+                                            # host transfers, large baked-in
+                                            # constants, dtype discipline).
+                                            # None/"off" = skip; "warn" = log
+                                            # findings; "raise" = GraphLintError
+                                            # on error-severity findings
     async_checkpoint: bool = True           # snapshot-then-write for trigger-based
                                             # mid-epoch saves: the hot loop pays only
                                             # the device→host snapshot; serialization+

@@ -282,6 +282,9 @@ class MetricFamily:
         self._lock = threading.Lock()
         self._children: Dict[Tuple[str, ...], Any] = {}
         if not self.label_names:         # unlabeled: the family IS the child
+            # constructor-time write: the family is not yet published to the
+            # registry, so no scrape can race this
+            # zoo-lint: disable=telemetry-lock — object not yet shared
             self._children[()] = self._make_child()
 
     def _make_child(self):

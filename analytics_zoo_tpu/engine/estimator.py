@@ -171,6 +171,9 @@ class Estimator:
         # at-most-one-in-flight async checkpoint writer (created lazily on
         # the first save when config.async_checkpoint)
         self._ckpt_writer: Optional[ckpt.CheckpointWriter] = None
+        # recompilation-hazard tracker over step signatures (lazy; see
+        # _note_step_signature)
+        self._recompile_tracker = None
 
     def _rebuild_tx(self) -> "Estimator":
         """(Re)compose the optimizer chain from ``_base_tx``: clipping first,
@@ -610,6 +613,7 @@ class Estimator:
             self._train_step = self._make_train_step()
 
         # init or resume
+        first = None
         if self.train_state is None:
             first = next(train_set.batches(batch_size, epoch=0, shuffle=False))
             self.train_state = self._init_state(first, seed=seed)
@@ -621,6 +625,17 @@ class Estimator:
                     self.trainer_state.iteration = meta["iteration"]
                     self.trainer_state.epoch = meta["epoch"]
                     logger.info("resumed from %s (iter %d)", latest, meta["iteration"])
+
+        # opt-in trace-time static analysis of the step about to train
+        # (TrainConfig.graph_checks): a broken structural invariant —
+        # collective budget, closure-captured weights, host round-trips,
+        # dtype leaks — surfaces HERE, before the first (expensive) compile,
+        # instead of at the next bench run
+        if cfg.graph_checks and cfg.graph_checks != "off":
+            if first is None:
+                first = next(train_set.batches(batch_size, epoch=0,
+                                               shuffle=False))
+            self._run_graph_checks(first)
 
         # retry-from-checkpoint budget (Topology.scala:1181-1263), now policy-
         # driven: retry_times attempts with exponential backoff between
@@ -793,7 +808,7 @@ class Estimator:
                     # here, and excluding the cost from the epoch epilogue's
                     # ComputeMs
                     jax.block_until_ready(loss)
-                    self._step_shapes.add(key)
+                    self._note_step_signature(key)
                     _COMPILES.inc()
                     compile_s = time.perf_counter() - t_step
                     _COMPILE_TIME.observe(compile_s)
@@ -987,7 +1002,7 @@ class Estimator:
                                                              db)
             if key not in self._step_shapes:
                 jax.block_until_ready(loss)
-                self._step_shapes.add(key)
+                self._note_step_signature(key)
                 _COMPILES.inc()
                 compile_s = time.perf_counter() - t_step
                 _COMPILE_TIME.observe(compile_s)
@@ -1000,6 +1015,43 @@ class Estimator:
                 self._save(cfg.checkpoint_dir)
         self._finish_epoch(t0, seen, loss, batch_size,
                            compile_s=epoch_compile)
+
+    def _run_graph_checks(self, sample_batch):
+        """Trace the train step (``jax.make_jaxpr`` — no compile) and run the
+        graph-layer lint rules against it per ``TrainConfig.graph_checks``.
+
+        Expectations are derived from the config: the flat update-sharding
+        path must show exactly one reduce-scatter + one all-gather per global
+        step (and none inside the accumulation scan); a declared bf16 policy
+        must actually reach the contraction ops; no host callbacks or large
+        closure-captured constants may ride the step."""
+        from ..analysis import RuleContext, enforce, lint_traced
+
+        expect = ({"reduce-scatter": 1, "all-gather": 1}
+                  if self._update_mode() == "flat" else None)
+        ctx = RuleContext(where="estimator.fit",
+                          expect_collectives=expect,
+                          compute_dtype=self.config.compute_dtype)
+        step = self._with_policy(self._step_fn())
+        batch = self._to_global(sample_batch)
+        findings = lint_traced(step, self.train_state, batch, ctx=ctx,
+                               rules=["collective-budget", "host-transfer",
+                                      "large-constant", "dtype-discipline"])
+        enforce(findings, self.config.graph_checks, logger)
+
+    def _note_step_signature(self, key) -> None:
+        """Record a newly-compiled step signature: add it to ``_step_shapes``
+        (the compile-event membership set) AND the recompilation-hazard
+        tracker — one add-path so the two can't desynchronize. A train step
+        re-tracing beyond a handful of distinct batch signatures is compiling
+        mid-run (unbucketed ragged batches, drifting dtypes)."""
+        self._step_shapes.add(key)
+        if self._recompile_tracker is None:
+            from ..analysis.graphlint import SignatureTracker
+
+            self._recompile_tracker = SignatureTracker("estimator.step",
+                                                       max_distinct=4)
+        self._recompile_tracker.add(key)
 
     def _observe_comm(self):
         """Feed ``zoo_train_comm_seconds``: time one param-sized gradient-

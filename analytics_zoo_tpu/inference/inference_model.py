@@ -146,6 +146,14 @@ class InferenceModel:
         # int8 packing wall time (quantize_int8) — startup cost the serving
         # engine pays at warmup instead of the first request
         self.quantize_seconds = 0.0
+        # recompilation-hazard tracker: the bucket ladder promises at most
+        # log2(max_batch)+1 executables per feature shape; a dispatch-key set
+        # outgrowing 2x that bound means this model compiles under live
+        # traffic (analysis/ graph-lint "recompile-hazard", flagged once)
+        from ..analysis.graphlint import SignatureTracker
+
+        self._sig_tracker = SignatureTracker.for_bucket_ladder(
+            "inference.predict", max_batch_size, shapes_per_bucket=2)
 
     # ------------------------------------------------------------------ loading
 
@@ -288,6 +296,7 @@ class InferenceModel:
                     self._compiled[key] = exe
                     self.compile_count += 1
                     _COMPILES.inc()
+                    self._sig_tracker.add(key)
                     return exe
         self.cache_hit_count += 1
         _CACHE_HITS.inc()
@@ -433,15 +442,52 @@ class InferenceModel:
 
     # ------------------------------------------------------------------- warmup
 
-    def warm_up(self, example_inputs) -> None:
+    def warm_up(self, example_inputs, graph_checks: Optional[str] = None
+                ) -> None:
         """Compile the bucket ladder ahead of traffic (AOT; replaces the
-        reference's replica-clone prefill)."""
+        reference's replica-clone prefill). ``graph_checks`` ("warn"/"raise")
+        additionally runs :meth:`check_fused_dispatch` so a quantized model
+        whose fused kernels are silently not dispatching is caught here —
+        at model-load time — instead of at the next bench run."""
         multi = isinstance(example_inputs, (list, tuple))
         arrs = [np.asarray(a) for a in
                 (example_inputs if multi else [example_inputs])]
         for b in _buckets(self.max_batch_size):
             padded = [_pad_to(a[:1], b) for a in arrs]
             self.predict(padded if multi else padded[0])
+        if graph_checks:
+            self.check_fused_dispatch(example_inputs, mode=graph_checks)
+
+    def check_fused_dispatch(self, example_inputs, mode: str = "warn"):
+        """Run the ``fused-int8-dispatch`` graph rule over the exact
+        computation :meth:`predict` compiles (the PR-6 regression class:
+        quantized model, fused tier claimed on, but the jaxpr shows lax
+        quantize ops / int8 HBM intermediates instead of pallas kernels).
+
+        No-op unless the model is quantized AND the fused tier is routed on
+        (``ops.int8_fused.fused_mode() != "off"``) — an un-quantized or
+        deliberately-lax model has no fused invariant to hold. ``mode``:
+        "warn" logs findings, "raise" raises
+        :class:`analytics_zoo_tpu.analysis.GraphLintError`. Returns the
+        findings."""
+        from ..analysis import RuleContext, enforce
+        from ..analysis.rules.fused_int8 import lint_fused_dispatch
+        from ..ops.int8_fused import fused_mode
+
+        if not mode or mode == "off":
+            return []
+        if not self._quantized or fused_mode() == "off":
+            return []
+        import logging
+
+        multi = isinstance(example_inputs, (list, tuple))
+        arrs = [jnp.asarray(np.asarray(a)[:1]) for a in
+                (example_inputs if multi else [example_inputs])]
+        x = arrs if multi else arrs[0]
+        ctx = RuleContext(where="inference.load", fused_expected=True)
+        findings = lint_fused_dispatch(self, x, ctx=ctx)
+        return enforce(findings, mode,
+                       logging.getLogger("analytics_zoo_tpu.inference"))
 
     @property
     def is_quantized(self) -> bool:

@@ -34,8 +34,6 @@ Two implementations, selected by the training engine:
 
 from __future__ import annotations
 
-import re
-from collections import Counter
 from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -309,20 +307,8 @@ def make_comm_probe(mesh, n_elems: int, axis: str = "dp",
 
 
 # --------------------------------------------------------------- HLO forensics
-_COLLECTIVE_RE = re.compile(
-    r"\b(all-reduce|reduce-scatter|all-gather|collective-permute|all-to-all)"
-    r"(?:-start)?\(")
-
-
-def collective_counts(hlo_text: str) -> dict:
-    """Count collective *instruction definitions* in compiled HLO text (used
-    by the update-sharding bench and tests to assert the one-collective-per-
-    global-step property; ignores mentions in operand positions)."""
-    out: Counter = Counter()
-    for line in hlo_text.splitlines():
-        if "=" not in line:
-            continue
-        m = _COLLECTIVE_RE.search(line.split("=", 1)[1])
-        if m:
-            out[m.group(1)] += 1
-    return dict(out)
+# The HLO collective counter moved onto the shared static-analysis rule
+# engine (analysis/rules/collectives.py) where it backs the
+# "collective-budget-hlo" rule; re-exported here so existing callers (the
+# bench, tests) keep their import path.
+from ..analysis.rules.collectives import collective_counts  # noqa: E402

@@ -27,6 +27,13 @@ class ServingConfig:
     warmup_shape: Optional[tuple] = None # per-record input shape (no batch
                                          # dim): engine start() pre-compiles
                                          # the bucket ladder for it
+    graph_checks: str = "warn"           # static analysis of the dispatch
+                                         # computation at warmup (analysis/
+                                         # fused-int8-dispatch rule): "warn"
+                                         # logs findings, "raise" fails
+                                         # start() — catches the PR-6
+                                         # regression class at model-load
+                                         # time; "off" skips
     log_dir: Optional[str] = None        # InferenceSummary TB dir
     # --- resilience (common.resilience wiring) ---
     infer_workers: int = 1               # model-worker threads; dead ones are
@@ -73,6 +80,19 @@ class ServingConfig:
         ws = raw.get("warmup_shape", model.get("warmup_shape"))
         flat["warmup_shape"] = tuple(int(d) for d in ws) if ws else None
         flat["log_dir"] = raw.get("log_dir")
+        if raw.get("graph_checks") is not None:
+            gc = raw["graph_checks"]
+            # YAML 1.1 parses bare off/on as booleans; map them back to the
+            # policy strings instead of coercing to "False"/"True". A typo'd
+            # policy must fail HERE: by warmup time the engine tolerates
+            # check failures in warn mode, so a bad value would silently
+            # disable the enforcement the operator asked for.
+            val = ("off" if gc is False
+                   else "warn" if gc is True else str(gc))
+            if val not in ("off", "warn", "raise"):
+                raise ValueError(f"graph_checks must be 'off'/'warn'/"
+                                 f"'raise', got {gc!r}")
+            flat["graph_checks"] = val
         for key in ("infer_workers", "heartbeat_timeout_s",
                     "http_max_inflight", "breaker_failure_threshold",
                     "breaker_reset_timeout_s"):

@@ -286,19 +286,43 @@ class ClusterServing:
         request — previously the first dispatch ate the packing + recompile
         cost. The costs land in ``compile_stats`` (``quantize_seconds``,
         ``compiles``), so ``stats()``/the bench can separate warmup from
-        steady-state traffic."""
+        steady-state traffic.
+
+        With ``config.graph_checks`` ("warn" default / "raise"), warmup also
+        runs the ``fused-int8-dispatch`` graph rule over the computation the
+        engine is about to serve: a quantized model whose fused kernels are
+        silently not dispatching (the 0.72× PR-6 regression class) is caught
+        at model-LOAD time instead of at the next bench run. The rule needs
+        an input shape, so it runs only when ``warmup_shape`` is set."""
         if self.config.int8 and not self.model.is_quantized:
             self.model.quantize_int8()
         shape = getattr(self.config, "warmup_shape", None)
+        checks = getattr(self.config, "graph_checks", "warn")
         if shape and hasattr(self.model, "warm_up"):
+            sample = np.zeros((1,) + tuple(int(d) for d in shape),
+                              np.float32)
             try:
-                self.model.warm_up(
-                    np.zeros((1,) + tuple(int(d) for d in shape),
-                             np.float32))
+                self.model.warm_up(sample)
             except Exception:
                 logger.exception("warmup predict failed (shape=%s); the "
                                  "first real request will compile instead",
                                  shape)
+            if hasattr(self.model, "check_fused_dispatch"):
+                try:
+                    self.model.check_fused_dispatch(sample, mode=checks)
+                except Exception:
+                    # a LINT VERDICT must fail start() in "raise" mode
+                    # (GraphLintError, raised by the check itself); a trace
+                    # failure in "warn" mode gets the same tolerance as a
+                    # warmup-predict failure above — log and serve
+                    if checks == "raise":
+                        raise
+                    logger.exception("fused-dispatch graph check failed "
+                                     "(shape=%s); serving anyway", shape)
+        elif self.config.int8 and checks and checks != "off":
+            logger.info("graph_checks: no warmup_shape configured — the "
+                        "fused-dispatch structure check needs an input "
+                        "shape and was skipped")
 
     def _spawn_infer_worker(self, widx: int) -> threading.Thread:
         t = threading.Thread(target=self._infer_loop, args=(widx,),

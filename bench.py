@@ -666,51 +666,6 @@ def run_data_pipeline(platform: str | None = None, n_records: int = 1024,
     }
 
 
-def fused_dispatch_structure(im, x) -> dict:
-    """Structural no-unfused-quantize-op audit of an ``InferenceModel``'s
-    dispatch computation with the fused int8 kernel tier forced on.
-
-    Walks the jaxpr of the exact computation ``predict`` compiles and
-    asserts the fused invariants the timing win rests on (CPU-checkable —
-    this is what ``--int8-dispatch --quick`` gates so the 0.72× regression
-    can't silently return):
-
-    * ≥1 ``pallas_call`` (the fused kernels actually dispatched);
-    * no standalone quantize ops (``round``/``clamp``) outside kernel
-      bodies — the unfused path's HBM-materialized activation quantization;
-    * no int8 intermediate produced outside kernel bodies (weights ENTER as
-      int8 arguments; nothing int8 may be computed between ops, which is
-      exactly an int8 tensor round-tripping HBM).
-    """
-    import jax
-
-    apply, params, state = im.device_apply()
-    jaxpr = jax.make_jaxpr(lambda p, s, xx: apply(p, s, xx))(params, state, x)
-    counts = {"pallas_calls": 0, "quantize_ops_outside_kernels": 0,
-              "int8_intermediates_outside_kernels": 0}
-
-    def walk(jx):
-        for eqn in jx.eqns:
-            if eqn.primitive.name == "pallas_call":
-                counts["pallas_calls"] += 1
-                continue                    # kernel body = VMEM, not HBM
-            if eqn.primitive.name in ("round", "clamp"):
-                counts["quantize_ops_outside_kernels"] += 1
-            for v in eqn.outvars:
-                if str(getattr(v.aval, "dtype", "")) == "int8":
-                    counts["int8_intermediates_outside_kernels"] += 1
-            for sub in eqn.params.values():
-                if hasattr(sub, "jaxpr"):
-                    walk(sub.jaxpr)
-
-    walk(jaxpr.jaxpr)
-    counts["fused_invariants_hold"] = bool(
-        counts["pallas_calls"] >= 1
-        and counts["quantize_ops_outside_kernels"] == 0
-        and counts["int8_intermediates_outside_kernels"] == 0)
-    return counts
-
-
 def run_int8_dispatch(hidden: Optional[int] = None,
                       batch: Optional[int] = None,
                       iters: Optional[int] = None) -> dict:
@@ -725,8 +680,11 @@ def run_int8_dispatch(hidden: Optional[int] = None,
     * ``raw``: device-resident chained matmul loop, bf16 vs int8;
     * ``dispatch``: ``InferenceModel.predict`` end-to-end (pad + executable
       lookup + transfers), bf16 vs quantized;
-    * ``structure``: the :func:`fused_dispatch_structure` jaxpr audit with
-      the fused tier forced on (the CPU-checkable invariant).
+    * ``structure``: the ``fused-int8-dispatch`` rule of the shared
+      static-analysis engine (``analysis.rules.fused_int8``) run over the
+      jaxpr of the exact computation predict compiles, with the fused tier
+      forced on (the CPU-checkable invariant; quick mode gates on its
+      findings being empty).
 
     On TPU the fused tier is autotuned first (``ops.tuning``) so dispatch
     runs tuned blocks; the sweep winner rides the artifact.
@@ -851,11 +809,14 @@ def run_int8_dispatch(hidden: Optional[int] = None,
         out["dispatch"]["int8_over_bf16"] / out["raw"]["int8_over_bf16"], 3)
 
     # --- structural audit: fused tier forced on (CPU-checkable) ----------
+    from analytics_zoo_tpu.analysis.rules.fused_int8 import (
+        fused_dispatch_report)
+
     env_prev = os.environ.get("ZOO_INT8_FUSED")
     os.environ["ZOO_INT8_FUSED"] = "1" if on_tpu else "interpret"
     try:
         out["structure_mode"] = fused_mode()
-        out["structure"] = fused_dispatch_structure(
+        out["structure"] = fused_dispatch_report(
             im_q, jnp.asarray(x_np[: min(batch, 8)]))
     finally:
         if env_prev is None:
@@ -993,8 +954,10 @@ def run_update_sharding(dp_sizes=(2, 4, 8), accum_steps=(1, 4),
         if hlo:     # the mixed-precision arm's step is policy-wrapped (no
             # .lower); it is measured for state bytes only
             compiled = step.lower(state, batch).compile()
-            out["collectives"] = upd.collective_counts(compiled.as_text())
-            out["hbm"] = mem_fields(compiled)
+            hlo_text = compiled.as_text()
+            out["collectives"] = upd.collective_counts(hlo_text)
+            out["_hlo"] = hlo_text        # popped by the caller (lint input,
+            out["hbm"] = mem_fields(compiled)  # never lands in the artifact)
             # drive the AOT executable directly below: jit dispatch would
             # compile the identical program a second time
             step = compiled
@@ -1022,12 +985,16 @@ def run_update_sharding(dp_sizes=(2, 4, 8), accum_steps=(1, 4),
         quiet = dict(log_every_n_steps=10 ** 9, shuffle=False)
         repl = arm(dp, TrainConfig(update_sharding=False, **quiet),
                    batch_np, measure_tps=True)
+        repl.pop("_hlo", None)
         shard = arm(dp, TrainConfig(update_sharding=True, **quiet),
                     batch_np, measure_tps=True)
-        accum = {str(k): arm(dp, TrainConfig(update_sharding=True,
+        shard_hlo = shard.pop("_hlo", "")
+        accum_arms = {k: arm(dp, TrainConfig(update_sharding=True,
                                              grad_accum_steps=k, **quiet),
-                             batch_np, measure_tps=False)["collectives"]
-                 for k in accum_steps}
+                             batch_np, measure_tps=False)
+                      for k in accum_steps}
+        accum_hlos = {k: a.pop("_hlo", "") for k, a in accum_arms.items()}
+        accum = {str(k): a["collectives"] for k, a in accum_arms.items()}
         mp = arm(dp, TrainConfig(update_sharding=True,
                                  compute_dtype="bfloat16", **quiet),
                  batch_np, measure_tps=False, hlo=False)
@@ -1043,6 +1010,36 @@ def run_update_sharding(dp_sizes=(2, 4, 8), accum_steps=(1, 4),
                 shard["opt_state_bytes_per_device"]
                 / max(1, repl["opt_state_bytes_per_device"]), 4),
         }
+        # the ZeRO-1 structural gates now run through the shared rule
+        # engine (analysis "collective-budget-hlo"): the sharded step must
+        # budget exactly one grad reduce-scatter + one params all-gather,
+        # and every accumulation variant must show the K=1 arm's exact
+        # collective counts (constant in K). Findings ride the artifact.
+        from analytics_zoo_tpu.analysis import RuleContext, lint_hlo
+
+        entry["sharded_lint"] = [f.as_dict() for f in lint_hlo(
+            shard_hlo, ctx=RuleContext(
+                where=f"update-sharding.dp{dp}",
+                expect_collectives={"reduce-scatter": 1, "all-gather": 1}))]
+        base = accum[str(accum_steps[0])]
+        # the base accum arm is gated against the ABSOLUTE ZeRO-1 budget
+        # (one reduce-scatter + one all-gather); the K>1 arms are then
+        # gated against the base's exact counts, so a violation shared by
+        # every arm equally cannot slip through the constancy comparison
+        accum_lint = [f.as_dict() for f in lint_hlo(
+            accum_hlos[accum_steps[0]], ctx=RuleContext(
+                where=f"update-sharding.dp{dp}.k{accum_steps[0]}",
+                expect_collectives={"reduce-scatter": 1, "all-gather": 1}))]
+        for k in accum_steps[1:]:
+            # expectation covers the UNION of collective kinds seen at K=1
+            # and at this K: a kind that only appears under accumulation
+            # (expected 0, found n) must trip the rule, not slip past it
+            kinds = set(base) | set(accum[str(k)])
+            accum_lint += [f.as_dict() for f in lint_hlo(
+                accum_hlos[k], ctx=RuleContext(
+                    where=f"update-sharding.dp{dp}.k{k}",
+                    expect_collectives={c: base.get(c, 0) for c in kinds}))]
+        entry["accum_lint"] = accum_lint
         ks = [accum[str(k)] for k in accum_steps]
         entry["grad_collectives_constant_in_k"] = all(k == ks[0] for k in ks)
         entry["one_reduce_scatter"] = all(
@@ -1130,12 +1127,18 @@ if __name__ == "__main__":
                 assert shard_b <= repl_b / dp * 1.35 + 4096, (
                     f"dp={dp}: sharded opt state {shard_b}B not ~1/{dp} of "
                     f"replicated {repl_b}B")
-                assert e["grad_collectives_constant_in_k"], (
-                    f"dp={dp}: collective count varies with grad_accum_steps "
-                    f"{e['sharded_accum_collectives']}")
-                assert e["one_reduce_scatter"], (
-                    f"dp={dp}: expected exactly one grad reduce-scatter "
-                    f"{e['sharded_accum_collectives']}")
+                # collective gates run through the shared rule engine: an
+                # empty finding list IS the invariant (exactly one grad
+                # reduce-scatter + one params all-gather; counts constant
+                # in grad_accum_steps)
+                assert not e["sharded_lint"], (
+                    f"dp={dp}: collective-budget rule findings:\n" + "\n".join(
+                        f"  {f['location']}: {f['message']}"
+                        for f in e["sharded_lint"]))
+                assert not e["accum_lint"], (
+                    f"dp={dp}: collective counts vary with grad_accum_steps:"
+                    "\n" + "\n".join(f"  {f['location']}: {f['message']}"
+                                     for f in e["accum_lint"]))
                 # memory gate: the sharded-update step must not cost more
                 # HBM than the replicated one
                 rh = e["replicated"]["hbm"].get("hbm_peak_bytes")
@@ -1178,9 +1181,14 @@ if __name__ == "__main__":
         print(json.dumps(kb))
         if "--quick" in sys.argv:
             st = kb["structure"]
-            # structural gate (CPU-checkable): the fused dispatch path must
-            # contain pallas kernels and NO standalone quantize ops / int8
-            # HBM intermediates — the shape of the 0.72x regression
+            # structural gate (CPU-checkable): the fused-int8-dispatch rule
+            # of the shared analysis engine must come back clean — pallas
+            # kernels present, NO standalone quantize ops / int8 HBM
+            # intermediates (the shape of the 0.72x regression)
+            assert not st["findings"], (
+                "fused-dispatch rule findings:\n" + "\n".join(
+                    f"  {f['location']}: {f['message']}"
+                    for f in st["findings"]))
             assert st["fused_invariants_hold"], (
                 f"fused-dispatch invariants violated: {st}")
             # the bench model is UNTRAINED (near-uniform 128-class softmax:

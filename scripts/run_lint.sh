@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Graph-lint runner (ISSUE 7).
+#
+#   scripts/run_lint.sh            # AST-lint the package; non-zero exit on
+#                                  # any unsuppressed error-severity finding
+#   scripts/run_lint.sh --full     # also run the analysis pytest marker
+#                                  # (golden fixtures + clean-repo gate +
+#                                  # graph_checks hooks)
+#
+# The graph-layer rules need a traced computation, so they run where one
+# exists: TrainConfig.graph_checks at fit() start, InferenceModel/serving
+# warmup at model-load time, and the bench gates (--int8-dispatch /
+# --update-sharding). This script is the host-layer CI gate and is wired
+# into scripts/run_serving_bench.sh --quick.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+LINT_TIMEOUT="${LINT_TIMEOUT:-300}"
+timeout -k 10 "$LINT_TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m analytics_zoo_tpu.analysis
+
+if [[ "${1:-}" == "--full" ]]; then
+    exec timeout -k 10 900 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/ -q -m analysis -p no:cacheprovider
+fi

@@ -29,6 +29,9 @@ cd "$(dirname "$0")/.."
 
 TIMEOUT="${SERVING_BENCH_TIMEOUT:-900}"
 if [[ "${1:-}" == "--quick" ]]; then
+    # host-layer graph-lint gate: the package must carry zero unsuppressed
+    # error-severity findings (scripts/run_lint.sh exits non-zero otherwise)
+    scripts/run_lint.sh
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
         python serving_bench.py --quick
     # int8 kernel-tier structural gate (writes KERNEL_BENCH.json for the

@@ -3,13 +3,13 @@
 Differential coverage: the fused int8 matmul/conv kernels
 (ops/int8_fused.py) vs the unfused lax oracle (ops/int8.py) and vs f32;
 the structural no-unfused-quantize-op invariant of the fused dispatch path
-(the jaxpr audit ``bench.fused_dispatch_structure`` that the serving quick
-gate runs); the block-schedule tuning cache (ops/tuning.py); and the
+(the ``fused-int8-dispatch`` rule of the shared analysis engine that the
+serving quick gate runs); the block-schedule tuning cache (ops/tuning.py);
+and the
 serving-engine startup warmup that moved int8 packing off the first
 request. All CPU-safe (pallas interpreter) — these run in tier-1.
 """
 
-import importlib.util
 import os
 
 import jax
@@ -22,15 +22,6 @@ from analytics_zoo_tpu.ops import int8_fused, tuning
 from analytics_zoo_tpu.ops.int8 import quantize_weight
 
 pytestmark = pytest.mark.pallas
-
-
-def _load_bench():
-    spec = importlib.util.spec_from_file_location(
-        "zoo_bench", os.path.join(os.path.dirname(__file__), "..",
-                                  "bench.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
 
 
 def _packed(w):
@@ -255,27 +246,31 @@ def test_quantized_model_fused_vs_lax_paths_agree(zoo_ctx, fused_interpret,
 
 def test_fused_dispatch_structure_invariants(zoo_ctx, fused_interpret,
                                              np_rng):
-    """The jaxpr audit the serving quick gate runs: with the fused tier on,
-    the quantized dispatch path has pallas kernels and NO standalone
-    quantize ops or int8 HBM intermediates; with it off, the unfused ops
-    are detected (the detector is falsifiable)."""
-    bench = _load_bench()
+    """The ``fused-int8-dispatch`` rule the serving quick gate runs: with
+    the fused tier on, the quantized dispatch path has pallas kernels and NO
+    standalone quantize ops or int8 HBM intermediates (zero findings); with
+    it off, the unfused ops are detected as findings (the rule is
+    falsifiable)."""
+    from analytics_zoo_tpu.analysis.rules.fused_int8 import (
+        fused_dispatch_report)
     from analytics_zoo_tpu.inference import InferenceModel
 
     im = InferenceModel(max_batch_size=16).load(_fitted_mlp(np_rng))
     im.quantize_int8(min_elements=64)
     x = jnp.asarray(np_rng.normal(size=(8, 32)).astype(np.float32))
-    st = bench.fused_dispatch_structure(im, x)
+    st = fused_dispatch_report(im, x)
     assert st["fused_invariants_hold"], st
+    assert st["findings"] == []
     assert st["pallas_calls"] == 3          # one per quantized Dense
     os.environ["ZOO_INT8_FUSED"] = "0"
     try:
-        st_off = bench.fused_dispatch_structure(im, x)
+        st_off = fused_dispatch_report(im, x)
     finally:
         os.environ["ZOO_INT8_FUSED"] = "interpret"
     assert not st_off["fused_invariants_hold"]
     assert st_off["quantize_ops_outside_kernels"] > 0
     assert st_off["int8_intermediates_outside_kernels"] > 0
+    assert {f["rule"] for f in st_off["findings"]} == {"fused-int8-dispatch"}
 
 
 # -------------------------------------------------------------- tuning cache

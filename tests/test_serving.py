@@ -304,3 +304,18 @@ postprocessing:
     assert cfg.batch_size == 64 and cfg.concurrent_num == 8
     assert cfg.queue_host == "1.2.3.4" and cfg.queue_port == 9999
     assert cfg.top_n == 5
+
+
+def test_config_yaml_graph_checks_bare_off(tmp_path):
+    # YAML 1.1 parses bare off/on as booleans; the policy string must
+    # survive (an operator's explicit opt-out must actually disable)
+    for raw, want in (("off", "off"), ("on", "warn"),
+                      ("warn", "warn"), ("raise", "raise")):
+        p = tmp_path / f"gc_{raw}.yaml"
+        p.write_text(f"graph_checks: {raw}\n")
+        assert ServingConfig.from_yaml(str(p)).graph_checks == want
+    # a typo'd policy fails at parse time, not silently at warmup
+    p = tmp_path / "gc_bad.yaml"
+    p.write_text("graph_checks: enforce\n")
+    with pytest.raises(ValueError, match="graph_checks"):
+        ServingConfig.from_yaml(str(p))
