@@ -106,6 +106,11 @@ class RuleContext:
     const_bytes_limit: int = 1 << 20
     # recompile-hazard: distinct dispatch signatures allowed before flagging
     max_signatures: Optional[int] = None
+    # decode-shape-stability: the (shape, dtype-name) of every KV-cache leaf
+    # the traced decode step carries — the rule asserts each one reappears
+    # unchanged among the outputs (cache threaded, no per-step growth) and
+    # bounds intermediate sizes by the largest cache leaf
+    decode_cache_avals: Optional[Sequence[Tuple[Tuple[int, ...], str]]] = None
 
 
 class Rule:

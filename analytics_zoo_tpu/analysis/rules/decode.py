@@ -1,0 +1,158 @@
+"""Decode-shape-stability rule: the KV-cache decode step's structural
+invariants.
+
+The continuous batcher's economics rest on the decode step being ONE
+compiled executable whose cost is flat in generated length. Three structural
+facts about the traced ``decode_step`` make that true, and each has a quiet
+failure mode this rule catches at warmup (``ServingConfig.graph_checks``,
+alongside the fused-int8 check) instead of at the next bench run:
+
+* **Cache threads through unchanged.** Every cache leaf's (shape, dtype)
+  must reappear among the jaxpr outputs. A concatenate-grown cache (the
+  naive "append K/V each step" implementation) changes shape per step —
+  one XLA recompile per emitted token.
+* **No per-step growth.** No equation outside a kernel body may produce an
+  intermediate larger than the largest cache leaf: an O(T²) score tensor or
+  an accidentally-broadcast gather shows up here.
+* **No host transfers.** A host callback inside the decode step serializes
+  the whole multi-slot loop on a host round-trip per token.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..core import Finding, Rule, RuleContext, register
+from ..graphlint import walk_eqns
+from .graph_hygiene import _HOST_PRIMITIVES
+
+
+def _aval_key(aval) -> Tuple[Tuple[int, ...], str]:
+    return (tuple(getattr(aval, "shape", ())),
+            str(getattr(aval, "dtype", "")))
+
+
+@register
+class DecodeShapeStabilityRule(Rule):
+    """Active when ``ctx.decode_cache_avals`` names the cache leaves."""
+
+    id = "decode-shape-stability"
+    layer = "jaxpr"
+    severity = "error"
+    doc = ("The traced decode step must thread every KV-cache leaf through "
+           "with identical (shape, dtype), produce no intermediate larger "
+           "than the cache, and contain no host transfers — the no-"
+           "recompile/no-O(T^2) contract of KV-cache decoding")
+
+    def check(self, closed_jaxpr, ctx: RuleContext) -> Iterable[Finding]:
+        if not ctx.decode_cache_avals:
+            return []
+        out: List[Finding] = []
+        jaxpr = closed_jaxpr.jaxpr
+
+        # (1) cache threading: each declared leaf reappears among outputs
+        out_avals: Dict[Tuple, int] = {}
+        for v in jaxpr.outvars:
+            k = _aval_key(v.aval)
+            out_avals[k] = out_avals.get(k, 0) + 1
+        leaf_bytes = []
+        for shape, dtype in ctx.decode_cache_avals:
+            import numpy as np
+
+            n = 1
+            for d in shape:
+                n *= int(d)
+            try:
+                itemsize = np.dtype(dtype).itemsize
+            except TypeError:
+                import ml_dtypes
+
+                itemsize = np.dtype(getattr(ml_dtypes, dtype)).itemsize
+            leaf_bytes.append(n * itemsize)
+            key = (tuple(shape), dtype)
+            if out_avals.get(key, 0) > 0:
+                out_avals[key] -= 1
+            else:
+                out.append(self.emit(
+                    ctx, f"cache leaf {dtype}{tuple(shape)} does not "
+                         f"reappear among the decode step's outputs — the "
+                         f"cache is being grown/reshaped per step (one "
+                         f"recompile per emitted token)",
+                    shape=tuple(shape), dtype=dtype))
+        limit = max(leaf_bytes) if leaf_bytes else 0
+
+        # (2)+(3): growth bound and host transfers over every equation
+        for site in walk_eqns(jaxpr):
+            if site.in_kernel:
+                continue
+            name = site.eqn.primitive.name
+            if name in _HOST_PRIMITIVES:
+                out.append(self.emit(
+                    ctx, f"{name} inside the decode step — a host round-trip "
+                         f"per emitted token", primitive=name))
+                continue
+            if limit:
+                for v in site.eqn.outvars:
+                    aval = getattr(v, "aval", None)
+                    nbytes = _aval_nbytes(aval)
+                    if nbytes is not None and nbytes > limit:
+                        out.append(self.emit(
+                            ctx, f"{name} produces a "
+                                 f"{aval.dtype}{tuple(aval.shape)} "
+                                 f"intermediate ({nbytes} bytes) larger "
+                                 f"than the whole KV cache leaf ({limit} "
+                                 f"bytes) — per-step growth / O(T^2) "
+                                 f"recompute shape",
+                            primitive=name, nbytes=int(nbytes)))
+                        break
+        return out
+
+
+def _aval_nbytes(aval) -> Optional[int]:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except TypeError:     # symbolic dim
+            return None
+    return n * dtype.itemsize
+
+
+def lint_decode_stability(model, params, cache_cfg, cache, *,
+                          top_k: int = 0,
+                          where: str = "serving.generation",
+                          ctx: Optional[RuleContext] = None) -> List[Finding]:
+    """Trace ``model.decode_step`` at the cache's fixed shapes (abstract —
+    no compile, no execution) and run the stability rule. This is the
+    warmup entry point (``ContinuousBatcher.check_decode_stability``) and
+    the bench's decode-lint gate."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..graphlint import lint_jaxpr
+
+    b = cache_cfg.n_slots
+    i32 = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32)
+    closed = jax.make_jaxpr(
+        lambda p, c, ids, ln, tb, sd, ti, tp: model.decode_step(
+            p, c, ids, ln, tb, sd, ti, tp, page_size=cache_cfg.page_size,
+            top_k=top_k))(
+        params, cache, i32((b,)), i32((b,)),
+        i32((b, cache_cfg.pages_per_slot)),
+        jax.ShapeDtypeStruct((b,), jnp.uint32),
+        jax.ShapeDtypeStruct((b,), jnp.uint32),
+        jax.ShapeDtypeStruct((b,), jnp.float32))
+    import jax.tree_util as jtu
+
+    cache_avals = [(tuple(leaf.shape), str(leaf.dtype))
+                   for leaf in jtu.tree_leaves(cache)]
+    ctx = ctx or RuleContext(where=where)
+    ctx = RuleContext(**{**ctx.__dict__, "decode_cache_avals": cache_avals})
+    return lint_jaxpr(closed, ctx=ctx, rules=["decode-shape-stability"])
+
+
+__all__ = ["DecodeShapeStabilityRule", "lint_decode_stability"]

@@ -164,6 +164,7 @@ KNOWN_SITES = {
     "conn.call",          # serving/client.py broker round-trip
     "data.prefetch",      # data/pipeline.py producer loop
     "estimator.step",     # engine/estimator.py per-step (both epoch runners)
+    "serving.generate",   # serving/generation.py continuous-batch decode loop
     "serving.infer",      # serving/engine.py model-worker batch loop
     "task_pool.worker",   # orca/task_pool.py worker loop
 }

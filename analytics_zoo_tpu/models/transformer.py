@@ -143,6 +143,108 @@ class TransformerLM(Layer, KerasNet):
         logits = h @ jnp.asarray(params["logits_kernel"], h.dtype)
         return logits, state
 
+    # -------------------------------------------------------- decode serving
+    # prefill()/decode_step(): the autoregressive path behind the continuous
+    # batcher (serving/generation.py). Both are pure functions of
+    # (params, cache, ...) with shapes fixed by the KVCacheConfig, so each
+    # compiles exactly once per (batch, bucket) — the pow2 discipline the
+    # one-shot serving path already follows.
+
+    def init_kv_cache(self, n_slots: int, *, page_size: int = 16,
+                      max_seq_len: Optional[int] = None,
+                      n_pages: Optional[int] = None, dtype=None):
+        """Build a paged KV cache for ``n_slots`` concurrent decode
+        sequences. Returns ``(KVCacheConfig, cache)`` where ``cache`` is the
+        ``{"k", "v"}`` page-pool pytree threaded through
+        :meth:`prefill`/:meth:`decode_step`."""
+        from ..nn.module import compute_dtype
+        from ..ops.kv_cache import KVCacheConfig, init_cache
+
+        max_seq = int(max_seq_len or self.seq_len)
+        pps = -(-max_seq // page_size)          # ceil: full pages only
+        if pps * page_size > self.seq_len:
+            # validate the ROUNDED capacity: pps*page_size is what decode
+            # positions can actually reach, and positions past the table
+            # would silently clamp to the last row (corrupt embeddings)
+            raise ValueError(
+                f"max_seq_len {max_seq} rounds up to {pps * page_size} "
+                f"(full pages of {page_size}), exceeding the model's "
+                f"position table ({self.seq_len}); choose max_seq_len <= "
+                f"{self.seq_len // page_size * page_size}")
+        attn = self.blocks[0].attn
+        cfg = KVCacheConfig(
+            n_layers=self.n_block, n_heads=attn.n_head,
+            head_dim=attn.head_dim, n_slots=n_slots, page_size=page_size,
+            pages_per_slot=pps, n_pages=n_pages,
+            dtype=dtype or compute_dtype())
+        return cfg, init_cache(cfg)
+
+    def prefill(self, params, cache, ids, lengths, table, *, page_size: int):
+        """One batched forward that fills the cache and returns last-token
+        logits.
+
+        ``ids``: (B, T_bucket) int32, right-padded to a pow2 bucket that
+        divides ``page_size``; ``lengths``: (B,) true prompt lengths;
+        ``table``: (B, pages_per_slot) int32 page tables (entries past the
+        allocated prefix = scratch). Causal masking means pad positions are
+        never attended by valid queries, so their scratch writes are inert.
+        Returns ``(logits (B, V) f32 — at position length-1, cache)``.
+        """
+        from ..ops.kv_cache import prefill_write
+
+        ids = jnp.asarray(ids, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        h = jnp.take(params["token_embeddings"], ids, axis=0)
+        h = h + params["pos_embeddings"][: ids.shape[1]][None]
+        h = as_compute(h)
+        k_cache, v_cache = cache["k"], cache["v"]
+        for i, blk in enumerate(self.blocks):
+            h, k, v = blk.apply_with_kv(params[f"block{i}"], h)
+            k_cache = k_cache.at[i].set(
+                prefill_write(k_cache[i], table, k, page_size=page_size))
+            v_cache = v_cache.at[i].set(
+                prefill_write(v_cache[i], table, v, page_size=page_size))
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h)
+        last = jnp.take_along_axis(
+            h, jnp.maximum(lengths - 1, 0)[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]                                    # (B, hidden)
+        logits = last @ jnp.asarray(params["logits_kernel"], last.dtype)
+        return logits.astype(jnp.float32), {"k": k_cache, "v": v_cache}
+
+    def decode_step(self, params, cache, ids, lengths, table, seeds,
+                    token_idx, temperature, *, page_size: int,
+                    top_k: int = 0):
+        """One fixed-shape decode step over every slot.
+
+        ``ids``: (B,) int32 — the token sampled by the previous step (or
+        prefill); ``lengths``: (B,) — tokens already cached, i.e. the
+        position ``ids`` occupies; ``seeds``/``token_idx``/``temperature``:
+        (B,) per-request sampling state (see
+        :func:`analytics_zoo_tpu.ops.kv_cache.sample_tokens`). Returns
+        ``(next_ids (B,) int32, logits (B, V) f32, cache)`` — cache shapes
+        identical in and out (the decode-shape-stability invariant).
+        """
+        from ..ops.kv_cache import sample_tokens
+
+        ids = jnp.asarray(ids, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        h = jnp.take(params["token_embeddings"], ids, axis=0)[:, None]
+        h = h + jnp.take(params["pos_embeddings"], lengths, axis=0)[:, None]
+        h = as_compute(h)
+        k_cache, v_cache = cache["k"], cache["v"]
+        for i, blk in enumerate(self.blocks):
+            h, kp, vp = blk.decode_step(
+                params[f"block{i}"], h, k_cache[i], v_cache[i], table,
+                lengths, page_size=page_size)
+            k_cache = k_cache.at[i].set(kp)
+            v_cache = v_cache.at[i].set(vp)
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h)
+        logits = (h[:, 0] @ jnp.asarray(params["logits_kernel"], h.dtype)
+                  ).astype(jnp.float32)
+        next_ids = sample_tokens(logits, seeds, token_idx, temperature,
+                                 top_k=top_k)
+        return next_ids, logits, {"k": k_cache, "v": v_cache}
+
     def compute_output_shape(self, input_shape):
         return tuple(input_shape) + (self.vocab,)
 

@@ -69,18 +69,31 @@ class MultiHeadAttention(Layer):
         }
         return params, {}
 
-    def apply(self, params, state, x, *, training=False, rng=None):
-        x = as_compute(x)
+    def qkv_proj(self, params, x):
+        """Fused QKV projection → (q, k, v), each (B, T, n_head, head_dim).
+        Shared by the batched forward and the KV-cache prefill/decode paths
+        so cached K/V are definitionally the ones ``apply`` would compute."""
         b, t, _ = x.shape
         qkv = x @ jnp.asarray(params["qkv_kernel"], x.dtype) + jnp.asarray(
             params["qkv_bias"], x.dtype)
         qkv = qkv.reshape(b, t, 3, self.n_head, self.head_dim)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    def out_proj(self, params, o, dtype):
+        """(B, T, n_head, head_dim) attention output → (B, T, hidden)."""
+        b, t = o.shape[:2]
+        o = o.reshape(b, t, self.hidden_size)
+        return o @ jnp.asarray(params["out_kernel"], dtype) + jnp.asarray(
+            params["out_bias"], dtype)
+
+    def _attend(self, q, k, v, t):
+        """Strategy dispatch shared by ``apply`` and ``apply_with_kv``."""
         mesh = self._mesh()
         if mesh is not None and self.attn_strategy != "full":
-            o = sharded_attention(q, k, v, mesh, strategy=self.attn_strategy,
-                                  causal=self.causal)
-        elif self._flash_single_device(t):
+            return sharded_attention(q, k, v, mesh,
+                                     strategy=self.attn_strategy,
+                                     causal=self.causal)
+        if self._flash_single_device(t):
             # no mesh context: an explicit 'flash' still means the kernel
             # (it falls back internally when pallas is unavailable or the
             # tiles don't divide), and 'auto' prefers it on TPU at the
@@ -89,14 +102,29 @@ class MultiHeadAttention(Layer):
             # option past 16k where the (H, T, T) scores OOM)
             from ...ops.flash_attention import flash_attention
 
-            o = flash_attention(q, k, v, self.causal)
-        else:
-            o = full_attention(q, k, v, causal=self.causal)
-        o = o.reshape(b, t, self.hidden_size)
-        return o @ jnp.asarray(params["out_kernel"], x.dtype) + jnp.asarray(
-            params["out_bias"], x.dtype), state
+            return flash_attention(q, k, v, self.causal)
+        return full_attention(q, k, v, causal=self.causal)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = as_compute(x)
+        q, k, v = self.qkv_proj(params, x)
+        o = self._attend(q, k, v, x.shape[1])
+        return self.out_proj(params, o, x.dtype), state
+
+    def apply_with_kv(self, params, x):
+        """Forward that ALSO returns the projected K/V — the prefill path:
+        same strategy dispatch (flash at long T), K/V handed to the caller
+        for the paged cache. Returns ``(out, k, v)``."""
+        x = as_compute(x)
+        q, k, v = self.qkv_proj(params, x)
+        o = self._attend(q, k, v, x.shape[1])
+        return self.out_proj(params, o, x.dtype), k, v
 
     def _flash_single_device(self, t: int) -> bool:
+        if t <= 1:
+            # single-query decode step: flash tiling is pure overhead at
+            # query length 1 — plain dot attention regardless of strategy
+            return False
         if self.attn_strategy == "flash":
             return True
         if self.attn_strategy == "auto":
@@ -158,6 +186,17 @@ class TransformerLayer(Layer):
         }
         return params, {}
 
+    def _mlp(self, params, x):
+        """ln2 + MLP + residual — the block tail, shared by ``apply`` and the
+        cache-threaded prefill/decode paths."""
+        h, _ = self.ln2.apply(params["ln2"], {}, x)
+        h = h @ jnp.asarray(params["mlp_up_kernel"], x.dtype) + jnp.asarray(
+            params["mlp_up_bias"], x.dtype)
+        h = self.activation(h)
+        h = h @ jnp.asarray(params["mlp_down_kernel"], x.dtype) + jnp.asarray(
+            params["mlp_down_bias"], x.dtype)
+        return x + h
+
     def apply(self, params, state, x, *, training=False, rng=None):
         x = as_compute(x)
         h, _ = self.ln1.apply(params["ln1"], {}, x)
@@ -167,13 +206,47 @@ class TransformerLayer(Layer):
             a = jnp.where(jax.random.bernoulli(jax.random.fold_in(rng, 1), keep,
                                                a.shape), a / keep, 0.0).astype(a.dtype)
         x = x + a
-        h, _ = self.ln2.apply(params["ln2"], {}, x)
-        h = h @ jnp.asarray(params["mlp_up_kernel"], x.dtype) + jnp.asarray(
-            params["mlp_up_bias"], x.dtype)
-        h = self.activation(h)
-        h = h @ jnp.asarray(params["mlp_down_kernel"], x.dtype) + jnp.asarray(
-            params["mlp_down_bias"], x.dtype)
-        return x + h, state
+        return self._mlp(params, x), state
+
+    def apply_with_kv(self, params, x):
+        """Prefill forward: the exact ``apply`` computation (inference mode)
+        that additionally returns this block's projected K/V,
+        each (B, T, n_head, head_dim), for the paged cache."""
+        x = as_compute(x)
+        h, _ = self.ln1.apply(params["ln1"], {}, x)
+        a, k, v = self.attn.apply_with_kv(params["attn"], h)
+        x = x + a
+        return self._mlp(params, x), k, v
+
+    def decode_step(self, params, x, k_pages, v_pages, table, pos, *,
+                    page_size: int):
+        """One cache-threaded decode step for this block.
+
+        ``x``: (B, 1, hidden) — the new token's hidden state; ``k_pages``/
+        ``v_pages``: (P, page_size, H, D) — this LAYER's page pool;
+        ``table``: (B, pages_per_slot) int32; ``pos``: (B,) int32 — the
+        position being decoded (== tokens already cached). The new K/V are
+        written at ``pos`` BEFORE attending, so the token sees itself; the
+        single-query attention is plain dot against the gathered cache,
+        masked to ``pos + 1`` valid positions. Returns
+        ``(x_out, k_pages, v_pages)`` — fixed shapes throughout (the
+        ``decode-shape-stability`` lint invariant).
+        """
+        from ...ops.kv_cache import decode_attention, paged_read, paged_write
+
+        x = as_compute(x)
+        h, _ = self.ln1.apply(params["ln1"], {}, x)
+        q, k, v = self.attn.qkv_proj(params["attn"], h)      # (B, 1, H, D)
+        k_pages = paged_write(k_pages, table, pos, k[:, 0],
+                              page_size=page_size)
+        v_pages = paged_write(v_pages, table, pos, v[:, 0],
+                              page_size=page_size)
+        ks = paged_read(k_pages, table)                      # (B, T_max, H, D)
+        vs = paged_read(v_pages, table)
+        o = decode_attention(q[:, 0], ks.astype(q.dtype),
+                             vs.astype(q.dtype), pos + 1)    # (B, H, D)
+        x = x + self.attn.out_proj(params["attn"], o[:, None], x.dtype)
+        return self._mlp(params, x), k_pages, v_pages
 
     def compute_output_shape(self, input_shape):
         return tuple(input_shape[:-1]) + (self.hidden_size,)

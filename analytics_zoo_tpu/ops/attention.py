@@ -507,7 +507,14 @@ def prefer_flash_single_device(t: int) -> bool:
     :func:`sharded_attention` (sp==1) paths, so both resolve identically:
     on TPU the pallas kernel beats XLA full attention from 4k up, matches
     it at 2k at the model level (LONGCTX_BENCH.json, MFU_SWEEP.json), and
-    is the only option once the (H, T, T) score tensor would OOM."""
+    is the only option once the (H, T, T) score tensor would OOM.
+
+    Query length 1 — the KV-cache decode step — is excluded UNCONDITIONALLY
+    (not just by the threshold): a single query row has nothing to tile, so
+    the flash grid/VMEM machinery is pure overhead over one dot+softmax;
+    plain attention is the fast path no matter how the threshold is tuned."""
+    if t <= 1:
+        return False
     return jax.default_backend() == "tpu" and t >= 2048
 
 

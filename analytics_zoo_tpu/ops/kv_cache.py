@@ -1,0 +1,244 @@
+"""Paged KV cache for autoregressive decode serving.
+
+The serving stack's one-shot predict path recomputes the whole sequence per
+request; an autoregressive decode loop doing that would pay O(T²) attention
+per EMITTED token. This module is the TPU-native fix — the decode-side state
+store behind ``TransformerLM.prefill()``/``decode_step()`` and the continuous
+batcher (:mod:`analytics_zoo_tpu.serving.generation`):
+
+* **Pages, not ragged buffers.** K/V live in a preallocated pool of
+  fixed-size pages, ``(n_layers, n_pages, page_size, n_heads, head_dim)``.
+  A sequence *slot* owns an int32 page-table row mapping its logical
+  positions to pool pages; pages are handed out by the host-side
+  :class:`PagePool` as sequences grow and returned when they retire, so HBM
+  is sized for the *working set* (active tokens), not
+  ``n_slots × max_seq_len`` worst case.
+* **One decode executable.** Every device op here has shapes fixed by the
+  cache config — ``(n_slots, pages_per_slot)`` tables, ``(n_slots,)``
+  lengths — and masks to each row's true length instead of reshaping, the
+  same pow2-bucket discipline the serving engine uses for batch sizes. The
+  whole multi-slot decode step compiles ONCE; admission/retirement never
+  changes a traced shape (the ``decode-shape-stability`` graph-lint rule
+  asserts exactly this).
+* **Page 0 is scratch.** The pool never hands out page 0; inactive slots
+  and not-yet-allocated table entries point at it, so masked lanes scatter
+  harmlessly into scratch instead of needing a traced branch.
+
+Parity: the reference's Cluster Serving has no decode path at all (one-shot
+Flink inference, PAPERS.md "BigDL 2.0" streams *requests*, not tokens);
+paged attention is the standard modern serving answer rebuilt here on
+jnp gather/scatter so it runs on any backend and stays one jaxpr.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+#: Page id every unallocated / masked table entry points at. The pool never
+#: allocates it, so garbage writes from inactive lanes land in scratch.
+SCRATCH_PAGE = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Static geometry of one paged cache (fixes every traced shape)."""
+
+    n_layers: int
+    n_heads: int
+    head_dim: int
+    n_slots: int                       # concurrent decode sequences
+    page_size: int = 16                # tokens per page
+    pages_per_slot: int = 16           # max_seq_len = page_size * pages_per_slot
+    n_pages: Optional[int] = None      # pool size incl. scratch (None = full)
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.page_size < 1 or self.pages_per_slot < 1:
+            raise ValueError("page_size and pages_per_slot must be >= 1")
+        if self.n_pages is not None and self.n_pages < 2:
+            raise ValueError("n_pages must leave room for scratch + 1 page")
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.page_size * self.pages_per_slot
+
+    @property
+    def total_pages(self) -> int:
+        # +1: page 0 is reserved scratch and backs no sequence
+        if self.n_pages is not None:
+            return self.n_pages
+        return self.n_slots * self.pages_per_slot + 1
+
+
+def init_cache(cfg: KVCacheConfig) -> Dict[str, jax.Array]:
+    """Preallocate the K/V page pools (zeros; contents only ever read through
+    a length mask, so stale pages are invisible)."""
+    shape = (cfg.n_layers, cfg.total_pages, cfg.page_size, cfg.n_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+class PagePool:
+    """Host-side free-list allocator over the cache's page pool.
+
+    Thread-safe; page 0 (scratch) is never handed out. ``alloc`` raises
+    :class:`OutOfPages` when the pool is dry — the batcher turns that into a
+    truncated stream rather than a deadlock.
+    """
+
+    def __init__(self, cfg: KVCacheConfig):
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(cfg.total_pages - 1, 0, -1))
+        self._capacity = len(self._free)
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def alloc(self, n: int = 1) -> List[int]:
+        with self._lock:
+            if n > len(self._free):
+                raise OutOfPages(
+                    f"requested {n} pages, {len(self._free)} free "
+                    f"(capacity {self._capacity})")
+            out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def release(self, pages: Sequence[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p == SCRATCH_PAGE:
+                    continue
+                if p in self._free:
+                    raise ValueError(f"double free of page {p}")
+                self._free.append(int(p))
+
+
+class OutOfPages(RuntimeError):
+    """The page pool cannot satisfy an allocation (working set too big)."""
+
+
+# ---------------------------------------------------------------------------
+# device ops — all shapes fixed by KVCacheConfig; traced once
+# ---------------------------------------------------------------------------
+
+def paged_write(pages: jax.Array, table: jax.Array, pos: jax.Array,
+                new: jax.Array, *, page_size: int) -> jax.Array:
+    """Write one token's K or V per slot.
+
+    ``pages``: (P, page_size, H, D) — ONE layer's pool.
+    ``table``: (B, pages_per_slot) int32; ``pos``: (B,) int32 (the position
+    being written, i.e. the slot's current length); ``new``: (B, H, D).
+    Masked/inactive slots must carry table rows full of ``SCRATCH_PAGE``.
+    """
+    page_idx = pos // page_size
+    offset = pos % page_size
+    page_ids = jnp.take_along_axis(table, page_idx[:, None], axis=1)[:, 0]
+    return pages.at[page_ids, offset].set(new.astype(pages.dtype))
+
+
+def paged_read(pages: jax.Array, table: jax.Array) -> jax.Array:
+    """Gather a slot-major contiguous view of one layer's cache.
+
+    ``pages``: (P, page_size, H, D); ``table``: (B, pages_per_slot) →
+    (B, pages_per_slot * page_size, H, D). Fixed output shape — reads beyond
+    a slot's true length surface scratch/stale values that the attention
+    mask removes.
+    """
+    b, pps = table.shape
+    gathered = pages[table]                      # (B, PPS, page, H, D)
+    return gathered.reshape(b, pps * pages.shape[1], *pages.shape[2:])
+
+
+def prefill_write(pages: jax.Array, table: jax.Array, kv: jax.Array,
+                  *, page_size: int) -> jax.Array:
+    """Scatter a whole prefill's K or V into the pool.
+
+    ``kv``: (B, T_bucket, H, D) with T_bucket divisible by ``page_size``;
+    table entries past the allocated prefix are ``SCRATCH_PAGE``, so bucket
+    padding scatters into scratch.
+    """
+    b, t, h, d = kv.shape
+    if t % page_size:
+        raise ValueError(f"prefill bucket {t} must divide page_size "
+                         f"{page_size}")
+    n_pages = t // page_size
+    tiles = kv.reshape(b, n_pages, page_size, h, d).astype(pages.dtype)
+    return pages.at[table[:, :n_pages]].set(tiles)
+
+
+def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     lengths: jax.Array) -> jax.Array:
+    """Single-query attention against a cached prefix, masked to each row's
+    true length.
+
+    ``q``: (B, H, D); ``k``/``v``: (B, T_max, H, D); ``lengths``: (B,) —
+    number of VALID cache positions (the new token's K/V already written, so
+    the query attends to itself). Plain dot attention on purpose: at query
+    length 1 flash tiling is pure overhead (see
+    ``ops.attention.prefer_flash_single_device``); softmax statistics in f32.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bhd,bthd->bht", q, k).astype(jnp.float32)
+    scores = scores / np.sqrt(d).astype(np.float32)
+    t = k.shape[1]
+    mask = jnp.arange(t, dtype=jnp.int32)[None, :] < lengths[:, None]  # (B,T)
+    scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bht,bthd->bhd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# sampling — per-request keys so continuous-batch scheduling never changes a
+# stream's tokens (determinism gate in tests/test_generation.py)
+# ---------------------------------------------------------------------------
+
+def sample_tokens(logits: jax.Array, seeds: jax.Array, token_idx: jax.Array,
+                  temperature: jax.Array, *, top_k: int = 0) -> jax.Array:
+    """Sample one token per row under an explicit per-request PRNG key.
+
+    ``logits``: (B, V) — any float dtype, upcast to f32 for the softmax.
+    ``seeds``: (B,) uint32/int — per-REQUEST seed; ``token_idx``: (B,) —
+    the row's generated-token ordinal. The key is
+    ``fold_in(PRNGKey(seed), token_idx)``: token i of request r samples
+    identically no matter which slot or decode step it lands in, which is
+    what makes continuous admit/retire scheduling reproducible.
+    ``temperature``: (B,) f32; rows at <= 0 take argmax (greedy).
+    ``top_k`` (static): 0 = full distribution, else restrict to the k
+    highest-logit tokens.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = jnp.maximum(temperature.astype(jnp.float32), 1e-6)[:, None]
+    scaled = logits / temp
+    if top_k:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled >= kth, scaled, NEG_INF)
+
+    def one(row, seed, idx):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), idx)
+        return jax.random.categorical(key, row)
+
+    sampled = jax.vmap(one)(scaled, seeds.astype(jnp.uint32),
+                            token_idx.astype(jnp.uint32)).astype(jnp.int32)
+    return jnp.where(temperature <= 0, greedy, sampled)
+
+
+__all__ = [
+    "KVCacheConfig", "OutOfPages", "PagePool", "SCRATCH_PAGE",
+    "decode_attention", "init_cache", "paged_read", "paged_write",
+    "prefill_write", "sample_tokens",
+]

@@ -36,6 +36,9 @@ _B_RUNS = _tm.counter("zoo_batch_runs_total",
                       "Micro-batches dispatched to predict_fn")
 _B_PADDED = _tm.counter("zoo_batch_padded_rows_total",
                         "Zero-pad rows added to reach a bucket size")
+_B_CANCELLED = _tm.counter("zoo_batch_cancelled_total",
+                           "Queued records dropped because their waiter "
+                           "timed out/cancelled before the batcher ran them")
 _B_SIZE = _tm.histogram("zoo_batch_size",
                         "Records coalesced per micro-batch",
                         buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
@@ -47,13 +50,17 @@ _tm.collector("zoo_batch_queue_depth",
 
 
 class _Slot:
-    __slots__ = ("tensors", "event", "result", "error")
+    __slots__ = ("tensors", "event", "result", "error", "cancelled")
 
     def __init__(self, tensors):
         self.tensors = tensors
         self.event = threading.Event()
         self.result = None
         self.error: Optional[Exception] = None
+        # set by a timed-out/abandoning waiter: the batcher must DROP this
+        # slot instead of computing it into a later batch (nobody is waiting;
+        # the work and its batch space would be pure waste)
+        self.cancelled = False
 
 
 class MicroBatcher:
@@ -81,6 +88,7 @@ class MicroBatcher:
         self.max_batch_seen = 0
         self.batch_sizes = collections.deque(maxlen=1000)
         self.padded_rows = 0
+        self.cancelled_drops = 0
         # every (bucket, per-record signature) that reached predict_fn: with
         # bucket_pad this stays <= len(buckets) per tensor signature, which is
         # exactly the "no mid-traffic recompile" property /metrics watches
@@ -101,7 +109,15 @@ class MicroBatcher:
     @staticmethod
     def wait(slot: _Slot, timeout_s: float = 30.0):
         if not slot.event.wait(timeout_s):
-            raise TimeoutError("micro-batch prediction timed out")
+            # mark-then-recheck: the batcher may have completed the slot
+            # between the wait expiring and the flag landing — in that case
+            # the result is good and the cancel must not stand. A slot that
+            # stays cancelled is dropped at drain time instead of being
+            # silently computed into a later batch (the timeout leak).
+            slot.cancelled = True
+            if not slot.event.is_set():
+                raise TimeoutError("micro-batch prediction timed out")
+            slot.cancelled = False
         if slot.error is not None:
             raise slot.error
         return slot.result
@@ -142,9 +158,27 @@ class MicroBatcher:
             slots = self._drain()
             if not slots:
                 continue
+            # drop slots whose waiter already gave up (timeout leak fix):
+            # computing them would burn batch space + device time on results
+            # nobody reads
+            live = []
+            for s in slots:
+                if s.cancelled:
+                    self.cancelled_drops += 1
+                    _B_CANCELLED.inc()
+                    # error BEFORE event: a waiter racing its own timeout
+                    # recheck must see a raised error, never result=None
+                    s.error = TimeoutError(
+                        "record dropped: waiter timed out before the "
+                        "batcher ran it")
+                    s.event.set()
+                else:
+                    live.append(s)
+            if not live:
+                continue
             # group by tensor signature — only same-shaped records stack
             groups: Dict[Tuple, List[_Slot]] = {}
-            for s in slots:
+            for s in live:
                 groups.setdefault(self._signature(s.tensors), []).append(s)
             for group in groups.values():
                 self._run_group(group)
@@ -204,6 +238,7 @@ class MicroBatcher:
             "max_batch_size": self.max_batch_seen,
             "queue_depth": self._q.qsize(),
             "padded_rows": self.padded_rows,
+            "cancelled_drops": self.cancelled_drops,
             "distinct_batch_shapes": len(self.batch_shapes_seen),
         }
 

@@ -52,9 +52,9 @@ from .wire import (MAX_MSG, VERSION as WIRE_VERSION,  # noqa: F401
                    _recv_exact, received_trace_context, recv_msg, send_msg,
                    wire_stats)
 
-_KNOWN_CMDS = frozenset({"XADD", "XGROUPCREATE", "XREADGROUP", "XACK",
-                         "HSET", "HGET", "HDEL", "LEN", "PING", "SHMOPEN",
-                         "INFO", "SHUTDOWN"})
+_KNOWN_CMDS = frozenset({"XADD", "XGROUPCREATE", "XREADGROUP", "XREAD",
+                         "XDELSTREAM", "XACK", "HSET", "HGET", "HDEL",
+                         "LEN", "PING", "SHMOPEN", "INFO", "SHUTDOWN"})
 # unknown verbs collapse to one label value: client-supplied strings must not
 # mint unbounded counter children in the process-wide registry
 _CMDS = _tm.counter("zoo_broker_commands_total",
@@ -232,6 +232,15 @@ class _Store:
                 elif op == "P":
                     _, stream, entry_id, payload = rec
                     all_payloads[stream][entry_id] = payload
+                elif op == "S":
+                    stream = rec[1]
+                    self.streams.pop(stream, None)
+                    self.trimmed.pop(stream, None)
+                    all_payloads.pop(stream, None)
+                    for key in [k for k in self.cursors if k[0] == stream]:
+                        del self.cursors[key]
+                    for key in [k for k in self.pending if k[0] == stream]:
+                        del self.pending[key]
                 elif op == "H":
                     self.hashes[rec[1]] = rec[2]
                 elif op == "D":
@@ -318,6 +327,54 @@ class _Store:
                 self._log("R", stream, group, self.cursors[key],
                           [i for i, _ in out])
             return out
+
+    def xread(self, stream: str, cursor: int, count: int,
+              block_ms: int) -> Tuple[int, List[Tuple[str, Any]]]:
+        """Plain cursor read (no group, no pending-entry tracking): entries
+        after absolute index ``cursor``, blocking up to ``block_ms`` for new
+        ones. The generation streaming path fans token-delta frames out with
+        this — every reader sees every frame, cursors are client-state, and
+        nothing is logged (reads mutate nothing). ``cursor`` is an absolute
+        per-stream index (monotonic across trims); returns
+        ``(next_cursor, entries)``."""
+        deadline = None if block_ms <= 0 else block_ms / 1e3
+        with self.cond:
+            cursor = max(int(cursor), 0)
+
+            # .get()-based reads: polling a not-yet-written (or deleted)
+            # stream must not mint defaultdict entries that outlive it
+            def avail() -> int:
+                return (self.trimmed.get(stream, 0)
+                        + len(self.streams.get(stream, ())) - cursor)
+
+            if avail() <= 0 and deadline:
+                self.cond.wait_for(lambda: avail() > 0, timeout=deadline)
+            # entries the cursor points at that were already trimmed away are
+            # skipped (the reader was too slow for the retention window)
+            trimmed = self.trimmed.get(stream, 0)
+            start = max(0, cursor - trimmed)
+            out = self.streams.get(stream, [])[start:start + count]
+            next_cursor = trimmed + start + len(out)
+            return next_cursor, list(out)
+
+    def sdel(self, stream: str) -> None:
+        """Delete a whole stream and every per-group cursor/pending record
+        attached to it (the generation path's per-request ``genout:*``
+        streams are deleted by their consumer after the final frame — the
+        streaming twin of result-hash HDEL, keeping long-running broker
+        state bounded by LIVE requests)."""
+        with self.cond:
+            existed = stream in self.streams
+            self.streams.pop(stream, None)
+            self.trimmed.pop(stream, None)
+            for key in [k for k in self.cursors if k[0] == stream]:
+                del self.cursors[key]
+            for key in [k for k in self.pending if k[0] == stream]:
+                del self.pending[key]
+            for key in [k for k in self.redeliver if k[0] == stream]:
+                del self.redeliver[key]
+            if existed:
+                self._log("S", stream)
 
     def xack(self, stream: str, group: str, ids: List[str]) -> int:
         with self.cond:
@@ -442,6 +499,12 @@ class _Handler(socketserver.BaseRequestHandler):
             return "OK"
         if cmd == "XREADGROUP":
             return store.xreadgroup(req[1], req[2], req[3], req[4])
+        if cmd == "XREAD":
+            return store.xread(req[1], req[2], req[3],
+                               req[4] if len(req) > 4 else 0)
+        if cmd == "XDELSTREAM":
+            store.sdel(req[1])
+            return "OK"
         if cmd == "XACK":
             return store.xack(req[1], req[2], req[3])
         if cmd == "HSET":

@@ -35,6 +35,16 @@ class ServingConfig:
                                          # regression class at model-load
                                          # time; "off" skips
     log_dir: Optional[str] = None        # InferenceSummary TB dir
+    # --- autoregressive generation (serving/generation.py) ---
+    gen_slots: int = 8                   # concurrent decode sequences (the
+                                         # continuous batcher's fixed width)
+    gen_page_size: int = 16              # KV-cache tokens per page (pow2)
+    gen_max_seq_len: int = 512           # prompt + generated cap per stream
+    gen_pages: int = 0                   # KV page-pool size (0 = full
+                                         # n_slots x pages_per_slot + scratch)
+    gen_top_k: int = 0                   # sampling top-k (0 = full dist;
+                                         # static: part of the ONE compiled
+                                         # decode executable)
     # --- resilience (common.resilience wiring) ---
     infer_workers: int = 1               # model-worker threads; dead ones are
                                          # respawned by the engine supervisor
@@ -93,6 +103,16 @@ class ServingConfig:
                 raise ValueError(f"graph_checks must be 'off'/'warn'/"
                                  f"'raise', got {gc!r}")
             flat["graph_checks"] = val
+        gen = raw.get("generation") or {}
+        for key, alias in (("gen_slots", "slots"),
+                           ("gen_page_size", "page_size"),
+                           ("gen_max_seq_len", "max_seq_len"),
+                           ("gen_pages", "pages"),
+                           ("gen_top_k", "top_k")):
+            if key in raw:
+                flat[key] = int(raw[key])
+            elif alias in gen:
+                flat[key] = int(gen[alias])
         for key in ("infer_workers", "heartbeat_timeout_s",
                     "http_max_inflight", "breaker_failure_threshold",
                     "breaker_reset_timeout_s"):

@@ -122,6 +122,9 @@ class _Handler(BaseHTTPRequestHandler):
                                 "welcome to analytics zoo web serving"})
 
     def do_POST(self):
+        if self.path == "/generate":
+            self._do_generate()
+            return
         if self.path not in ("/predict", "/models/predict"):
             self._respond(404, {"error": f"no route {self.path}"})
             return
@@ -169,6 +172,115 @@ class _Handler(BaseHTTPRequestHandler):
             app._release()
 
 
+    # -- streaming generation -------------------------------------------------
+
+    def _write_chunk(self, data: bytes) -> None:
+        """One HTTP/1.1 chunked-transfer chunk (hand-rolled: the stdlib
+        handler has no chunked writer)."""
+        self.wfile.write(f"{len(data):x}\r\n".encode("ascii") + data
+                         + b"\r\n")
+
+    def _abort_stream(self, error: str) -> None:
+        """Mid-stream failure after the 200/chunked headers are gone: emit an
+        error final frame and terminate the chunked body cleanly so the
+        client's reader ends instead of hanging."""
+        try:
+            self._write_chunk(json.dumps(
+                {"tokens": [], "final": True, "outcome": "error",
+                 "error": error}).encode("utf-8") + b"\n")
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            pass
+
+    def _do_generate(self):
+        """POST /generate: ``{"prompt": [ids...], "max_new_tokens": N,
+        "temperature": t, "seed": s, "eos_id": e, "stream": true}``.
+
+        ``stream: true`` (default) answers with ``Transfer-Encoding:
+        chunked`` — one JSON line per token-delta frame plus a final-marker
+        line, flushed as the decode loop emits, so the client sees tokens at
+        inter-token latency instead of request latency. ``stream: false``
+        accumulates and answers one JSON object (old one-shot shape)."""
+        app: "FrontEndApp" = self.server.app  # type: ignore[attr-defined]
+        if not app._admit():
+            app.shed_requests += 1
+            _HTTP_SHED.inc()
+            _HTTP_REQS.labels(code="503").inc()
+            self._respond_shed(1.0, "server overloaded, request shed")
+            return
+        code = "500"
+        headers_sent = False
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            prompt = body.get("prompt")
+            if not isinstance(prompt, list) or not prompt:
+                raise ValueError('body must contain a non-empty "prompt" '
+                                 'token-id list')
+            stream = bool(body.get("stream", True))
+            kw = dict(max_new_tokens=int(body.get("max_new_tokens", 32)),
+                      temperature=float(body.get("temperature", 0.0)),
+                      seed=int(body.get("seed", 0)),
+                      eos_id=(int(body["eos_id"])
+                              if body.get("eos_id") is not None else None))
+            with _tm.span("serving.http.generate", n=len(prompt)):
+                frames = app.generate_frames(prompt, timeout_s=app.timeout_s,
+                                             **kw)
+                if not stream:
+                    tokens, meta = [], {}
+                    for toks, final, m in frames:
+                        tokens.extend(toks)
+                        if final:
+                            meta = m
+                    if meta.get("error"):
+                        raise RuntimeError(meta["error"])
+                    code = "200"
+                    self._respond(200, {"tokens": tokens,
+                                        "outcome": meta.get("outcome", "ok"),
+                                        "n_tokens": len(tokens)})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                headers_sent = True
+                for toks, final, meta in frames:
+                    line = {"tokens": list(toks), "final": bool(final)}
+                    if final:
+                        line.update({k: meta[k] for k in
+                                     ("outcome", "error", "n_tokens")
+                                     if k in meta})
+                    self._write_chunk(json.dumps(line).encode("utf-8")
+                                      + b"\n")
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+                code = "200"
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            # a late validation error (e.g. prompt over gen_max_seq_len,
+            # raised by submit() at the generator's FIRST iteration) lands
+            # after the 200/chunked headers — a second status line would
+            # corrupt the open chunked body
+            code = "400"
+            if headers_sent:
+                self._abort_stream(str(e))
+            else:
+                self._respond(400, {"error": str(e)})
+        except TimeoutError as e:
+            code = "504"
+            if headers_sent:
+                self._abort_stream(str(e))
+            else:
+                self._respond(504, {"error": str(e)})
+        except Exception as e:
+            if headers_sent:
+                self._abort_stream(str(e))
+            else:
+                self._respond(500, {"error": str(e)})
+        finally:
+            _HTTP_REQS.labels(code=code).inc()
+            app._release()
+
+
 class _Server(ThreadingHTTPServer):
     # default listen backlog (5) drops/resets connections under concurrent
     # clients — the whole point of the micro-batching mode
@@ -186,7 +298,7 @@ class FrontEndApp:
                  max_inflight: Optional[int] = None,
                  registry: Optional[HealthRegistry] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 engine_stats=None):
+                 engine_stats=None, generator=None):
         self.config = config or ServingConfig()
         self.timeout_s = timeout_s
         self.registry = registry             # backs /healthz (None => always ok)
@@ -223,6 +335,10 @@ class FrontEndApp:
         # ThreadingHTTPServer spawns a fresh thread per request, so cache broker
         # connections in a pool rather than thread-locals (which would never hit)
         self._oq_pool: "queue.LifoQueue[OutputQueue]" = queue.LifoQueue()
+        # streaming generation: an in-process ContinuousBatcher (direct mode)
+        # or — when absent — the broker-backed GenerationClient path
+        self._generator = generator
+        self._gc_pool: "queue.LifoQueue" = queue.LifoQueue()
 
     @property
     def port(self) -> int:
@@ -305,6 +421,62 @@ class FrontEndApp:
         self.breaker.record_success()
         return out
 
+    @contextlib.contextmanager
+    def _gen_client(self):
+        from .generation import GenerationClient
+
+        try:
+            gc = self._gc_pool.get_nowait()
+        except queue.Empty:
+            gc = GenerationClient(self.config.queue_host,
+                                  self.config.queue_port)
+        try:
+            yield gc
+        except BaseException:
+            # anything but a clean finish — TimeoutError, GeneratorExit
+            # (client disconnected mid-stream), connection errors — must
+            # close the socket, not strand it unreferenced
+            gc.close()
+            raise
+        else:
+            self._gc_pool.put(gc)
+
+    def generate_frames(self, prompt, timeout_s: float = 30.0, **kw):
+        """Yield ``(tokens, final, meta)`` frames for one generation request
+        — in-process when a generator (ContinuousBatcher) was attached,
+        otherwise through the broker's generation engine. An abandoned
+        consumer (client disconnect mid-stream, timeout) CANCELS the
+        underlying request — otherwise the decode loop would keep burning a
+        slot + KV pages to max_new_tokens for output nobody reads."""
+        if self._generator is not None:
+            handle = self._generator.submit(prompt, **kw)
+            try:
+                yield from handle.frames(timeout_s=timeout_s)
+            finally:
+                handle.cancel()   # no-op once the stream finished
+            return
+        with self._gen_client() as gc:
+            uri = gc.submit(prompt, **kw)
+            n = 0
+            finished = False
+            try:
+                try:
+                    for chunk in gc.stream(uri, timeout_s=timeout_s):
+                        n += len(chunk)
+                        yield chunk.tolist(), False, {}
+                except RuntimeError as e:
+                    finished = True      # terminal frame consumed (error)
+                    yield [], True, {"outcome": "error", "error": str(e)}
+                    return
+                finished = True
+                yield [], True, {"outcome": "ok", "n_tokens": n}
+            finally:
+                if not finished:
+                    try:
+                        gc.cancel(uri)
+                    except Exception:
+                        pass
+
     def start(self) -> "FrontEndApp":
         threading.Thread(target=self._server.serve_forever, daemon=True,
                          name="serving-http").start()
@@ -320,3 +492,8 @@ class FrontEndApp:
             self._input.close()
         if self._batcher is not None:
             self._batcher.close()
+        while True:   # pooled generation clients (the generator itself is
+            try:      # caller-owned and NOT closed here)
+                self._gc_pool.get_nowait().close()
+            except queue.Empty:
+                break

@@ -19,8 +19,15 @@
 #                                           # no standalone quantize ops / no
 #                                           # int8 HBM intermediates — the
 #                                           # shape of the 0.72x dispatch
-#                                           # regression); never writes the
-#                                           # artifacts
+#                                           # regression); and gates the
+#                                           # generation decode path
+#                                           # (bench.py --generation --quick:
+#                                           # zero failed streams at N=8, one
+#                                           # compiled decode shape, empty
+#                                           # decode-lint findings,
+#                                           # continuous >= 1.5x RTC, flat
+#                                           # per-token cost); never writes
+#                                           # the artifacts
 #
 # SERVING_BENCH_TIMEOUT (seconds, default 900) caps the run so a wedged
 # accelerator tunnel can never hang CI.
@@ -34,6 +41,12 @@ if [[ "${1:-}" == "--quick" ]]; then
     scripts/run_lint.sh
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
         python serving_bench.py --quick
+    # generation decode-path gate: N=8 concurrent streams with zero failed
+    # streams, ONE compiled decode shape (bucket invariant), empty
+    # decode-shape-stability findings, continuous >= 1.5x run-to-completion
+    # on mixed-length traffic, flat per-token decode cost
+    timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+        python bench.py --generation --quick
     # int8 kernel-tier structural gate (writes KERNEL_BENCH.json for the
     # CPU leg; the TPU run overwrites it with real ratios + MFU)
     exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
