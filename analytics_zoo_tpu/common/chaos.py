@@ -164,6 +164,8 @@ KNOWN_SITES = {
     "conn.call",          # serving/client.py broker round-trip
     "data.prefetch",      # data/pipeline.py producer loop
     "estimator.step",     # engine/estimator.py per-step (both epoch runners)
+    "fleet.route",        # serving/fleet.py per-dispatch routing decision
+    "fleet.respawn",      # serving/fleet.py dead-replica respawn path
     "serving.generate",   # serving/generation.py continuous-batch decode loop
     "serving.infer",      # serving/engine.py model-worker batch loop
     "task_pool.worker",   # orca/task_pool.py worker loop

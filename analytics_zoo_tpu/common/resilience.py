@@ -335,6 +335,18 @@ class CircuitBreaker:
                     >= self.failure_threshold:
                 self._open()
 
+    def trip(self):
+        """Force the circuit OPEN immediately, regardless of the outcome
+        window — out-of-band eviction (a health registry declaring the
+        guarded component dead shouldn't wait for ``failure_threshold``
+        doomed calls to discover it). The normal open → half-open → probe
+        readmission path applies from here."""
+        with self._lock:
+            if self._state != self.OPEN:
+                self._open()
+            else:
+                self._opened_at = self._clock()   # restart the probe timer
+
     def call(self, fn: Callable, *args, **kw) -> Any:
         """Run ``fn`` through the breaker; raises :class:`CircuitOpenError`
         without calling when open."""
@@ -397,6 +409,10 @@ class HealthRegistry:
         self._clock = clock or time.monotonic
         self._lock = threading.Lock()
         self._entries: Dict[str, Dict[str, Any]] = {}
+        # liveness-transition listeners (fleet eviction/readmission hooks):
+        # fired by check_transitions(), never under the lock
+        self._listeners: List[Callable[[str, bool], None]] = []
+        self._last_dead: set = set()
         _LIVE_REGISTRIES.add(self)
 
     def register(self, name: str, timeout_s: Optional[float] = None,
@@ -449,6 +465,44 @@ class HealthRegistry:
         with self._lock:
             return sorted(n for n, e in self._entries.items()
                           if self._age(e) >= e["timeout_s"])
+
+    def add_transition_listener(self,
+                                fn: Callable[[str, bool], None]) -> None:
+        """Subscribe ``fn(component, alive)`` to liveness TRANSITIONS:
+        called with ``alive=False`` when a component's heartbeat goes stale
+        (eviction hook — e.g. trip a replica's circuit breaker) and
+        ``alive=True`` when a previously-dead component beats again or is
+        re-registered (readmission hook). Transitions are detected by
+        :meth:`check_transitions`, which the supervising loop must poll."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def check_transitions(self) -> List[Tuple[str, bool]]:
+        """Diff liveness against the last check and fire listeners for every
+        component that changed state. Listeners run OUTSIDE the registry
+        lock (they typically call back into breakers/routers that may read
+        this registry). Returns the ``(component, alive)`` transition list.
+
+        A deregistered component produces no transition — deregistration is
+        deliberate shutdown, not death."""
+        with self._lock:
+            dead_now = {n for n, e in self._entries.items()
+                        if self._age(e) >= e["timeout_s"]}
+            newly_dead = dead_now - self._last_dead
+            # revived = was dead at last check AND still registered AND alive
+            revived = {n for n in self._last_dead - dead_now
+                       if n in self._entries}
+            self._last_dead = dead_now
+            listeners = list(self._listeners)
+        transitions = [(n, False) for n in sorted(newly_dead)] + \
+                      [(n, True) for n in sorted(revived)]
+        for name, alive in transitions:
+            for fn in listeners:
+                try:
+                    fn(name, alive)
+                except Exception:   # a broken listener must not stop the
+                    pass            # supervisor loop or its peers
+        return transitions
 
     def healthy(self) -> bool:
         return not self.dead()

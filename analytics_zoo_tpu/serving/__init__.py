@@ -16,10 +16,12 @@ from .broker import QueueBroker, start_broker
 from .client import InputQueue, OutputQueue
 from .config import ServingConfig
 from .engine import ClusterServing
+from .fleet import FleetSupervisor, ReplicaRouter
 from .generation import (ContinuousBatcher, GenerationClient,
                          GenerationEngine)
 from .http_frontend import FrontEndApp
 
 __all__ = ["QueueBroker", "start_broker", "InputQueue", "OutputQueue",
            "ServingConfig", "ClusterServing", "ContinuousBatcher",
-           "GenerationClient", "GenerationEngine", "FrontEndApp"]
+           "FleetSupervisor", "GenerationClient", "GenerationEngine",
+           "FrontEndApp", "ReplicaRouter"]

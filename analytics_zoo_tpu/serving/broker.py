@@ -39,6 +39,7 @@ import collections
 import contextlib
 import json
 import os
+import socket
 import socketserver
 import threading
 import time
@@ -53,8 +54,9 @@ from .wire import (MAX_MSG, VERSION as WIRE_VERSION,  # noqa: F401
                    wire_stats)
 
 _KNOWN_CMDS = frozenset({"XADD", "XGROUPCREATE", "XREADGROUP", "XREAD",
-                         "XDELSTREAM", "XACK", "HSET", "HGET", "HDEL",
-                         "LEN", "PING", "SHMOPEN", "INFO", "SHUTDOWN"})
+                         "XDELSTREAM", "XTRANSFER", "XACK", "HSET", "HSETNX",
+                         "HGET", "HDEL", "LEN", "PING", "SHMOPEN", "INFO",
+                         "SHUTDOWN"})
 # unknown verbs collapse to one label value: client-supplied strings must not
 # mint unbounded counter children in the process-wide registry
 _CMDS = _tm.counter("zoo_broker_commands_total",
@@ -66,6 +68,14 @@ _SHM_NEG = _tm.counter(
     "zoo_broker_shm_negotiations_total",
     "SHMOPEN ring negotiations, by outcome (fallback = connection stays "
     "socket-only)", labels=("outcome",))
+_AOF_COMPACT = _tm.counter(
+    "zoo_broker_aof_compactions_total",
+    "AOF compactions (live-state rewrite + atomic rename) triggered by the "
+    "op-count or size threshold after startup")
+_DUP_DROPPED = _tm.counter(
+    "zoo_fleet_duplicate_results_total",
+    "HSETNX writes dropped because the key was already answered (a slow-not-"
+    "dead replica double-answering a requeued request)")
 
 
 class _Store:
@@ -76,11 +86,18 @@ class _Store:
     long-running deployment holds bounded memory.
     """
 
+    ANSWERED_MAXLEN = 65536   # dedup-tombstone LRU bound (see hsetnx)
+
     def __init__(self, maxlen: int = 65536, aof_path: Optional[str] = None,
-                 reclaim_idle_ms: int = 60_000):
+                 reclaim_idle_ms: int = 60_000,
+                 aof_rewrite_min_bytes: int = 64 << 20):
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.maxlen = maxlen
+        # size-triggered compaction floor: once the log grows past this, the
+        # next mutation rewrites live state to a fresh file (long-running
+        # fleet brokers must not replay days of dead records on restart)
+        self.aof_rewrite_min_bytes = aof_rewrite_min_bytes
         # delivered entries idle (unacked) past this are re-delivered to the
         # next reader — XAUTOCLAIM semantics, so a consumer that died with
         # in-flight work doesn't strand it until a broker restart
@@ -97,9 +114,24 @@ class _Store:
             collections.defaultdict(dict)
         self.redeliver: Dict[Tuple[str, str], List[Tuple[str, Any]]] = \
             collections.defaultdict(list)
+        # per-request delivery counts for delivered-but-unacked entries
+        # (XAUTOCLAIM/XPENDING parity: the fleet requeue verb reports how
+        # often each transferred request was already handed out). In-memory
+        # only — a broker restart resets counts, redelivery itself is what
+        # the AOF "R" records guarantee.
+        self.deliveries: Dict[Tuple[str, str], Dict[str, int]] = \
+            collections.defaultdict(dict)
+        # first-write-wins tombstones for HSETNX: keys ever written (even if
+        # HDEL'd since) stay "answered" while inside this bounded LRU, so a
+        # slow-not-dead replica's late duplicate result is dropped instead of
+        # recreating a hash the client already consumed
+        self._answered: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
+        self.compactions = 0      # post-startup AOF rewrites (INFO)
         self._aof = None
         self._aof_path = aof_path
         self._ops_since_rewrite = 0
+        self._aof_base_bytes = 0  # snapshot size after the last rewrite
         # replay visibility: counts by record op, surfaced by INFO/`cli info`
         # and mirrored into the shared metric registry
         self.replayed: Dict[str, int] = {}
@@ -108,7 +140,7 @@ class _Store:
                 self._replay(aof_path)
             # compact at startup: replaying history re-runs every trim ever
             # applied; the snapshot keeps restart time bounded by LIVE state
-            self._rewrite_locked()
+            self._rewrite_locked(startup=True)
 
     # -- append-only log ------------------------------------------------------
     REWRITE_EVERY_OPS = 200_000
@@ -122,10 +154,19 @@ class _Store:
             self._aof.flush()
             os.fsync(self._aof.fileno())
             self._ops_since_rewrite += 1
-            if self._ops_since_rewrite >= self.REWRITE_EVERY_OPS:
+            # two triggers: op count (bounded replay work) and byte size
+            # (bounded disk + restart time for fleet brokers whose dead
+            # XDELSTREAM'd records dominate the log). The size trigger is
+            # the min-bytes floor AND 2x the post-rewrite snapshot size
+            # (Redis auto-aof-rewrite-percentage analog): live state bigger
+            # than the floor must not make EVERY op pay a full synchronous
+            # rewrite — the log has to actually grow past the snapshot
+            if (self._ops_since_rewrite >= self.REWRITE_EVERY_OPS
+                    or self._aof.tell() >= max(self.aof_rewrite_min_bytes,
+                                               2 * self._aof_base_bytes)):
                 self._rewrite_locked()
 
-    def _rewrite_locked(self) -> None:
+    def _rewrite_locked(self, startup: bool = False) -> None:
         """Snapshot live state into a fresh log and atomically swap it in
         (Redis BGREWRITEAOF analog, done inline — live state is bounded by
         ``maxlen`` so the rewrite is cheap). Caller holds the lock, or is the
@@ -171,6 +212,10 @@ class _Store:
         os.replace(tmp, self._aof_path)
         self._aof = open(self._aof_path, "a", encoding="utf-8")
         self._ops_since_rewrite = 0
+        self._aof_base_bytes = self._aof.tell()
+        if not startup:   # the startup snapshot is bookkeeping, not a
+            self.compactions += 1            # traffic-triggered compaction
+            _AOF_COMPACT.inc()
 
     def _replay(self, path: str) -> None:
         # payloads of replayed appends still possibly needed for redelivery,
@@ -243,6 +288,9 @@ class _Store:
                         del self.pending[key]
                 elif op == "H":
                     self.hashes[rec[1]] = rec[2]
+                    # replayed writes re-arm the dedup tombstone: a duplicate
+                    # result arriving after a broker restart is still dropped
+                    self._mark_answered(rec[1])
                 elif op == "D":
                     self.hashes.pop(rec[1], None)
         # anything still pending was in flight when the broker died: schedule
@@ -322,8 +370,10 @@ class _Store:
                 self.cursors[key] = start + take
                 out.extend(self.streams[stream][start:start + take])
             if out:
+                dv = self.deliveries[key]
                 for i, payload in out:
                     self.pending[key][i] = (payload, now)
+                    dv[i] = dv.get(i, 0) + 1
                 self._log("R", stream, group, self.cursors[key],
                           [i for i, _ in out])
             return out
@@ -364,26 +414,82 @@ class _Store:
         streaming twin of result-hash HDEL, keeping long-running broker
         state bounded by LIVE requests)."""
         with self.cond:
-            existed = stream in self.streams
-            self.streams.pop(stream, None)
-            self.trimmed.pop(stream, None)
-            for key in [k for k in self.cursors if k[0] == stream]:
-                del self.cursors[key]
-            for key in [k for k in self.pending if k[0] == stream]:
-                del self.pending[key]
-            for key in [k for k in self.redeliver if k[0] == stream]:
-                del self.redeliver[key]
-            if existed:
-                self._log("S", stream)
+            self._sdel_locked(stream)
+
+    def _sdel_locked(self, stream: str) -> None:
+        existed = stream in self.streams
+        self.streams.pop(stream, None)
+        self.trimmed.pop(stream, None)
+        for key in [k for k in self.cursors if k[0] == stream]:
+            del self.cursors[key]
+        for key in [k for k in self.pending if k[0] == stream]:
+            del self.pending[key]
+        for key in [k for k in self.redeliver if k[0] == stream]:
+            del self.redeliver[key]
+        for key in [k for k in self.deliveries if k[0] == stream]:
+            del self.deliveries[key]
+        if existed:
+            self._log("S", stream)
+
+    def xtransfer(self, src: str, group: str, dst: str) -> Dict[str, Any]:
+        """Claim-transfer (the fleet's XAUTOCLAIM analog): atomically move
+        every request still owed by ``(src, group)`` — delivered-but-unacked
+        entries, crash-recovered redeliveries, and entries never delivered —
+        onto ``dst`` as fresh appends, then delete ``src``. Used by the
+        FleetSupervisor when a replica dies: its claimed work goes back to
+        the dispatch stream instead of stranding until idle-reclaim.
+
+        Per-entry delivery counts ride along: dict payloads are stamped with
+        ``__deliveries__`` (how often the entry was already handed to a
+        consumer) and the reply carries ``(new_id, deliveries)`` pairs. The
+        guarantee is at-least-once — a slow-not-dead replica may still finish
+        the work it claimed; result writes go through :meth:`hsetnx` so only
+        the first answer per uri lands (dedup-on-uri)."""
+        with self.cond:
+            if src == dst:
+                raise ValueError("xtransfer src and dst must differ")
+            key = (src, group)
+            moved: "collections.OrderedDict[str, Any]" = \
+                collections.OrderedDict()
+            for i, (payload, _ts) in sorted(
+                    self.pending.get(key, {}).items(),
+                    key=lambda kv: int(kv[0].split("-")[0])):
+                moved[i] = payload
+            for i, payload in self.redeliver.get(key, ()):
+                moved.setdefault(i, payload)
+            cur = self.cursors.get(key, 0)
+            for i, payload in self.streams.get(src, [])[cur:]:
+                moved.setdefault(i, payload)
+            counts = dict(self.deliveries.get(key, {}))
+            # delete src FIRST (logs "S"), then append to dst (logs "A"):
+            # replaying that order rebuilds exactly this post-transfer state
+            self._sdel_locked(src)
+            out = []
+            for i, payload in moved.items():
+                n = counts.get(i, 0)
+                if isinstance(payload, dict):
+                    payload = dict(payload)
+                    payload["__deliveries__"] = n
+                self._seq += 1
+                entry_id = f"{self._seq}-0"
+                self._append(dst, entry_id, payload)
+                self._log("A", dst, entry_id, payload)
+                out.append((entry_id, n))
+            if out:
+                self.cond.notify_all()
+            return {"moved": len(out), "entries": out}
 
     def xack(self, stream: str, group: str, ids: List[str]) -> int:
         with self.cond:
             key = (stream, group)
             n = 0
             dropped = set(ids)
+            dv = self.deliveries.get(key)
             for i in ids:
                 if self.pending[key].pop(i, None) is not None:
                     n += 1
+                if dv:
+                    dv.pop(i, None)
             # an entry acked while queued for crash redelivery (its result was
             # written before the crash) must not be served again
             redo = self.redeliver.get(key)
@@ -393,11 +499,36 @@ class _Store:
                 self._log("K", stream, group, list(ids))
             return n
 
+    def _mark_answered(self, key: str) -> None:
+        """Record ``key`` in the bounded first-write tombstone LRU."""
+        self._answered[key] = None
+        self._answered.move_to_end(key)
+        while len(self._answered) > self.ANSWERED_MAXLEN:
+            self._answered.popitem(last=False)
+
     def hset(self, key: str, mapping: Any) -> None:
         with self.cond:
             self.hashes[key] = mapping
+            self._mark_answered(key)
             self._log("H", key, mapping)
             self.cond.notify_all()
+
+    def hsetnx(self, key: str, mapping: Any) -> int:
+        """First-write-wins HSET: refuses (returns 0) when ``key`` is live OR
+        was EVER written within the tombstone window — even after the client
+        HDEL'd it. This is the fleet's dedup-on-uri primitive: a requeued
+        request answered by two replicas (the reassigned one and the slow-
+        not-dead original) produces exactly one client-visible result, and
+        the late duplicate can't recreate a consumed hash."""
+        with self.cond:
+            if key in self.hashes or key in self._answered:
+                _DUP_DROPPED.inc()
+                return 0
+            self.hashes[key] = mapping
+            self._mark_answered(key)
+            self._log("H", key, mapping)
+            self.cond.notify_all()
+            return 1
 
     def hget(self, key: str, block_ms: int = 0) -> Any:
         deadline = None if block_ms <= 0 else block_ms / 1e3
@@ -411,9 +542,16 @@ class _Store:
             self.hashes.pop(key, None)
             self._log("D", key)
 
-    def slen(self, stream: str) -> int:
+    def slen(self, stream: str, group: Optional[str] = None) -> int:
+        """Stream depth. With ``group``, counts the work OWED to that
+        group's consumer: undelivered entries plus delivered-but-unacked
+        (pending) ones — the fleet router's least_pending signal (a replica
+        that claimed a deep batch and died/stalled still owes it)."""
         with self.cond:
-            return len(self.streams[stream])
+            n = len(self.streams.get(stream, ()))
+            if group is not None:
+                n += len(self.pending.get((stream, group), ()))
+            return n
 
 
 # connection-scoped command sentinels (returned by _dispatch, acted on by
@@ -423,6 +561,16 @@ _SHUTDOWN = object()
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        super().setup()
+        # reply frames are small and latency-bound (see client.py _connect):
+        # Nagle + the client's delayed ACK costs ~40ms per round trip
+        try:
+            self.request.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
     def handle(self):
         from ..common.chaos import chaos_point
 
@@ -505,18 +653,22 @@ class _Handler(socketserver.BaseRequestHandler):
         if cmd == "XDELSTREAM":
             store.sdel(req[1])
             return "OK"
+        if cmd == "XTRANSFER":
+            return store.xtransfer(req[1], req[2], req[3])
         if cmd == "XACK":
             return store.xack(req[1], req[2], req[3])
         if cmd == "HSET":
             store.hset(req[1], req[2])
             return "OK"
+        if cmd == "HSETNX":
+            return store.hsetnx(req[1], req[2])
         if cmd == "HGET":
             return store.hget(req[1], req[2] if len(req) > 2 else 0)
         if cmd == "HDEL":
             store.hdel(req[1])
             return "OK"
         if cmd == "LEN":
-            return store.slen(req[1])
+            return store.slen(req[1], req[2] if len(req) > 2 else None)
         if cmd == "PING":
             return "PONG"
         if cmd == "SHMOPEN":
@@ -535,6 +687,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     # per-BROKER-INSTANCE counts (like streams/hashes) — the
                     # registry's zoo_broker_* counters aggregate the process
                     "aof_replayed_records": replayed,
+                    "aof_compactions": store.compactions,
                     "shm_negotiations": server.shm_counts(),
                     "commands": server.command_counts()}
         if cmd == "SHUTDOWN":
@@ -548,9 +701,11 @@ class QueueBroker(socketserver.ThreadingTCPServer):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  aof_path: Optional[str] = None,
-                 reclaim_idle_ms: int = 60_000):
+                 reclaim_idle_ms: int = 60_000,
+                 aof_rewrite_min_bytes: int = 64 << 20):
         super().__init__((host, port), _Handler)
-        self.store = _Store(aof_path=aof_path, reclaim_idle_ms=reclaim_idle_ms)
+        self.store = _Store(aof_path=aof_path, reclaim_idle_ms=reclaim_idle_ms,
+                            aof_rewrite_min_bytes=aof_rewrite_min_bytes)
         # per-instance observability counts for INFO (a process can host
         # several brokers; the registry counters aggregate across them)
         self._counts_lock = threading.Lock()
@@ -595,9 +750,13 @@ def main():  # pragma: no cover - exercised as a subprocess
                     help="append-only persistence file (replayed on start)")
     ap.add_argument("--reclaim-idle-ms", type=int, default=60_000,
                     help="redeliver entries unacked for this long (XAUTOCLAIM)")
+    ap.add_argument("--aof-rewrite-min-bytes", type=int, default=64 << 20,
+                    help="compact the AOF (rewrite live state, atomic rename) "
+                         "once it grows past this many bytes")
     args = ap.parse_args()
     broker = QueueBroker(args.host, args.port, aof_path=args.aof,
-                         reclaim_idle_ms=args.reclaim_idle_ms)
+                         reclaim_idle_ms=args.reclaim_idle_ms,
+                         aof_rewrite_min_bytes=args.aof_rewrite_min_bytes)
     print(f"queue broker listening on {args.host}:{broker.port}", flush=True)
     broker.serve_forever()
 

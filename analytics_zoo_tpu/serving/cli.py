@@ -12,6 +12,14 @@ re-delivers in-flight ones.
     python -m analytics_zoo_tpu.serving.cli status  --port 6380
     python -m analytics_zoo_tpu.serving.cli info    --port 6380
 
+Fleet operations (a stack running with ``replicas > 1``, serving/fleet.py):
+the commands ride broker control hashes, so they work from any host that can
+reach the broker — the supervising stack process picks them up.
+
+    python -m ... cli fleet-status     --port 6380            # roster + hb
+    python -m ... cli drain --replica r0 --port 6380          # graceful drain
+    python -m ... cli rolling-restart  --port 6380            # zero-downtime
+
 ``info`` prints the broker's data-plane gauges (wire protocol version,
 per-stream depths, bytes on wire by frame kind, shm attachment) as JSON —
 the operator-side view of the binary zero-copy data plane. Since the unified
@@ -136,20 +144,105 @@ def do_info(args) -> int:
     return 0
 
 
+def do_fleet_status(args) -> int:
+    """Roster + per-replica heartbeat view of a fleet-mode stack."""
+    from .engine import FLEET_HB_PREFIX
+    from .fleet import MEMBERS_KEY
+
+    try:
+        members = _call(args.host, args.port, "HGET", MEMBERS_KEY, 0)
+    except (OSError, ConnectionError, ValueError) as e:
+        print(f"broker on {args.host}:{args.port} unreachable: {e}",
+              file=sys.stderr)
+        return 3
+    if not isinstance(members, dict):
+        print("no fleet registered on this broker", file=sys.stderr)
+        return 4
+    import time
+
+    out = {"spawn": members.get("spawn"), "replicas": {}}
+    now = time.time()
+    for rid in members.get("replicas", ()):
+        hb = _call(args.host, args.port, "HGET", FLEET_HB_PREFIX + rid, 0)
+        if isinstance(hb, dict):
+            out["replicas"][rid] = {
+                "state": hb.get("state"),
+                "served": hb.get("served"),
+                "inflight": hb.get("inflight"),
+                "hb_age_s": round(now - float(hb.get("ts", 0)), 3)}
+        else:
+            out["replicas"][rid] = {"state": "no-heartbeat"}
+    print(json.dumps(out, indent=1, sort_keys=True))
+    return 0
+
+
+def do_drain(args) -> int:
+    """Graceful drain of one replica: it stops claiming new requests,
+    finishes + acks in-flight work, and reports state ``drained``."""
+    from .engine import FLEET_CTL_PREFIX, FLEET_HB_PREFIX
+
+    if not args.replica:
+        print("drain needs --replica <id>", file=sys.stderr)
+        return 2
+    try:
+        _call(args.host, args.port, "HSET", FLEET_CTL_PREFIX + args.replica,
+              {"state": "drain"})
+    except (OSError, ConnectionError, ValueError) as e:
+        print(f"broker unreachable: {e}", file=sys.stderr)
+        return 3
+
+    def drained():
+        hb = _call(args.host, args.port, "HGET",
+                   FLEET_HB_PREFIX + args.replica, 0)
+        if not (isinstance(hb, dict) and hb.get("state") == "drained"):
+            raise _NotYet()
+
+    if _await_condition(drained, args.wait):
+        print(f"replica {args.replica} drained")
+        return 0
+    print(f"replica {args.replica} not drained after {args.wait}s "
+          f"(still finishing in-flight work?)", file=sys.stderr)
+    return 1
+
+
+def do_rolling_restart(args) -> int:
+    """Ask the fleet supervisor for a rolling restart: each replica is
+    drained, restarted and readmitted in turn — N-1 replicas keep serving
+    at every instant (zero downtime)."""
+    import uuid
+
+    from .fleet import ROLLING_KEY
+
+    try:
+        _call(args.host, args.port, "HSET", ROLLING_KEY,
+              {"nonce": uuid.uuid4().hex})
+    except (OSError, ConnectionError, ValueError) as e:
+        print(f"broker unreachable: {e}", file=sys.stderr)
+        return 3
+    print("rolling restart requested (watch `cli fleet-status`)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="cluster-serving lifecycle (start/stop/restart/status)")
+        description="cluster-serving lifecycle (start/stop/restart/status) "
+                    "+ fleet operations (fleet-status/drain/rolling-restart)")
     ap.add_argument("action",
-                    choices=["start", "stop", "restart", "status", "info"])
+                    choices=["start", "stop", "restart", "status", "info",
+                             "fleet-status", "drain", "rolling-restart"])
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6380)
     ap.add_argument("--aof", default=None,
                     help="append-only persistence file (start/restart)")
+    ap.add_argument("--replica", default=None,
+                    help="replica id for `drain` (see fleet-status)")
     ap.add_argument("--wait", type=float, default=10.0,
-                    help="seconds to wait for start/stop to take effect")
+                    help="seconds to wait for start/stop/drain to take effect")
     args = ap.parse_args(argv)
     return {"start": do_start, "stop": do_stop, "restart": do_restart,
-            "status": do_status, "info": do_info}[args.action](args)
+            "status": do_status, "info": do_info,
+            "fleet-status": do_fleet_status, "drain": do_drain,
+            "rolling-restart": do_rolling_restart}[args.action](args)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -83,6 +83,13 @@ class _Conn:
     def _connect(self):
         self.sock = socket.create_connection((self.host, self.port),
                                              timeout=self.timeout)
+        # small request/reply frames are latency-bound: without NODELAY the
+        # kernel holds the second small write of a frame for the peer's
+        # delayed ACK (~40ms per broker round trip)
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
         if self.policy is not None:
             # policy-managed conns: the connect timeout guards unreachable
             # hosts, but replies to blocking ops (XREADGROUP block_ms, HGET

@@ -45,6 +45,22 @@ class ServingConfig:
     gen_top_k: int = 0                   # sampling top-k (0 = full dist;
                                          # static: part of the ONE compiled
                                          # decode executable)
+    # --- replica fleet (serving/fleet.py) ---
+    replicas: int = 1                    # engine replicas behind the router
+                                         # (1 = classic single-engine stack)
+    fleet_policy: str = "least_pending"  # routing policy: "least_pending"
+                                         # (queue-depth-aware) | "round_robin"
+    fleet_spawn: str = "thread"          # replica isolation: "thread" (N
+                                         # engines in-process) | "process"
+                                         # (one subprocess per replica; needs
+                                         # model_path — a live model object
+                                         # can't cross the fork)
+    fleet_heartbeat_s: float = 0.5       # replica -> broker hb cadence
+    fleet_failover_timeout_s: float = 3.0  # hb staleness => dead: evict,
+                                         # requeue claimed work, respawn
+    fleet_spawn_grace_s: float = 30.0    # extra liveness budget for a replica
+                                         # that is still loading/compiling its
+                                         # model (first heartbeat pending)
     # --- resilience (common.resilience wiring) ---
     infer_workers: int = 1               # model-worker threads; dead ones are
                                          # respawned by the engine supervisor
@@ -113,6 +129,24 @@ class ServingConfig:
                 flat[key] = int(raw[key])
             elif alias in gen:
                 flat[key] = int(gen[alias])
+        fleet = raw.get("fleet") or {}
+        for key, alias in (("replicas", "replicas"),
+                           ("fleet_policy", "policy"),
+                           ("fleet_spawn", "spawn"),
+                           ("fleet_heartbeat_s", "heartbeat_s"),
+                           ("fleet_failover_timeout_s", "failover_timeout_s"),
+                           ("fleet_spawn_grace_s", "spawn_grace_s")):
+            if key in raw:
+                flat[key] = type(getattr(cls, key))(raw[key])
+            elif alias in fleet:
+                flat[key] = type(getattr(cls, key))(fleet[alias])
+        if flat.get("fleet_policy") not in (None, "least_pending",
+                                            "round_robin"):
+            raise ValueError(f"fleet policy must be 'least_pending'/"
+                             f"'round_robin', got {flat['fleet_policy']!r}")
+        if flat.get("fleet_spawn") not in (None, "thread", "process"):
+            raise ValueError(f"fleet spawn must be 'thread'/'process', "
+                             f"got {flat['fleet_spawn']!r}")
         for key in ("infer_workers", "heartbeat_timeout_s",
                     "http_max_inflight", "breaker_failure_threshold",
                     "breaker_reset_timeout_s"):

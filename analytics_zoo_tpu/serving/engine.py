@@ -15,6 +15,7 @@ MXU-batched across the micro-batch.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -38,6 +39,15 @@ _RECORDS = _tm.counter("zoo_serving_records_total",
                        labels=("outcome",))
 _RESPAWNS = _tm.counter("zoo_serving_worker_respawns_total",
                         "Dead model-worker slots respawned by the supervisor")
+_DUP_RESULTS = _tm.counter(
+    "zoo_fleet_duplicate_results_dropped_total",
+    "Result writes a dedup-mode sink dropped because another replica "
+    "already answered the uri (HSETNX returned 0)")
+
+# fleet coordination keys on the broker (written by replica engines, read by
+# the ReplicaRouter/FleetSupervisor in serving/fleet.py)
+FLEET_HB_PREFIX = "fleet:hb:"     # per-replica heartbeat hash
+FLEET_CTL_PREFIX = "fleet:ctl:"   # per-replica control hash (drain commands)
 
 
 class ClusterServing:
@@ -49,9 +59,20 @@ class ClusterServing:
 
     def __init__(self, model=None, config: Optional[ServingConfig] = None,
                  group: str = "serving",
-                 registry: Optional[HealthRegistry] = None):
+                 registry: Optional[HealthRegistry] = None,
+                 stream: Optional[str] = None,
+                 replica_id: Optional[str] = None,
+                 dedup_results: bool = False):
         self.config = config or ServingConfig()
         self.group = group
+        # fleet wiring: a replica consumes its OWN stream (the router's
+        # per-replica dispatch stream) under its own consumer group, announces
+        # itself via a broker-side heartbeat hash, and writes results
+        # first-write-wins (HSETNX) so a requeued request answered twice
+        # reaches the client exactly once
+        self.stream = stream or INPUT_STREAM
+        self.replica_id = replica_id
+        self.dedup_results = dedup_results
         # liveness registry: every stage thread registers + beats; the
         # supervisor respawns dead model workers; /healthz reads status()
         self.registry = registry if registry is not None else HealthRegistry(
@@ -73,6 +94,13 @@ class ClusterServing:
                 max_batch_size=max(self.config.batch_size, 1),
                 summary=self.summary).load_zoo(self.config.model_path)
         self._stop = threading.Event()
+        # drain mode: stop CLAIMING new stream entries, finish + ack what is
+        # already in flight (the zero-downtime rolling-restart precondition)
+        self._draining = threading.Event()
+        # hard kill: every loop exits at its next check WITHOUT acking or
+        # sinking — simulates replica death for failover drills (claimed
+        # entries stay pending broker-side and get requeued by the fleet)
+        self._killed = threading.Event()
         self._threads: List[threading.Thread] = []
         # model-worker threads are tracked by slot so the supervisor can
         # respawn a dead one in place (reference: Flink task restarts)
@@ -110,8 +138,13 @@ class ClusterServing:
         try:
             while not self._stop.is_set():
                 hb.beat()
+                if self._draining.is_set():
+                    # shed: a draining replica claims nothing new; in-flight
+                    # work keeps moving through infer/sink until acked
+                    time.sleep(0.01)
+                    continue
                 try:
-                    entries = conn.call("XREADGROUP", INPUT_STREAM, self.group,
+                    entries = conn.call("XREADGROUP", self.stream, self.group,
                                         cfg.batch_size, cfg.batch_timeout_ms)
                 except RetryAbortedError:
                     break          # job stopping
@@ -238,7 +271,7 @@ class ClusterServing:
         hb = self.registry.register("serving.sink")
         try:
             # keep draining after _stop so results already computed still land
-            while True:
+            while not self._killed.is_set():
                 hb.beat()
                 try:
                     results = self._sink_q.get(timeout=0.1)
@@ -253,13 +286,14 @@ class ClusterServing:
                         # RetryAbortedError means stopping AND broker gone.
                         # Result tensors ride raw binary frames (no npy/base64)
                         if uri is not None:
-                            if ctx is not None:
-                                with _tm.span("serving.fanout", remote=ctx,
-                                              uri=uri):
-                                    conn.call("HSET", RESULT_PREFIX + uri,
-                                              value)
+                            span_cm = (_tm.span("serving.fanout", remote=ctx,
+                                                uri=uri) if ctx is not None
+                                       else None)
+                            if span_cm is not None:
+                                with span_cm:
+                                    self._write_result(conn, uri, value)
                             else:
-                                conn.call("HSET", RESULT_PREFIX + uri, value)
+                                self._write_result(conn, uri, value)
                         _RECORDS.labels(
                             outcome="error" if isinstance(value, dict)
                             and "error" in value else "ok").inc()
@@ -271,12 +305,22 @@ class ClusterServing:
                     # dropped ack would leave the entries pending forever and
                     # redeliver them on every restart
                     if done_ids:
-                        conn.call("XACK", INPUT_STREAM, self.group, done_ids)
+                        conn.call("XACK", self.stream, self.group, done_ids)
                 except RetryAbortedError:
                     break          # stopping and broker gone: give up
         finally:
             hb.stop()
             conn.close()
+
+    def _write_result(self, conn: _Conn, uri: str, value: Any) -> None:
+        """One result write. In fleet (dedup) mode only the FIRST answer per
+        uri lands — the broker's HSETNX tombstones make a slow-not-dead
+        replica's duplicate answer for a requeued request a counted no-op."""
+        if self.dedup_results:
+            if conn.call("HSETNX", RESULT_PREFIX + uri, value) == 0:
+                _DUP_RESULTS.inc()
+        else:
+            conn.call("HSET", RESULT_PREFIX + uri, value)
 
     # ----------------------------------------------------------------- control
 
@@ -348,6 +392,8 @@ class ClusterServing:
     def start(self) -> "ClusterServing":
         """Start the pipeline (non-blocking; threads are daemons)."""
         self._stop.clear()
+        self._draining.clear()
+        self._killed.clear()
         self._warm_model()
         # Register the consumer group at the stream TAIL before consuming
         # (FlinkRedisSource.scala:44 xgroupCreate parity): a fresh job sees
@@ -355,20 +401,97 @@ class ClusterServing:
         # preserved cursor, picking up records enqueued while it was down.
         conn = self._connect("engine.control")
         try:
-            conn.call("XGROUPCREATE", INPUT_STREAM, self.group, "$")
+            conn.call("XGROUPCREATE", self.stream, self.group, "$")
         except RetryAbortedError:
             pass
         finally:
             conn.close()
-        for name, fn in (("source", self._source_loop),
-                         ("sink", self._sink_loop),
-                         ("supervisor", self._supervise_loop)):
+        loops = [("source", self._source_loop),
+                 ("sink", self._sink_loop),
+                 ("supervisor", self._supervise_loop)]
+        if self.replica_id is not None:
+            loops.append(("fleet-hb", self._fleet_heartbeat_loop))
+        for name, fn in loops:
             t = threading.Thread(target=fn, daemon=True, name=f"serving-{name}")
             t.start()
             self._threads.append(t)
         for widx in range(max(1, self.config.infer_workers)):
             self._threads.append(self._spawn_infer_worker(widx))
         return self
+
+    # ------------------------------------------------------------- fleet mode
+
+    def state(self) -> str:
+        """Replica lifecycle state published in the fleet heartbeat:
+        ``up`` → ``draining`` (drain requested, in-flight work finishing) →
+        ``drained`` (nothing left; safe to stop/deregister)."""
+        if self._draining.is_set():
+            return "drained" if not self._busy() else "draining"
+        return "up"
+
+    def _busy(self) -> bool:
+        with self._inflight_lock:
+            inflight = self._inflight
+        return inflight > 0 or not (self._infer_q.empty()
+                                    and self._sink_q.empty())
+
+    def drain(self) -> None:
+        """Stop accepting (claiming) new requests; keep processing + acking
+        what is already in flight. ``state()`` reaches ``drained`` once the
+        pipeline is empty — the graceful half of a rolling restart."""
+        self._draining.set()
+
+    def drained(self) -> bool:
+        return self._draining.is_set() and not self._busy()
+
+    def kill(self) -> None:
+        """Hard replica death (chaos drills, supervisor force-respawn): all
+        loops exit at their next check; nothing further is sunk or acked, so
+        every claimed-but-unacked request stays pending on the broker for
+        the fleet's claim-transfer requeue. The in-process analog of
+        ``SIGKILL`` on a replica process."""
+        self._killed.set()
+        self._stop.set()
+
+    def _fleet_heartbeat_loop(self):
+        """Replica presence on the broker: periodically HSET
+        ``fleet:hb:<replica_id>`` with a wall-clock timestamp, lifecycle
+        state, and progress counters (the router's half-open probe readmission
+        watches ``served`` advance), and poll ``fleet:ctl:<replica_id>`` for
+        drain commands. A killed/crashed replica simply stops beating — the
+        supervisor detects staleness, requeues its claimed work, respawns."""
+        conn = self._connect("engine.fleet-hb")
+        interval = max(0.05, float(
+            getattr(self.config, "fleet_heartbeat_s", 0.5)))
+        ctl_seen: Any = None
+        try:
+            while not self._stop.is_set() and not self._killed.is_set():
+                try:
+                    conn.call("HSET", FLEET_HB_PREFIX + self.replica_id,
+                              {"ts": time.time(), "state": self.state(),
+                               "pid": os.getpid(), "served": self.served,
+                               "inflight": self._infer_q.qsize()})
+                    ctl = conn.call("HGET",
+                                    FLEET_CTL_PREFIX + self.replica_id, 0)
+                except RetryAbortedError:
+                    break
+                if isinstance(ctl, dict) and ctl != ctl_seen:
+                    ctl_seen = ctl
+                    if ctl.get("state") == "drain":
+                        self.drain()
+                self._stop.wait(interval)
+            # deliberate shutdown (not kill): publish a terminal state so the
+            # supervisor can tell "stopped on purpose" from "went silent"
+            if not self._killed.is_set():
+                try:
+                    conn.call("HSET", FLEET_HB_PREFIX + self.replica_id,
+                              {"ts": time.time(), "state": "stopped",
+                               "pid": os.getpid(), "served": self.served,
+                               "inflight": 0})
+                except Exception:
+                    pass
+        finally:
+            conn.close()
 
     def stats(self) -> Dict[str, Any]:
         """Engine-side observability: records served, worker respawns, and
@@ -391,14 +514,8 @@ class ClusterServing:
 
     def stop(self, drain_s: float = 1.0):
         deadline = time.time() + drain_s
-
-        def busy():  # queued OR currently inside predict (between queues)
-            with self._inflight_lock:
-                inflight = self._inflight
-            return inflight > 0 or not (self._infer_q.empty()
-                                        and self._sink_q.empty())
-
-        while time.time() < deadline and busy():
+        # queued OR currently inside predict (between queues)
+        while time.time() < deadline and self._busy():
             time.sleep(0.01)
         self._stop.set()
         # _infer_threads may hold respawned workers not in _threads

@@ -1,0 +1,903 @@
+"""Serving replica fleet — health-routed multi-replica dispatch with
+zero-loss failover.
+
+The reference's Cluster Serving inherited horizontal scale and task restarts
+from Flink's runtime (PAPERS.md "BigDL 2.0"); this module builds the same
+supervision loop natively over the queue broker, in the at-least-once
+redelivery spirit of PAPERS.md "TensorFlow: A system for large-scale machine
+learning":
+
+* :class:`ReplicaRouter` sits at the broker: it consumes the client-facing
+  request stream under its own consumer group and forwards each entry onto a
+  per-replica dispatch stream (``fleet:req:<rid>``), choosing the replica by a
+  pluggable policy — ``round_robin`` or ``least_pending`` (fed by the same
+  per-replica queue-depth numbers it publishes as ``zoo_fleet_queue_depth``
+  gauges). A per-replica :class:`~..common.resilience.CircuitBreaker` gates
+  eligibility: an evicted replica takes no traffic until its half-open probe
+  request is observed SERVED.
+
+* :class:`FleetSupervisor` owns the replica lifecycle: it spawns N
+  :class:`~.engine.ClusterServing` replicas (``thread`` mode — N engines in
+  this process — or ``process`` mode — one subprocess each, see ``main``),
+  folds their broker-side heartbeats (``fleet:hb:<rid>``, written by the
+  engine's fleet-heartbeat loop) into a
+  :class:`~..common.resilience.HealthRegistry`, and reacts to liveness
+  TRANSITIONS via the registry's listener hook: a replica that goes silent is
+  evicted from routing, its claimed-but-unacked requests are moved back onto
+  the dispatch stream in one atomic broker ``XTRANSFER`` (delivery counts
+  ride along), and the replica is respawned. Requests are therefore
+  at-least-once: a slow-not-dead replica may still answer work that was
+  requeued — replica sinks write results with ``HSETNX`` (first-write-wins,
+  dedup-on-uri), so the client sees exactly one response per submitted uri.
+
+* Graceful drain (``drain()`` / the ``cli drain`` command) flips a replica to
+  stop-accepting via its control hash; it finishes + acks in-flight work,
+  reaches state ``drained``, and is deregistered from routing — the
+  zero-downtime half of :meth:`FleetSupervisor.rolling_restart`, which drains,
+  restarts and readmits replicas one at a time (the model hot-swap
+  precondition).
+
+Wire layout on the broker::
+
+    serving_stream                   client XADDs (unchanged client API)
+    fleet:req:<rid>                  router -> replica dispatch stream
+    fleet:hb:<rid>                   replica heartbeat hash {ts, state, served}
+    fleet:ctl:<rid>                  supervisor/cli -> replica control hash
+    fleet:members                    supervisor-published replica roster
+    result:<uri>                     replica HSETNX (first answer wins)
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import logging
+import signal
+import subprocess
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..common import telemetry as _tm
+from ..common.chaos import chaos_point
+from ..common.resilience import (CircuitBreaker, HealthRegistry,
+                                 RetryAbortedError, RetryPolicy)
+from .client import INPUT_STREAM, _Conn
+from .config import ServingConfig
+from .engine import FLEET_CTL_PREFIX, FLEET_HB_PREFIX, ClusterServing
+
+logger = logging.getLogger("analytics_zoo_tpu.serving.fleet")
+
+REPLICA_STREAM_PREFIX = "fleet:req:"
+ROUTER_GROUP = "fleet-router"
+MEMBERS_KEY = "fleet:members"
+ROLLING_KEY = "fleet:ctl:__rolling__"
+
+_DISPATCH = _tm.counter("zoo_fleet_dispatch_total",
+                        "Requests dispatched to a replica by the router",
+                        labels=("replica",))
+_REQUEUED = _tm.counter(
+    "zoo_fleet_requeued_requests_total",
+    "Requests claim-transferred back to the dispatch stream from a dead "
+    "replica (XTRANSFER moves; each implies a redelivery)")
+_FLEET_RESPAWNS = _tm.counter("zoo_fleet_respawns_total",
+                              "Dead replicas respawned by the supervisor")
+_FAILOVER = _tm.histogram(
+    "zoo_fleet_failover_seconds",
+    "Death detection -> claimed work requeued + respawn initiated",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
+_NO_REPLICA = _tm.counter(
+    "zoo_fleet_route_stalls_total",
+    "Router iterations that held traffic because no replica was eligible")
+
+# scrape-time gauges walk the live routers (weakset, the resilience.py
+# pattern): eligible-replica count + per-replica queue depth — the numbers
+# the least_pending policy itself routes on
+_LIVE_ROUTERS: "weakref.WeakSet[ReplicaRouter]" = weakref.WeakSet()
+
+
+def _collect_eligible():
+    out = {}
+    for r in list(_LIVE_ROUTERS):
+        out[(r.name,)] = float(len(r.eligible_ids()))
+    return out.items()
+
+
+def _collect_depths():
+    out = {}
+    for r in list(_LIVE_ROUTERS):
+        for rid, depth in r.depths().items():
+            out[(rid,)] = float(depth)
+    return out.items()
+
+
+_tm.collector("zoo_fleet_eligible_replicas",
+              "Replicas currently eligible for dispatch (heartbeat fresh, "
+              "state up, breaker not open)", _collect_eligible,
+              labels=("router",))
+_tm.collector("zoo_fleet_queue_depth",
+              "Per-replica pending work (dispatch-stream depth + reported "
+              "in-flight) — the least_pending routing signal",
+              _collect_depths, labels=("replica",))
+
+
+class _ReplicaSlot:
+    """Router-side view of one replica: breaker, liveness fed by the
+    supervisor's heartbeat polls, dispatch/depth accounting, and the
+    outstanding half-open probe (if any)."""
+
+    def __init__(self, rid: str, config: ServingConfig):
+        self.rid = rid
+        self.breaker = CircuitBreaker(
+            failure_threshold=config.breaker_failure_threshold,
+            reset_timeout_s=config.breaker_reset_timeout_s,
+            name=f"fleet-replica-{rid}")
+        self.alive = True           # hb freshness (supervisor-fed)
+        self.state = "up"           # replica lifecycle state from the hb
+        self.served = 0             # replica's cumulative served counter
+        self.dispatched = 0
+        self.depth = 0              # stream LEN + reported in-flight
+        self.reported_inflight = 0  # engine-internal queue depth from the hb
+        # (served_at_dispatch, t_dispatch) while a half-open probe request
+        # is outstanding; progress on `served` closes the breaker
+        self.probe: Optional[Tuple[int, float]] = None
+
+
+class ReplicaRouter:
+    """Broker-level dispatch tier over N engine replicas.
+
+    Consumes ``stream`` under consumer group ``group`` and forwards each
+    entry to ``prefix + <chosen replica>``; the origin entry is XACKed only
+    after the forward landed, so a router crash redelivers (at-least-once,
+    deduped on uri by the replica sinks). Standalone use (e.g. routing the
+    generation stream over :class:`~.generation.GenerationEngine` replicas)
+    needs only ``replica_ids``; under a :class:`FleetSupervisor` the
+    supervisor feeds liveness into :meth:`set_liveness`/:meth:`evict`.
+    """
+
+    def __init__(self, config: Optional[ServingConfig] = None,
+                 replica_ids: Tuple[str, ...] = (), *,
+                 stream: str = INPUT_STREAM,
+                 prefix: str = REPLICA_STREAM_PREFIX,
+                 group: str = ROUTER_GROUP,
+                 policy: Optional[str] = None,
+                 registry: Optional[HealthRegistry] = None,
+                 name: str = "fleet", group_fmt: str = "fleet-{rid}"):
+        self.config = config or ServingConfig()
+        self.stream, self.prefix, self.group = stream, prefix, group
+        # each replica's consumer-group name (the depth probe counts work
+        # OWED to that group: undelivered + claimed-but-unacked)
+        self.group_fmt = group_fmt
+        self.policy = policy or self.config.fleet_policy
+        if self.policy not in ("least_pending", "round_robin"):
+            raise ValueError(f"unknown routing policy {self.policy!r}")
+        self.registry = registry
+        self.name = name
+        self._lock = threading.Lock()
+        self._slots: "collections.OrderedDict[str, _ReplicaSlot]" = \
+            collections.OrderedDict()
+        for rid in replica_ids:
+            self.add_replica(rid)
+        self._rr_next = 0
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._depths_refreshed = 0.0
+        self.routed = 0
+        _LIVE_ROUTERS.add(self)
+
+    # -- membership / liveness (supervisor-fed) ------------------------------
+
+    def add_replica(self, rid: str) -> None:
+        with self._lock:
+            if rid not in self._slots:
+                self._slots[rid] = _ReplicaSlot(rid, self.config)
+
+    def remove_replica(self, rid: str) -> None:
+        with self._lock:
+            self._slots.pop(rid, None)
+
+    def replica_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._slots)
+
+    def evict(self, rid: str) -> None:
+        """Force a replica out of the rotation NOW (death, operator action).
+        The breaker trips open; readmission follows the normal half-open
+        probe path once the replica heartbeats again."""
+        with self._lock:
+            slot = self._slots.get(rid)
+        if slot is not None:
+            slot.breaker.trip()
+            slot.probe = None
+            logger.warning("fleet: evicted replica %s (breaker open)", rid)
+
+    def set_liveness(self, rid: str, alive: bool, state: str = "up",
+                     served: Optional[int] = None,
+                     inflight: Optional[int] = None) -> None:
+        """Heartbeat-poll feed from the supervisor. Also resolves half-open
+        probes: a probe request counts as SUCCEEDED when the replica's
+        cumulative ``served`` advanced past its at-dispatch value, and as
+        FAILED when the replica went stale (or the probe aged out) — so a
+        respawned replica re-earns traffic by actually serving, not merely
+        by heartbeating."""
+        with self._lock:
+            slot = self._slots.get(rid)
+            if slot is None:
+                return
+            slot.alive = alive
+            slot.state = state
+            if served is not None:
+                slot.served = served
+            if inflight is not None:
+                slot.reported_inflight = inflight
+            probe = slot.probe
+        if probe is None:
+            return
+        served_at, t_probe = probe
+        if alive and served is not None and served > served_at:
+            slot.breaker.record_success()
+            slot.probe = None
+            logger.info("fleet: replica %s probe served; readmitted", rid)
+        elif not alive or (time.monotonic() - t_probe
+                           > 2 * self.config.fleet_failover_timeout_s):
+            slot.breaker.record_failure()
+            slot.probe = None
+
+    def eligible_ids(self) -> List[str]:
+        """Replicas a dispatch could go to right now (hb fresh, lifecycle
+        ``up``, breaker not open; half-open counts — the probe admission
+        happens per-dispatch via ``allow()``)."""
+        with self._lock:
+            slots = list(self._slots.values())
+        return [s.rid for s in slots
+                if s.alive and s.state == "up"
+                and s.breaker.state != CircuitBreaker.OPEN
+                and s.probe is None]
+
+    def depths(self) -> Dict[str, int]:
+        with self._lock:
+            return {rid: s.depth for rid, s in self._slots.items()}
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            slots = list(self._slots.values())
+        return {"routed": self.routed, "policy": self.policy,
+                "replicas": {
+                    s.rid: {"dispatched": s.dispatched, "depth": s.depth,
+                            "alive": s.alive, "state": s.state,
+                            "breaker": s.breaker.state} for s in slots}}
+
+    # -- routing -------------------------------------------------------------
+
+    def _connect(self, tag: str) -> _Conn:
+        policy = RetryPolicy(max_attempts=None, base_delay_s=0.05,
+                             max_delay_s=0.5, attempt_timeout_s=5.0,
+                             retryable=(ConnectionError, OSError))
+        return _Conn(self.config.queue_host, self.config.queue_port,
+                     policy=policy, abort=self._stop.is_set, tag=tag)
+
+    def _refresh_depths(self, conn: _Conn) -> None:
+        """Per-replica queue depth = everything the replica still owes on
+        its dispatch stream: undelivered entries PLUS claimed-but-unacked
+        ones (group-aware broker LEN — an engine buffers claimed batches
+        internally, so the live stream length alone understates load).
+        Refreshed at most every 50ms; incremented locally per dispatch in
+        between."""
+        now = time.monotonic()
+        if now - self._depths_refreshed < 0.05:
+            return
+        self._depths_refreshed = now
+        for rid in self.replica_ids():
+            try:
+                depth = int(conn.call("LEN", self.prefix + rid,
+                                      self.group_fmt.format(rid=rid)))
+            except RetryAbortedError:
+                raise
+            except Exception:
+                continue
+            with self._lock:
+                slot = self._slots.get(rid)
+                if slot is not None:
+                    slot.depth = depth
+
+    def _pick(self) -> Optional[str]:
+        """Choose an eligible replica per the policy; reserves a half-open
+        probe slot via ``breaker.allow()`` (so at most one in-flight probe
+        per recovering replica)."""
+        with self._lock:
+            slots = [s for s in self._slots.values()
+                     if s.alive and s.state == "up"]
+            if not slots:
+                return None
+            if self.policy == "least_pending":
+                order = sorted(slots, key=lambda s: s.depth)
+            else:                       # round_robin over the stable roster
+                n = len(slots)
+                start = self._rr_next % n
+                order = slots[start:] + slots[:start]
+                self._rr_next += 1
+        for slot in order:
+            was_half_open = slot.breaker.state == CircuitBreaker.HALF_OPEN
+            if slot.breaker.allow():
+                if was_half_open:
+                    slot.probe = (slot.served, time.monotonic())
+                return slot.rid
+        return None
+
+    def _note_dispatched(self, rid: str) -> None:
+        with self._lock:
+            slot = self._slots.get(rid)
+            if slot is not None:
+                slot.dispatched += 1
+                slot.depth += 1
+        self.routed += 1
+        _DISPATCH.labels(replica=rid).inc()
+
+    def _route_loop(self):
+        conn = self._connect("fleet.router")
+        hb = (self.registry.register("fleet.router")
+              if self.registry is not None else None)
+        hold: "collections.deque" = collections.deque()
+        try:
+            while not self._stop.is_set():
+                if hb is not None:
+                    hb.beat()
+                if not hold:
+                    if self._draining.is_set():
+                        break           # drained: nothing held, stop claiming
+                    try:
+                        entries = conn.call("XREADGROUP", self.stream,
+                                            self.group, 64, 100)
+                    except RetryAbortedError:
+                        break
+                    hold.extend(entries or ())
+                    if not hold:
+                        continue
+                try:
+                    self._refresh_depths(conn)
+                    done: List[str] = []
+                    stalled = False
+                    while hold:
+                        entry_id, payload = hold[0]
+                        rid = self._pick()
+                        if rid is None:
+                            stalled = True
+                            break
+                        # deterministic fault site: a "fail" rule drops this
+                        # routing decision (entry retried next iteration —
+                        # at-least-once), a "delay" rule models a slow router
+                        chaos_point("fleet.route", tag=rid)
+                        conn.call("XADD", self.prefix + rid, payload)
+                        self._note_dispatched(rid)
+                        hold.popleft()
+                        done.append(entry_id)
+                    if done:
+                        conn.call("XACK", self.stream, self.group, done)
+                    if stalled:
+                        _NO_REPLICA.inc()
+                        self._stop.wait(0.02)
+                except RetryAbortedError:
+                    break
+                except Exception:
+                    # injected routing fault / transient broker hiccup: the
+                    # un-forwarded entries stay in `hold` (and pending
+                    # broker-side under the router group) — retry, never drop
+                    logger.exception("fleet: routing iteration failed; "
+                                     "holding %d entries", len(hold))
+                    self._stop.wait(0.02)
+        finally:
+            if hb is not None:
+                hb.stop()
+            conn.close()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ReplicaRouter":
+        self._stop.clear()
+        self._draining.clear()
+        conn = self._connect("fleet.router-init")
+        try:
+            conn.call("XGROUPCREATE", self.stream, self.group, "$")
+        except RetryAbortedError:
+            pass
+        finally:
+            conn.close()
+        self._thread = threading.Thread(target=self._route_loop, daemon=True,
+                                        name="zoo-fleet-router")
+        self._thread.start()
+        return self
+
+    def stop(self, drain_s: float = 2.0):
+        """Drain-then-stop: forward everything already claimed, then exit.
+        Unclaimed stream entries stay on the broker (redelivered to the next
+        router incarnation)."""
+        self._draining.set()
+        if self._thread is not None:
+            self._thread.join(timeout=drain_s)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+class _ReplicaHandle:
+    """Supervisor-side handle on one replica incarnation."""
+
+    def __init__(self, rid: str, mode: str):
+        self.rid = rid
+        self.mode = mode                    # "thread" | "process"
+        self.engine: Optional[ClusterServing] = None
+        self.proc: Optional[subprocess.Popen] = None
+        self.spawned_at = time.monotonic()
+        self.drain_requested = False
+        self.restarting = False             # deliberate restart in progress:
+                                            # the monitor must not failover
+        self.generation = 0                 # incarnation count (respawns)
+
+    def kill(self):
+        """Hard-stop this incarnation (no drain, no acks)."""
+        if self.engine is not None:
+            self.engine.kill()
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+
+    def stop(self, drain_s: float = 2.0):
+        if self.engine is not None:
+            self.engine.stop(drain_s)
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=max(drain_s, 5.0))
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+class FleetSupervisor:
+    """Heartbeat-monitors N replicas, requeues a dead replica's claimed
+    work, respawns it, and supports graceful drain / rolling restart.
+
+    ``spawn="thread"`` builds each replica as a :class:`ClusterServing` in
+    this process (``model_factory()`` per replica, or ``None`` to load from
+    ``config.model_path``); ``spawn="process"`` launches
+    ``python -m analytics_zoo_tpu.serving.fleet --replica <rid> ...`` — real
+    process isolation, requires ``config.model_path`` (or ``demo=True``).
+    """
+
+    def __init__(self, config: ServingConfig, *,
+                 model_factory: Optional[Callable[[], Any]] = None,
+                 replica_ids: Optional[List[str]] = None,
+                 spawn: Optional[str] = None,
+                 router: Optional[ReplicaRouter] = None,
+                 registry: Optional[HealthRegistry] = None,
+                 demo: bool = False, config_path: Optional[str] = None,
+                 platform: Optional[str] = None):
+        self.config = config
+        self.spawn = spawn or config.fleet_spawn
+        if self.spawn not in ("thread", "process"):
+            raise ValueError(f"unknown spawn mode {self.spawn!r}")
+        self.model_factory = model_factory
+        self.demo = demo
+        # process-mode replicas re-read the operator's YAML themselves: a
+        # live ServingConfig object can't cross the fork, and spawning with
+        # defaults would silently drop batch/int8/heartbeat tuning
+        self.config_path = config_path
+        self.platform = platform
+        ids = list(replica_ids) if replica_ids else \
+            [f"r{i}" for i in range(max(1, config.replicas))]
+        self.router = router or ReplicaRouter(config, tuple(ids))
+        # the fleet registry holds one component per replica; death/revival
+        # TRANSITIONS drive eviction + requeue + respawn via the listener
+        # hook (common/resilience.py) — /readyz and tests read it too
+        self.registry = registry or HealthRegistry(
+            default_timeout_s=config.fleet_failover_timeout_s, name="fleet")
+        self.registry.add_transition_listener(self._on_transition)
+        self._handles: Dict[str, _ReplicaHandle] = {}
+        self._hb_seen: Dict[str, bool] = {}      # first fresh hb observed?
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._monitor: Optional[threading.Thread] = None
+        self._conn: Optional[_Conn] = None
+        self._rolling_seen: Any = None
+        self._rolling_busy = False
+        self.requeued = 0
+        self.respawns = 0
+        self.failovers: List[float] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _connect(self, tag: str) -> _Conn:
+        policy = RetryPolicy(max_attempts=None, base_delay_s=0.05,
+                             max_delay_s=0.5, attempt_timeout_s=5.0,
+                             retryable=(ConnectionError, OSError))
+        return _Conn(self.config.queue_host, self.config.queue_port,
+                     policy=policy, abort=self._stop.is_set, tag=tag)
+
+    def start(self) -> "FleetSupervisor":
+        self._stop.clear()
+        self._conn = self._connect("fleet.supervisor")
+        try:
+            # roster published for operators (`cli fleet-status`/frontends)
+            self._conn.call("HSET", MEMBERS_KEY,
+                            {"replicas": self.router.replica_ids(),
+                             "spawn": self.spawn})
+            # a rolling-restart nonce left by a PREVIOUS stack incarnation
+            # (the hash is never deleted and survives AOF replay) is an
+            # already-executed command, not an order for this one: snapshot
+            # it so only nonces written from now on trigger
+            prior = self._conn.call("HGET", ROLLING_KEY, 0)
+            if isinstance(prior, dict):
+                self._rolling_seen = prior.get("nonce")
+        except RetryAbortedError:
+            pass
+        self.router.start()
+        for rid in self.router.replica_ids():
+            self._spawn_replica(rid)
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="zoo-fleet-supervisor")
+        self._monitor.start()
+        return self
+
+    def _replica_config(self) -> ServingConfig:
+        import dataclasses
+
+        return dataclasses.replace(self.config)
+
+    def _spawn_replica(self, rid: str) -> None:
+        handle = self._handles.get(rid)
+        generation = handle.generation + 1 if handle is not None else 1
+        handle = _ReplicaHandle(rid, self.spawn)
+        handle.generation = generation
+        # stale state from the previous incarnation must not leak in: a dead
+        # replica's old hb would otherwise look "fresh enough" right after
+        # respawn, and an old drain command would insta-drain the new one
+        try:
+            self._conn.call("HDEL", FLEET_HB_PREFIX + rid)
+            self._conn.call("HDEL", FLEET_CTL_PREFIX + rid)
+        except RetryAbortedError:
+            pass
+        if self.spawn == "thread":
+            model = self.model_factory() if self.model_factory else None
+            handle.engine = ClusterServing(
+                model, config=self._replica_config(), group=f"fleet-{rid}",
+                stream=self.router.prefix + rid, replica_id=rid,
+                dedup_results=True)
+            handle.engine.start()
+        else:
+            cmd = [sys.executable, "-m", "analytics_zoo_tpu.serving.fleet",
+                   "--replica", rid,
+                   "--broker-host", self.config.queue_host,
+                   "--broker-port", str(self.config.queue_port)]
+            if self.config_path:
+                cmd += ["--config", self.config_path]
+            if self.platform:
+                cmd += ["--platform", self.platform]
+            if self.demo:
+                cmd.append("--demo")
+            elif self.config.model_path:
+                cmd += ["--model", self.config.model_path]
+            elif not self.config_path:
+                raise ValueError("process-mode replicas need model_path, "
+                                 "config_path, or demo=True")
+            handle.proc = subprocess.Popen(cmd)
+        self._handles[rid] = handle
+        self._hb_seen[rid] = False
+        # liveness budget: normal failover timeout once beating; until the
+        # first heartbeat the replica may still be loading/compiling, so it
+        # gets the spawn grace instead
+        self.registry.register(f"replica.{rid}",
+                               timeout_s=self.config.fleet_spawn_grace_s)
+        self.router.add_replica(rid)
+
+    # -- monitoring ----------------------------------------------------------
+
+    def _monitor_loop(self):
+        interval = max(0.05, min(self.config.fleet_heartbeat_s, 0.2))
+        while not self._stop.is_set():
+            try:
+                self._poll_once()
+            except RetryAbortedError:
+                break
+            except Exception:
+                logger.exception("fleet: supervisor poll failed")
+            self._stop.wait(interval)
+
+    def _poll_once(self):
+        now = time.time()
+        for rid in list(self._handles):
+            hb = self._conn.call("HGET", FLEET_HB_PREFIX + rid, 0)
+            handle = self._handles.get(rid)
+            if handle is None:
+                continue
+            # a process-mode replica that exited is dead regardless of the
+            # staleness window — don't wait out the timeout
+            proc_dead = (handle.proc is not None
+                         and handle.proc.poll() is not None)
+            fresh = (isinstance(hb, dict)
+                     and now - float(hb.get("ts", 0))
+                     < self.config.fleet_failover_timeout_s
+                     and hb.get("state") != "stopped")
+            if fresh and not proc_dead:
+                if not self._hb_seen.get(rid):
+                    # first beat: tighten the liveness budget from spawn
+                    # grace down to the failover timeout
+                    self._hb_seen[rid] = True
+                    self.registry.register(
+                        f"replica.{rid}",
+                        timeout_s=self.config.fleet_failover_timeout_s)
+                self.registry.beat(f"replica.{rid}")
+                state = str(hb.get("state", "up"))
+                if state in ("draining", "drained") and not handle.restarting:
+                    # the drain may have been commanded out-of-band (`cli
+                    # drain` writes the control hash directly): a replica
+                    # that dies mid-drain must not be respawned regardless
+                    # of which path asked for the drain
+                    handle.drain_requested = True
+                self.router.set_liveness(
+                    rid, True, state=state,
+                    served=int(hb.get("served", 0)),
+                    inflight=int(hb.get("inflight", 0)))
+            elif proc_dead:
+                # hard process exit: expire the component immediately by
+                # re-registering with a zero budget — check_transitions
+                # below turns that into the death callback
+                self.registry.register(f"replica.{rid}", timeout_s=0.0)
+        self.registry.check_transitions()
+        self._check_rolling()
+
+    def _on_transition(self, component: str, alive: bool) -> None:
+        if not component.startswith("replica."):
+            return
+        rid = component[len("replica."):]
+        if alive:
+            logger.info("fleet: replica %s is back", rid)
+            return
+        if self._stop.is_set():
+            return
+        handle = self._handles.get(rid)
+        if handle is not None and handle.restarting:
+            return      # deliberate rolling restart owns this lifecycle
+        self._failover(rid)
+
+    def _failover(self, rid: str) -> None:
+        """A replica went silent: evict it from routing, claim-transfer its
+        owed requests back to the dispatch stream, respawn it (unless it was
+        deliberately draining). Zero-loss: nothing it claimed was acked, so
+        everything it owed is still on the broker."""
+        t0 = time.perf_counter()
+        handle = self._handles.get(rid)
+        self.router.evict(rid)
+        self.router.set_liveness(rid, False, state="dead")
+        try:
+            res = self._conn.call("XTRANSFER", self.router.prefix + rid,
+                                  f"fleet-{rid}", self.router.stream)
+            moved = int(res.get("moved", 0)) if isinstance(res, dict) else 0
+        except RetryAbortedError:
+            return
+        except Exception:
+            logger.exception("fleet: requeue for dead replica %s failed", rid)
+            moved = 0
+        if moved:
+            _REQUEUED.inc(moved)
+            self.requeued += moved
+        logger.warning("fleet: replica %s dead; requeued %d claimed "
+                       "request(s)", rid, moved)
+        if handle is None:
+            # unmanaged id (already removed): eviction + requeue is all
+            return
+        handle.kill()           # reap whatever half-dead incarnation remains
+        if not handle.drain_requested:
+            chaos_point("fleet.respawn", tag=rid)
+            self._spawn_replica(rid)
+            self.respawns += 1
+            _FLEET_RESPAWNS.inc()
+        else:
+            # died while draining: work requeued above; the drain decided
+            # this replica should not take traffic, so don't bring it back
+            self._handles.pop(rid, None)
+            self._hb_seen.pop(rid, None)
+            self.router.remove_replica(rid)
+            self.registry.deregister(f"replica.{rid}")
+        dt = time.perf_counter() - t0
+        self.failovers.append(dt)
+        _FAILOVER.observe(dt)
+
+    # -- drain / rolling restart --------------------------------------------
+
+    def drain(self, rid: str) -> None:
+        """Ask one replica to stop accepting and finish in-flight work (the
+        command rides the broker control hash, so `cli drain` from another
+        process takes the same path)."""
+        handle = self._handles.get(rid)
+        if handle is not None:
+            handle.drain_requested = True
+        self._conn.call("HSET", FLEET_CTL_PREFIX + rid, {"state": "drain"})
+
+    def wait_state(self, rid: str, state: str, timeout_s: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            hb = self._conn.call("HGET", FLEET_HB_PREFIX + rid, 0)
+            if isinstance(hb, dict) and hb.get("state") == state:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def wait_eligible(self, n: int, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if len(self.router.eligible_ids()) >= n:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def restart_replica(self, rid: str, timeout_s: float = 30.0) -> bool:
+        """One rolling-restart step: drain → stop → respawn → wait until the
+        fresh incarnation is eligible again. Zero-downtime as long as the
+        other replicas stay up (the router keeps dispatching to them)."""
+        handle = self._handles.get(rid)
+        if handle is None:
+            return False
+        handle.restarting = True    # monitor: hands off this lifecycle
+        self.drain(rid)
+        self.wait_state(rid, "drained", timeout_s=timeout_s)
+        handle.stop(drain_s=2.0)
+        try:
+            # stragglers dispatched in the eviction race go back to the pool
+            res = self._conn.call("XTRANSFER", self.router.prefix + rid,
+                                  f"fleet-{rid}", self.router.stream)
+            moved = int(res.get("moved", 0)) if isinstance(res, dict) else 0
+            if moved:
+                _REQUEUED.inc(moved)
+                self.requeued += moved
+        except Exception:
+            logger.exception("fleet: straggler requeue for %s failed", rid)
+        self._spawn_replica(rid)    # fresh handle: restarting/drain cleared
+        ok = self.wait_eligible(len(self.router.replica_ids()),
+                                timeout_s=timeout_s)
+        logger.info("fleet: rolling-restarted replica %s (eligible=%s)",
+                    rid, ok)
+        return ok
+
+    def rolling_restart(self, timeout_s: float = 60.0) -> bool:
+        """Drain + restart every replica one at a time (model hot-swap /
+        config rollout): at every instant N-1 replicas serve traffic."""
+        ok = True
+        for rid in list(self.router.replica_ids()):
+            ok = self.restart_replica(rid, timeout_s=timeout_s) and ok
+        return ok
+
+    def _check_rolling(self):
+        """`cli rolling-restart` writes a nonce to the rolling control hash;
+        execute it once per nonce (on a side thread — the monitor loop must
+        keep polling heartbeats while replicas restart)."""
+        val = self._conn.call("HGET", ROLLING_KEY, 0)
+        if not isinstance(val, dict) or val.get("nonce") == self._rolling_seen:
+            return
+        if self._rolling_busy:
+            # a restart is still executing: leave the new nonce unconsumed
+            # so the next poll after this run finishes picks it up (the
+            # operator's command queues instead of silently vanishing)
+            return
+        self._rolling_seen = val.get("nonce")
+        self._rolling_busy = True
+
+        def run():
+            try:
+                self.rolling_restart()
+            finally:
+                self._rolling_busy = False
+
+        threading.Thread(target=run, daemon=True,
+                         name="zoo-fleet-rolling").start()
+
+    # -- introspection -------------------------------------------------------
+
+    def readiness(self) -> Tuple[bool, Dict[str, Any]]:
+        """/readyz payload: ready iff >= 1 replica is eligible for dispatch
+        (distinct from liveness — a fleet mid-drain is alive but not ready)."""
+        eligible = self.router.eligible_ids()
+        return (len(eligible) >= 1,
+                {"eligible": eligible,
+                 "replicas": self.router.replica_ids(),
+                 "requeued": self.requeued, "respawns": self.respawns})
+
+    def stats(self) -> Dict[str, Any]:
+        """Aggregated engine stats + router view (feeds /metrics.json)."""
+        out: Dict[str, Any] = {"router": self.router.stats(),
+                               "requeued": self.requeued,
+                               "respawns": self.respawns,
+                               "served": 0}
+        for rid, handle in list(self._handles.items()):
+            if handle.engine is not None:
+                out["served"] += handle.engine.served
+        return out
+
+    def kill_replica(self, rid: str) -> None:
+        """Chaos hook: hard-kill one replica (threads stop un-acked /
+        process SIGKILL). The monitor detects the silence and fails over."""
+        handle = self._handles.get(rid)
+        if handle is not None:
+            handle.kill()
+
+    def stop(self, drain_s: float = 5.0):
+        """Ordered fleet shutdown: router first (stop claiming client
+        traffic), then replicas drain + stop (in-flight work finishes and
+        acks), then the monitor. Undispatched client entries stay on the
+        broker for the next incarnation (AOF redelivery)."""
+        self.router.stop(drain_s=min(2.0, drain_s))
+        for rid, handle in list(self._handles.items()):
+            if handle.engine is not None:
+                handle.engine.drain()
+        deadline = time.monotonic() + drain_s
+        for rid, handle in list(self._handles.items()):
+            if handle.engine is not None:
+                while (time.monotonic() < deadline
+                       and not handle.engine.drained()):
+                    time.sleep(0.02)
+        self._stop.set()
+        for handle in list(self._handles.values()):
+            handle.stop(drain_s=1.0)
+        if self._monitor is not None:
+            self._monitor.join(timeout=2.0)
+            self._monitor = None
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+
+# ---------------------------------------------------------------------------
+# subprocess replica entrypoint (fleet_spawn: process)
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:  # pragma: no cover - exercised as a subprocess
+    ap = argparse.ArgumentParser(
+        description="one fleet replica: ClusterServing consuming its own "
+                    "dispatch stream, heartbeating over the broker")
+    ap.add_argument("--replica", required=True, help="replica id (rN)")
+    ap.add_argument("--broker-host", default="127.0.0.1")
+    ap.add_argument("--broker-port", type=int, required=True)
+    ap.add_argument("--config", default=None, help="ServingConfig yaml")
+    ap.add_argument("--model", default=None, help="zoo model bundle path")
+    ap.add_argument("--demo", action="store_true",
+                    help="serve the built-in demo model")
+    ap.add_argument("--platform", default=None, choices=("cpu", "tpu"))
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    cfg = (ServingConfig.from_yaml(args.config) if args.config
+           else ServingConfig())
+    cfg.queue_host, cfg.queue_port = args.broker_host, args.broker_port
+    if args.model:
+        cfg.model_path = args.model
+    model = None
+    if args.demo and not cfg.model_path:
+        from .stack import _demo_model
+
+        model = _demo_model()
+    rid = args.replica
+    engine = ClusterServing(model, config=cfg, group=f"fleet-{rid}",
+                            stream=REPLICA_STREAM_PREFIX + rid,
+                            replica_id=rid, dedup_results=True)
+    engine.start()
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    logger.info("fleet replica %s up (stream=%s)", rid,
+                REPLICA_STREAM_PREFIX + rid)
+    stop.wait()
+    engine.drain()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not engine.drained():
+        time.sleep(0.05)
+    engine.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -674,9 +674,14 @@ class GenerationEngine:
     def __init__(self, model, params=None,
                  config: Optional[ServingConfig] = None,
                  group: str = "generation",
-                 registry: Optional[HealthRegistry] = None):
+                 registry: Optional[HealthRegistry] = None,
+                 stream: Optional[str] = None):
         self.config = config or ServingConfig()
         self.group = group
+        # fleet mode: a replica consumes its own routed dispatch stream
+        # (serving/fleet.py ReplicaRouter) instead of the shared one; the
+        # per-request genout:* reply streams are unaffected by routing
+        self.stream = stream or GEN_STREAM
         self.registry = registry if registry is not None else HealthRegistry(
             default_timeout_s=self.config.heartbeat_timeout_s)
         cfg = self.config
@@ -722,7 +727,7 @@ class GenerationEngine:
         self.batcher.start()
         conn = self._connect("gen.control")
         try:
-            conn.call("XGROUPCREATE", GEN_STREAM, self.group, "$")
+            conn.call("XGROUPCREATE", self.stream, self.group, "$")
         except RetryAbortedError:
             pass
         finally:
@@ -742,7 +747,7 @@ class GenerationEngine:
             while not self._stop.is_set():
                 hb.beat()
                 try:
-                    entries = conn.call("XREADGROUP", GEN_STREAM, self.group,
+                    entries = conn.call("XREADGROUP", self.stream, self.group,
                                         8, 200)
                 except RetryAbortedError:
                     break
@@ -819,7 +824,7 @@ class GenerationEngine:
                 kind, entry_id, uri, seq, tokens, meta, final, ctx = item
                 try:
                     if kind == "ack":   # cancel frames carry no reply
-                        conn.call("XACK", GEN_STREAM, self.group, [entry_id])
+                        conn.call("XACK", self.stream, self.group, [entry_id])
                         continue
                     frame = {"sid": uri, "seq": seq,
                              "tokens": np.asarray(tokens, np.int32),
@@ -832,7 +837,7 @@ class GenerationEngine:
                         frame[TRACE_KEY] = ctx
                     conn.call("XADD", GEN_OUT_PREFIX + uri, frame)
                     if final:
-                        conn.call("XACK", GEN_STREAM, self.group, [entry_id])
+                        conn.call("XACK", self.stream, self.group, [entry_id])
                         self.served_streams += 1
                 except RetryAbortedError:
                     break

@@ -15,7 +15,14 @@ Two serving modes:
 
 Routes:
     GET  /                 -> liveness ("welcome to analytics zoo web serving")
-    GET  /healthz          -> health registry status (503 when a component is dead)
+    GET  /healthz          -> LIVENESS: health registry status (503 when a
+                              component is dead). An orchestrator restarts on
+                              this.
+    GET  /readyz           -> READINESS: 503 + Retry-After while the stack
+                              cannot take NEW traffic — draining, circuit
+                              breaker open, or zero eligible fleet replicas —
+                              even though the process is perfectly alive. An
+                              orchestrator (or L4 balancer) routes on this.
     POST /predict          -> {"instances":[{name: tensor-as-nested-list, ...}]}
     GET  /metrics          -> the shared telemetry registry as Prometheus text
                               format (docs/observability.md)
@@ -35,6 +42,7 @@ import contextlib
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -117,6 +125,21 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             status = app.registry.status()
             self._respond(200 if status["status"] == "ok" else 503, status)
+        elif self.path == "/readyz":
+            ready, detail = app.readiness()
+            if ready:
+                self._respond(200, {"status": "ready", **detail})
+            else:
+                # Retry-After so rolling restarts look like backpressure,
+                # not an outage, to well-behaved clients
+                data = json.dumps({"status": "unready",
+                                   **detail}).encode("utf-8")
+                self.send_response(503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Retry-After", "1")
+                self.end_headers()
+                self.wfile.write(data)
         else:
             self._respond(200, {"message":
                                 "welcome to analytics zoo web serving"})
@@ -298,10 +321,18 @@ class FrontEndApp:
                  max_inflight: Optional[int] = None,
                  registry: Optional[HealthRegistry] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 engine_stats=None, generator=None):
+                 engine_stats=None, generator=None, ready_fn=None):
         self.config = config or ServingConfig()
         self.timeout_s = timeout_s
         self.registry = registry             # backs /healthz (None => always ok)
+        # backs /readyz: () -> (ready, detail) — e.g. FleetSupervisor.
+        # readiness (>= 1 eligible replica). None => backend always ready
+        self._ready_fn = ready_fn
+        # ordered shutdown: stop_accepting() flips this; new requests shed
+        # 503 while already-admitted ones finish (wait_idle)
+        self._draining = False
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._model = model
         # queue-backed stacks pass the ClusterServing job's ``stats`` here so
         # /metrics carries the engine's compile-cache gauges too
@@ -357,12 +388,58 @@ class FrontEndApp:
             return self._model.compile_stats()
         return {}
 
-    # -- load shedding --------------------------------------------------------
+    # -- load shedding / readiness -------------------------------------------
     def _admit(self) -> bool:
-        return self._admission.acquire(blocking=False)
+        if self._draining:
+            return False         # draining: shed before any work is accepted
+        if not self._admission.acquire(blocking=False):
+            return False
+        with self._inflight_lock:
+            self._inflight += 1
+        return True
 
     def _release(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
         self._admission.release()
+
+    def readiness(self) -> tuple:
+        """(ready, detail) for /readyz: NOT ready while draining, while the
+        broker-path breaker is open (no backend will answer), or while the
+        attached readiness source (fleet) reports zero eligible replicas.
+        Liveness (/healthz) is deliberately independent: a draining stack is
+        alive-but-unready, and must not be restarted by its orchestrator."""
+        detail: dict = {}
+        if self._draining:
+            return False, {"reason": "draining"}
+        if self.breaker.state == CircuitBreaker.OPEN:
+            return False, {"reason": "circuit open",
+                           "retry_after_s": self.breaker.retry_after_s()}
+        if self._ready_fn is not None:
+            try:
+                ready, detail = self._ready_fn()
+            except Exception as e:
+                return False, {"reason": f"readiness probe failed: {e}"}
+            if not ready:
+                return False, {"reason": "no eligible replica", **detail}
+        return True, detail
+
+    def stop_accepting(self) -> None:
+        """First step of ordered shutdown: /readyz flips 503 and new
+        /predict//generate requests shed immediately; in-flight requests
+        keep running (pair with :meth:`wait_idle`)."""
+        self._draining = True
+
+    def wait_idle(self, timeout_s: float = 10.0) -> bool:
+        """Block until every admitted request released (True) or timeout."""
+        end = time.monotonic() + timeout_s
+        while time.monotonic() < end:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    return True
+            time.sleep(0.02)
+        with self._inflight_lock:
+            return self._inflight == 0
 
     @contextlib.contextmanager
     def _output(self):

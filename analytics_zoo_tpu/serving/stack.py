@@ -21,7 +21,27 @@ from ..common.resilience import HealthRegistry
 from .broker import start_broker
 from .config import ServingConfig
 from .engine import ClusterServing
+from .fleet import FleetSupervisor
 from .http_frontend import FrontEndApp
+
+
+def shutdown_stack(app, backend, broker, drain_s: float = 5.0) -> None:
+    """Ordered stack shutdown (the SIGTERM path).
+
+    Order matters and is NOT construction order: (1) the frontend stops
+    ACCEPTING (readyz flips 503, new requests shed) but keeps running so
+    already-admitted requests can still fetch their results; (2) the routing
+    tier + engines drain — every claimed request finishes, is written to the
+    broker, and acked; (3) admitted HTTP requests have collected their
+    responses (wait_idle); (4) the broker stops; (5) the frontend exits.
+    Stopping in construction order (broker first, or frontend hard-stop
+    first) strands accepted requests mid-flight — the regression test in
+    tests/test_fleet.py drives a request THROUGH this shutdown."""
+    app.stop_accepting()
+    backend.stop(drain_s)        # FleetSupervisor.stop or ClusterServing.stop
+    app.wait_idle(timeout_s=drain_s)
+    broker.shutdown()
+    app.stop()
 
 
 def _demo_model():
@@ -49,6 +69,11 @@ def main(argv=None) -> int:
     ap.add_argument("--aof", default=None)
     ap.add_argument("--model", default=None, help="zoo model bundle path")
     ap.add_argument("--config", default=None, help="ServingConfig yaml")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="engine replicas behind the fleet router (default: "
+                         "config `fleet: replicas`, else 1 = classic single "
+                         "engine). >1 enables health-routed dispatch, "
+                         "failover requeue, and rolling `cli drain`/restart")
     ap.add_argument("--int8", action="store_true")
     ap.add_argument("--demo", action="store_true",
                     help="serve a built-in demo model (no bundle needed)")
@@ -84,16 +109,40 @@ def main(argv=None) -> int:
     if not cfg.model_path and not args.demo:
         ap.error("pass --model <bundle>, --config with model/path, or --demo")
 
+    if args.replicas is not None:
+        cfg.replicas = args.replicas
+
     broker = start_broker("127.0.0.1", args.broker_port, aof_path=args.aof)
     # one registry spans the stack: engine stage/worker heartbeats feed the
     # frontend's /healthz, so an orchestrator probes the whole pipeline
     registry = HealthRegistry(default_timeout_s=cfg.heartbeat_timeout_s)
-    serving = ClusterServing(_demo_model() if args.demo and not cfg.model_path
-                             else None, config=cfg, registry=registry)
-    serving.start()
+    ready_fn = None
+    if cfg.replicas > 1:
+        # fleet mode: router + N supervised replicas; /readyz reflects the
+        # eligible-replica count, `cli drain`/`rolling-restart` work
+        demo_module = (_demo_model() if args.demo and not cfg.model_path
+                       else None)
+        if cfg.fleet_spawn == "process" and demo_module is not None:
+            ap.error("--demo needs thread-mode replicas (fleet: spawn)")
+        # the supervisor keeps its OWN registry: a dead replica is a
+        # READINESS event (supervisor evicts + respawns; /readyz reflects
+        # it) — it must not flip /healthz and get the whole stack restarted
+        serving = FleetSupervisor(
+            cfg,
+            model_factory=((lambda: demo_module) if demo_module is not None
+                           else None),
+            config_path=args.config, platform=args.platform)
+        serving.start()
+        ready_fn = serving.readiness
+    else:
+        serving = ClusterServing(
+            _demo_model() if args.demo and not cfg.model_path else None,
+            config=cfg, registry=registry)
+        serving.start()
     # engine_stats feeds the frontend's /metrics recompile-count gauges
     app = FrontEndApp(cfg, host=args.host, port=args.http_port,
-                      registry=registry, engine_stats=serving.stats)
+                      registry=registry, engine_stats=serving.stats,
+                      ready_fn=ready_fn)
 
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -112,9 +161,9 @@ def main(argv=None) -> int:
 
         threading.Thread(target=_dump_loop, daemon=True,
                          name="zoo-metrics-jsonl").start()
-    logging.info("serving stack up: http=%s:%d broker=127.0.0.1:%d%s",
-                 args.host, args.http_port, args.broker_port,
-                 f" aof={args.aof}" if args.aof else "")
+    logging.info("serving stack up: http=%s:%d broker=127.0.0.1:%d "
+                 "replicas=%d%s", args.host, args.http_port, args.broker_port,
+                 cfg.replicas, f" aof={args.aof}" if args.aof else "")
     stop.wait()
     logging.info("shutting down")
     if args.metrics_jsonl:
@@ -124,9 +173,9 @@ def main(argv=None) -> int:
             telemetry.write_jsonl(args.metrics_jsonl)
         except OSError:
             pass
-    app.stop()
-    serving.stop()
-    broker.shutdown()
+    # ordered: stop accepting -> drain router+engines -> broker -> frontend
+    # (construction-order stops strand accepted requests; see shutdown_stack)
+    shutdown_stack(app, serving, broker)
     return 0
 
 

@@ -1183,22 +1183,34 @@ def run_generation_bench(quick: bool = False) -> dict:
     out["streams"] = streams_out
 
     # --- continuous vs run-to-completion on mixed-length traffic ----------
-    def policy_run(policy):
-        b = make(policy)
-        try:
-            # bursty mix, longs interleaved 1-in-4 (chat-traffic shape): RTC
-            # waves are each gated by their slowest member; continuous
-            # admission backfills retired slots immediately
-            wall, tokens, _itls, fails = drive(
-                b, n_reqs, max_new=[long_tok, short_tok, short_tok,
-                                    short_tok],
-                prompt_lens=[7])
-            return {"tokens_per_s": round(tokens / wall, 1),
-                    "tokens": tokens, "wall_s": round(wall, 3),
-                    "steps": b.stats()["steps"],
-                    "failed_streams": len(fails)}
-        finally:
-            b.close()
+    def policy_run(policy, repeats=3):
+        """Median of ``repeats`` trials per arm: one trial's wall is ~0.1s
+        in quick mode, and on a shared 1-core host a single-shot ratio of
+        two such walls swings 1.1x-2.3x run to run (measured RTC spread
+        within one process: 1357-2641 tok/s for identical work) — the gate
+        was flaking on scheduler jitter, not on the property it checks."""
+        trials = []
+        for _ in range(repeats):
+            b = make(policy)
+            try:
+                # bursty mix, longs interleaved 1-in-4 (chat-traffic shape):
+                # RTC waves are each gated by their slowest member;
+                # continuous admission backfills retired slots immediately
+                wall, tokens, _itls, fails = drive(
+                    b, n_reqs, max_new=[long_tok, short_tok, short_tok,
+                                        short_tok],
+                    prompt_lens=[7])
+                trials.append({"tokens_per_s": round(tokens / wall, 1),
+                               "tokens": tokens, "wall_s": round(wall, 3),
+                               "steps": b.stats()["steps"],
+                               "failed_streams": len(fails)})
+            finally:
+                b.close()
+        mid = sorted(trials, key=lambda t: t["tokens_per_s"])[len(trials) // 2]
+        out = dict(mid)
+        out["trials_tokens_per_s"] = [t["tokens_per_s"] for t in trials]
+        out["failed_streams"] = sum(t["failed_streams"] for t in trials)
+        return out
 
     cont = policy_run("continuous")
     rtc = policy_run("batch")
@@ -1230,6 +1242,182 @@ def run_generation_bench(quick: bool = False) -> dict:
     finally:
         b.close()
     out["platform"] = str(jax.devices()[0].platform)
+    return out
+
+
+# --------------------------------------------------------------------------
+# serving replica-fleet bench (ISSUE 9): router scaling + chaos-kill drill
+# --------------------------------------------------------------------------
+
+FLEET_SERVICE_MS = float(os.environ.get("ZOO_FLEET_BENCH_SERVICE_MS", "40"))
+FLEET_BATCH = int(os.environ.get("ZOO_FLEET_BENCH_BATCH", "4"))
+
+
+def _fleet_stub_model(service_time_s: float):
+    """A device-bound stand-in model: ``predict`` blocks (GIL released) for a
+    fixed service time per micro-batch, exactly like an XLA execute on a
+    replica's own accelerator. The fleet bench measures the ROUTING TIER —
+    dispatch, queue-depth balancing, failover requeue — on a 1-core CI host
+    where N real compute-bound replicas could never overlap; a real
+    deployment pins one replica per chip and the host CPU is not the
+    bottleneck. The artifact records the stub's service time explicitly."""
+    import numpy as np
+
+    from analytics_zoo_tpu.inference import InferenceModel
+
+    class _Stub(InferenceModel):
+        def predict(self, inputs, batch_first=True):
+            time.sleep(service_time_s)
+            x = np.asarray(inputs)
+            return x.sum(axis=tuple(range(1, x.ndim)), keepdims=True)
+
+    return _Stub()
+
+
+def _fleet_run_phase(broker_port: int, n_replicas: int, n_requests: int,
+                     service_s: float, *, kill_rid=None,
+                     submit_threads: int = 4) -> dict:
+    """One fleet phase: N replicas behind the router, ``n_requests`` streamed
+    in from ``submit_threads`` producers, every uri fetched exactly once.
+    ``kill_rid`` hard-kills that replica once ~1/3 of the requests are in
+    (the chaos drill) and asserts reconvergence."""
+    import threading
+
+    import numpy as np
+
+    from analytics_zoo_tpu.serving import (FleetSupervisor, InputQueue,
+                                           OutputQueue, ServingConfig)
+
+    cfg = ServingConfig(queue_port=broker_port, batch_size=FLEET_BATCH,
+                        batch_timeout_ms=2, replicas=n_replicas,
+                        fleet_heartbeat_s=0.1, fleet_failover_timeout_s=0.8,
+                        fleet_spawn_grace_s=10.0, breaker_reset_timeout_s=0.5)
+    fleet = FleetSupervisor(
+        cfg, model_factory=lambda: _fleet_stub_model(service_s))
+    fleet.start()
+    try:
+        assert fleet.wait_eligible(n_replicas, timeout_s=15), \
+            f"fleet never reached {n_replicas} eligible: {fleet.router.stats()}"
+        uris: list = []
+        uris_lock = threading.Lock()
+        t0 = time.perf_counter()
+
+        def submit(idx: int):
+            iq = InputQueue(port=broker_port)
+            try:
+                for i in range(idx, n_requests, submit_threads):
+                    u = iq.enqueue(None, input=np.full((4,), float(i),
+                                                       np.float32))
+                    with uris_lock:
+                        uris.append((i, u))
+            finally:
+                iq.close()
+
+        threads = [threading.Thread(target=submit, args=(i,), daemon=True)
+                   for i in range(submit_threads)]
+        for t in threads:
+            t.start()
+        killed_at = None
+        if kill_rid is not None:
+            while True:
+                with uris_lock:
+                    n_in = len(uris)
+                if n_in >= n_requests // 3:
+                    break
+                time.sleep(0.005)
+            fleet.kill_replica(kill_rid)
+            killed_at = time.perf_counter() - t0
+        for t in threads:
+            t.join()
+        oq = OutputQueue(port=broker_port)
+        failed = []
+        try:
+            for i, u in sorted(uris):
+                try:
+                    v = oq.query(u, timeout_s=60)
+                    # response-count accounting: the answer must be THIS
+                    # request's (sum of its filled input), exactly once
+                    if abs(float(np.asarray(v).ravel()[0]) - 4.0 * i) > 1e-5:
+                        failed.append((u, "wrong value"))
+                except Exception as e:
+                    failed.append((u, repr(e)))
+        finally:
+            oq.close()
+        wall = time.perf_counter() - t0
+        reconverged = fleet.wait_eligible(n_replicas, timeout_s=15)
+        out = {
+            "replicas": n_replicas,
+            "requests": n_requests,
+            "failed_requests": len(failed),
+            "first_failure": failed[0] if failed else None,
+            "wall_seconds": round(wall, 3),
+            "req_per_s": round(n_requests / wall, 1),
+            "requeued": fleet.requeued,
+            "respawns": fleet.respawns,
+            "failover_s": ([round(f, 3) for f in fleet.failovers] or None),
+            "eligible_at_end": len(fleet.router.eligible_ids()),
+            "reconverged": reconverged,
+            "dispatch": {rid: s["dispatched"] for rid, s in
+                         fleet.router.stats()["replicas"].items()},
+        }
+        if killed_at is not None:
+            out["killed_replica"] = kill_rid
+            out["killed_at_s"] = round(killed_at, 3)
+        return out
+    finally:
+        fleet.stop(drain_s=2.0)
+
+
+def run_fleet_bench(quick: bool = False) -> dict:
+    """Replica-fleet scaling + failover artifact (FLEET_BENCH.json).
+
+    Scaling arms run 1 → (2) → 4 stub replicas (fixed per-batch service
+    time, see _fleet_stub_model) over a fresh broker each and record closed-
+    set req/s; the drill arm runs 4 replicas under sustained submission,
+    hard-kills one mid-burst, and verifies ZERO lost requests (every uri
+    answered exactly once — duplicates are dropped broker-side by HSETNX and
+    counted), plus reconvergence to 4 eligible replicas."""
+    from analytics_zoo_tpu.serving import start_broker
+
+    service_s = FLEET_SERVICE_MS / 1e3
+    # enough requests that steady-state routing dominates the ramp/tail
+    # (short runs understate the 4-replica arm: partial first/last batches
+    # and the eligibility ramp are a fixed cost)
+    n_requests = 360 if quick else 720
+    arms = (1, 4) if quick else (1, 2, 4)
+    out: dict = {
+        "metric": "serving fleet scaling (routed replicas, stub model)",
+        "unit": "req/s",
+        "service_time_ms": FLEET_SERVICE_MS,
+        "batch_size": FLEET_BATCH,
+        "model": "device-bound stub (sleep(service_time) per micro-batch; "
+                 "measures the routing tier, not XLA)",
+        "scaling": {},
+    }
+    for n in arms:
+        broker = start_broker()
+        try:
+            out["scaling"][str(n)] = _fleet_run_phase(
+                broker.port, n, n_requests, service_s)
+        finally:
+            broker.shutdown()
+    r1 = out["scaling"]["1"]["req_per_s"]
+    r4 = out["scaling"]["4"]["req_per_s"]
+    out["value"] = r4
+    out["speedup_4_vs_1"] = round(r4 / r1, 2)
+
+    from analytics_zoo_tpu.serving.broker import _DUP_DROPPED
+
+    dups_before = _DUP_DROPPED.value()
+    broker = start_broker()
+    try:
+        drill = _fleet_run_phase(broker.port, 4,
+                                 180 if quick else 400, service_s,
+                                 kill_rid="r1")
+    finally:
+        broker.shutdown()
+    drill["duplicates_dropped"] = int(_DUP_DROPPED.value() - dups_before)
+    out["chaos_drill"] = drill
     return out
 
 
@@ -1390,6 +1578,41 @@ if __name__ == "__main__":
             print("[bench] int8-dispatch quick gate OK: "
                   f"pallas_calls={st['pallas_calls']}, dispatch/raw="
                   f"{kb['dispatch_over_raw']}", file=sys.stderr)
+        sys.exit(0)
+    if "--fleet" in sys.argv:
+        # replica-fleet routing bench (ISSUE 9): scaling 1->4 + chaos-kill
+        # drill. Host-side by construction (stub device-bound model), so it
+        # pins the CPU backend like the data-pipeline bench — a wedged TPU
+        # tunnel must never hang the routing gate.
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        quick = "--quick" in sys.argv
+        fb = run_fleet_bench(quick=quick)
+        if not quick:
+            # quick is the CI gate and never touches the committed artifact
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "FLEET_BENCH.json"), "w") as f:
+                json.dump(fb, f, indent=1)
+        print(json.dumps(fb))
+        drill = fb["chaos_drill"]
+        assert drill["failed_requests"] == 0, (
+            f"chaos drill lost requests: {drill['first_failure']}")
+        assert drill["requeued"] > 0, (
+            "kill drill requeued nothing — the dead replica held no claimed "
+            "work; raise load or lower failover timeout")
+        assert drill["reconverged"] and drill["eligible_at_end"] == 4, drill
+        for arm in fb["scaling"].values():
+            assert arm["failed_requests"] == 0, arm
+        assert fb["speedup_4_vs_1"] >= 2.5, (
+            f"fleet scaling 1->4 gave {fb['speedup_4_vs_1']}x < 2.5x "
+            f"({fb['scaling']['1']['req_per_s']} -> "
+            f"{fb['scaling']['4']['req_per_s']} req/s)")
+        print(f"[bench] fleet gate OK: {fb['speedup_4_vs_1']}x at 4 "
+              f"replicas, drill zero-loss (requeued="
+              f"{drill['requeued']}, dups_dropped="
+              f"{drill['duplicates_dropped']}, failover="
+              f"{drill['failover_s']})", file=sys.stderr)
         sys.exit(0)
     if "--generation" in sys.argv:
         # generation decode-path bench (ISSUE 8). Quick mode is the CI gate

@@ -26,8 +26,13 @@
 #                                           # compiled decode shape, empty
 #                                           # decode-lint findings,
 #                                           # continuous >= 1.5x RTC, flat
-#                                           # per-token cost); never writes
-#                                           # the artifacts
+#                                           # per-token cost); and gates the
+#                                           # replica fleet (bench.py --fleet
+#                                           # --quick: one of 4 replicas
+#                                           # chaos-killed mid-burst loses
+#                                           # ZERO requests, >= 2.5x req/s
+#                                           # scaling 1 -> 4 replicas);
+#                                           # never writes the artifacts
 #
 # SERVING_BENCH_TIMEOUT (seconds, default 900) caps the run so a wedged
 # accelerator tunnel can never hang CI.
@@ -47,6 +52,11 @@ if [[ "${1:-}" == "--quick" ]]; then
     # on mixed-length traffic, flat per-token decode cost
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
         python bench.py --generation --quick
+    # replica-fleet gate: zero lost requests with one of 4 replicas chaos-
+    # killed mid-burst (requeue + dedup-on-uri verified), fleet reconverges,
+    # and routed throughput scales >= 2.5x from 1 to 4 replicas
+    timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+        python bench.py --fleet --quick
     # int8 kernel-tier structural gate (writes KERNEL_BENCH.json for the
     # CPU leg; the TPU run overwrites it with real ratios + MFU)
     exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
