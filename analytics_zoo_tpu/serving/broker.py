@@ -544,13 +544,24 @@ class _Store:
 
     def slen(self, stream: str, group: Optional[str] = None) -> int:
         """Stream depth. With ``group``, counts the work OWED to that
-        group's consumer: undelivered entries plus delivered-but-unacked
-        (pending) ones — the fleet router's least_pending signal (a replica
-        that claimed a deep batch and died/stalled still owes it)."""
+        group's consumer: entries not yet delivered (past the group cursor,
+        or queued for crash redelivery) plus delivered-but-unacked (pending)
+        ones — the fleet router's least_pending signal (a replica that
+        claimed a deep batch and died/stalled still owes it). The raw stream
+        list retains delivered-and-acked entries until maxlen-trim, so it
+        must NOT be counted wholesale: that would report cumulative dispatch
+        history as load and starve replicas whose stream was reset (e.g.
+        freshly respawned after an XTRANSFER)."""
         with self.cond:
             n = len(self.streams.get(stream, ()))
             if group is not None:
-                n += len(self.pending.get((stream, group), ()))
+                key = (stream, group)
+                n = max(0, n - self.cursors.get(key, 0))
+                # redeliver entries stay in pending until acked; count the
+                # union so neither map's stragglers are missed or doubled
+                owed = set(self.pending.get(key, ()))
+                owed.update(i for i, _ in self.redeliver.get(key, ()))
+                n += len(owed)
             return n
 
 

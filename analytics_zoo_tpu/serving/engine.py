@@ -395,13 +395,20 @@ class ClusterServing:
         self._draining.clear()
         self._killed.clear()
         self._warm_model()
-        # Register the consumer group at the stream TAIL before consuming
-        # (FlinkRedisSource.scala:44 xgroupCreate parity): a fresh job sees
-        # only traffic from now on; a restarted job (same group) resumes its
-        # preserved cursor, picking up records enqueued while it was down.
+        # Register the consumer group before consuming. On the SHARED client
+        # stream the group starts at the TAIL (FlinkRedisSource.scala:44
+        # xgroupCreate parity): a fresh job sees only traffic from now on; a
+        # restarted job (same group) resumes its preserved cursor. A fleet
+        # replica's dispatch stream is PRIVATE to this replica, and the
+        # router may forward to it before this call lands (model load /
+        # compile on spawn, or the respawn window after a failover XTRANSFER
+        # deleted the stream + cursor) — tail semantics would silently skip
+        # those already-acked-at-origin entries, so fleet groups replay from
+        # '0' instead.
         conn = self._connect("engine.control")
         try:
-            conn.call("XGROUPCREATE", self.stream, self.group, "$")
+            conn.call("XGROUPCREATE", self.stream, self.group,
+                      "0" if self.replica_id is not None else "$")
         except RetryAbortedError:
             pass
         finally:

@@ -209,10 +209,11 @@ class ReplicaRouter:
         probe path once the replica heartbeats again."""
         with self._lock:
             slot = self._slots.get(rid)
-        if slot is not None:
+            if slot is None:
+                return
             slot.breaker.trip()
             slot.probe = None
-            logger.warning("fleet: evicted replica %s (breaker open)", rid)
+        logger.warning("fleet: evicted replica %s (breaker open)", rid)
 
     def set_liveness(self, rid: str, alive: bool, state: str = "up",
                      served: Optional[int] = None,
@@ -223,6 +224,7 @@ class ReplicaRouter:
         FAILED when the replica went stale (or the probe aged out) — so a
         respawned replica re-earns traffic by actually serving, not merely
         by heartbeating."""
+        readmitted = False
         with self._lock:
             slot = self._slots.get(rid)
             if slot is None:
@@ -233,18 +235,23 @@ class ReplicaRouter:
                 slot.served = served
             if inflight is not None:
                 slot.reported_inflight = inflight
+            # probe resolution stays under the lock: _pick() reserves
+            # slot.probe while holding it, and clearing the reservation here
+            # without it could admit a second in-flight probe (the breaker's
+            # own lock is leaf-level, so nesting it is deadlock-free)
             probe = slot.probe
-        if probe is None:
-            return
-        served_at, t_probe = probe
-        if alive and served is not None and served > served_at:
-            slot.breaker.record_success()
-            slot.probe = None
+            if probe is not None:
+                served_at, t_probe = probe
+                if alive and served is not None and served > served_at:
+                    slot.breaker.record_success()
+                    slot.probe = None
+                    readmitted = True
+                elif not alive or (time.monotonic() - t_probe
+                                   > 2 * self.config.fleet_failover_timeout_s):
+                    slot.breaker.record_failure()
+                    slot.probe = None
+        if readmitted:
             logger.info("fleet: replica %s probe served; readmitted", rid)
-        elif not alive or (time.monotonic() - t_probe
-                           > 2 * self.config.fleet_failover_timeout_s):
-            slot.breaker.record_failure()
-            slot.probe = None
 
     def eligible_ids(self) -> List[str]:
         """Replicas a dispatch could go to right now (hb fresh, lifecycle
@@ -268,6 +275,7 @@ class ReplicaRouter:
                 "replicas": {
                     s.rid: {"dispatched": s.dispatched, "depth": s.depth,
                             "alive": s.alive, "state": s.state,
+                            "served": s.served,
                             "breaker": s.breaker.state} for s in slots}}
 
     # -- routing -------------------------------------------------------------
@@ -319,12 +327,18 @@ class ReplicaRouter:
                 start = self._rr_next % n
                 order = slots[start:] + slots[:start]
                 self._rr_next += 1
-        for slot in order:
-            was_half_open = slot.breaker.state == CircuitBreaker.HALF_OPEN
-            if slot.breaker.allow():
-                if was_half_open:
-                    slot.probe = (slot.served, time.monotonic())
-                return slot.rid
+            for slot in order:
+                if slot.breaker.allow():
+                    # the half-open check must come AFTER the admission:
+                    # allow() itself transitions OPEN -> HALF_OPEN once the
+                    # reset timeout elapses, and a consumed probe slot that
+                    # never lands on slot.probe would wedge the breaker
+                    # half-open forever (set_liveness only resolves recorded
+                    # probes). Post-admission HALF_OPEN implies exactly that
+                    # a probe was reserved; CLOSED admissions need none.
+                    if slot.breaker.state == CircuitBreaker.HALF_OPEN:
+                        slot.probe = (slot.served, time.monotonic())
+                    return slot.rid
         return None
 
     def _note_dispatched(self, rid: str) -> None:
@@ -806,13 +820,20 @@ class FleetSupervisor:
 
     def stats(self) -> Dict[str, Any]:
         """Aggregated engine stats + router view (feeds /metrics.json)."""
-        out: Dict[str, Any] = {"router": self.router.stats(),
+        router_stats = self.router.stats()
+        out: Dict[str, Any] = {"router": router_stats,
                                "requeued": self.requeued,
                                "respawns": self.respawns,
                                "served": 0}
+        slots = router_stats.get("replicas", {})
         for rid, handle in list(self._handles.items()):
             if handle.engine is not None:
                 out["served"] += handle.engine.served
+            else:
+                # process-mode replica: no in-process engine — its served
+                # counter rides the fleet:hb:<rid> heartbeat hash, polled by
+                # the supervisor and cached on the router slot
+                out["served"] += int(slots.get(rid, {}).get("served", 0))
         return out
 
     def kill_replica(self, rid: str) -> None:

@@ -681,6 +681,7 @@ class GenerationEngine:
         # fleet mode: a replica consumes its own routed dispatch stream
         # (serving/fleet.py ReplicaRouter) instead of the shared one; the
         # per-request genout:* reply streams are unaffected by routing
+        self._routed = stream is not None
         self.stream = stream or GEN_STREAM
         self.registry = registry if registry is not None else HealthRegistry(
             default_timeout_s=self.config.heartbeat_timeout_s)
@@ -727,7 +728,12 @@ class GenerationEngine:
         self.batcher.start()
         conn = self._connect("gen.control")
         try:
-            conn.call("XGROUPCREATE", self.stream, self.group, "$")
+            # shared stream: tail semantics (see ClusterServing.start). A
+            # routed per-replica stream is private to this engine and the
+            # router may have forwarded before this call lands — replay
+            # from '0' so nothing dispatched early is skipped
+            conn.call("XGROUPCREATE", self.stream, self.group,
+                      "0" if self._routed else "$")
         except RetryAbortedError:
             pass
         finally:

@@ -309,6 +309,41 @@ def test_drain_via_control_hash_and_rolling_restart(zoo_ctx):
         broker.shutdown()
 
 
+def test_replica_spawn_race_predispatched_requests_not_lost():
+    """Regression (review): slots are born eligible, so the router forwards
+    to fleet:req:<rid> (and XACKs the origin entry) before a slow-starting
+    replica registers its consumer group — the model-load/compile window on
+    spawn, and the post-XTRANSFER respawn window. Tail ('$') group semantics
+    silently skipped those entries; fleet groups must replay from '0'."""
+    broker = start_broker()
+    try:
+        cfg = _cfg(broker)
+        router = ReplicaRouter(cfg, ("r0",), policy="round_robin").start()
+        engine = None
+        try:
+            iq = InputQueue(port=broker.port)
+            subs = [(iq.enqueue(None, input=np.full((4,), float(i),
+                                                    np.float32)), 4.0 * i)
+                    for i in range(6)]
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and router.routed < 6:
+                time.sleep(0.02)
+            assert router.routed == 6, "router did not forward the burst"
+            # the replica comes up only AFTER everything was dispatched
+            engine = ClusterServing(StubModel(), config=cfg, group="fleet-r0",
+                                    stream=REPLICA_STREAM_PREFIX + "r0",
+                                    replica_id="r0",
+                                    dedup_results=True).start()
+            _submit_and_check(broker, subs, timeout_s=15)
+            iq.close()
+        finally:
+            router.stop()
+            if engine is not None:
+                engine.stop()
+    finally:
+        broker.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # generation engine behind the router (smoke)
 # ---------------------------------------------------------------------------
@@ -472,6 +507,37 @@ def test_store_xtransfer_moves_pending_and_undelivered_with_counts():
         s2.xtransfer("a", "g", "a")
 
 
+def test_store_group_slen_counts_owed_not_history():
+    """Regression (review): the least_pending depth signal must be work
+    OWED (undelivered + unacked), not the raw stream length — the stream
+    retains delivered-and-acked entries until maxlen-trim, so counting it
+    wholesale reports cumulative dispatch history and floods a freshly
+    respawned (stream-reset) replica with all traffic."""
+    s = _Store()
+    for i in range(6):
+        s.xadd("st", {"uri": f"u{i}"})
+    assert s.slen("st", "g") == 6        # nothing delivered: all owed
+    got = s.xreadgroup("st", "g", 4, 0)
+    s.xack("st", "g", [i for i, _ in got[:3]])
+    # 2 undelivered + 1 delivered-but-unacked; the 3 acked are history
+    assert s.slen("st", "g") == 3
+    s.xack("st", "g", [got[3][0]])
+    assert s.slen("st", "g") == 2
+    assert s.slen("st") == 6             # raw (group-less) depth unchanged
+
+
+def test_store_group_slen_counts_crash_redelivery_once(tmp_path):
+    """Entries queued for crash redelivery are also still pending; the owed
+    count takes the union, not the sum."""
+    aof = str(tmp_path / "owed.aof")
+    s = _Store(aof_path=aof)
+    for i in range(3):
+        s.xadd("st", {"uri": f"u{i}"})
+    s.xreadgroup("st", "g", 2, 0)        # 2 claimed, never acked
+    s2 = _Store(aof_path=aof)            # broker crash restart
+    assert s2.slen("st", "g") == 3       # 1 undelivered + 2 owed, no double
+
+
 def test_store_hsetnx_first_write_wins_even_after_hdel():
     s = _Store()
     assert s.hsetnx("result:u1", {"value": 1}) == 1
@@ -589,6 +655,22 @@ def test_broker_info_carries_compactions(tmp_path):
         c.close()
     finally:
         broker.shutdown()
+
+
+def test_supervisor_stats_folds_heartbeat_served_for_process_replicas():
+    """Regression (review): process-mode replicas have no in-process engine
+    (handle.engine is None); their served counters ride the fleet:hb:<rid>
+    heartbeat hashes the supervisor already polls onto the router slots —
+    stats()/metrics.json must fold those in instead of reporting 0."""
+    from analytics_zoo_tpu.serving.fleet import _ReplicaHandle
+
+    sup = FleetSupervisor(ServingConfig(), replica_ids=["r0", "r1"],
+                          spawn="process", demo=True)
+    sup._handles["r0"] = _ReplicaHandle("r0", "process")
+    sup._handles["r1"] = _ReplicaHandle("r1", "process")
+    sup.router.set_liveness("r0", True, state="up", served=7, inflight=0)
+    sup.router.set_liveness("r1", True, state="up", served=5, inflight=0)
+    assert sup.stats()["served"] == 12
 
 
 # ---------------------------------------------------------------------------
