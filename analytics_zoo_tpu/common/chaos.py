@@ -166,8 +166,10 @@ KNOWN_SITES = {
     "estimator.step",     # engine/estimator.py per-step (both epoch runners)
     "fleet.route",        # serving/fleet.py per-dispatch routing decision
     "fleet.respawn",      # serving/fleet.py dead-replica respawn path
+    "rollout.phase",      # serving/hotswap.py rollout state-machine phases
     "serving.generate",   # serving/generation.py continuous-batch decode loop
     "serving.infer",      # serving/engine.py model-worker batch loop
+    "swap.stage",         # serving/hotswap.py staging (validation -> load)
     "task_pool.worker",   # orca/task_pool.py worker loop
 }
 

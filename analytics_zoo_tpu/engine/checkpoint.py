@@ -20,17 +20,27 @@ only matches completed ``checkpoint_<n>`` names — so a kill mid-write can
 never surface a half-written snapshot; the most recent DURABLE checkpoint
 always wins.  Callers that must observe a durable state (fit() exit, the
 SIGTERM path, rollback-retry restores) drain the writer first.
+
+Every snapshot carries a ``manifest.json`` sidecar (version id, step,
+param-tree signature, content checksum of ``state.npz``) written and fsync'd
+inside the staging dir before publication: a torn/truncated/bit-rotted
+checkpoint is rejected at load (:class:`CheckpointCorruptError`) instead of
+deserializing garbage, and the manifest is exactly what the serving-side
+hot-swap validation (serving/hotswap.py) consumes.  ``on_durable`` hooks
+(on the writer or per ``save_checkpoint`` call) fire AFTER the rename +
+directory fsync — the trainer→fleet publish point.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
 import shutil
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -39,6 +49,13 @@ from ..common import telemetry as _tm
 from ..common.chaos import chaos_point
 
 _CKPT_RE = re.compile(r"^checkpoint_(\d+)$")
+
+MANIFEST_NAME = "manifest.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed its manifest validation (truncated ``state.npz``,
+    checksum mismatch, missing files) — the snapshot must not be loaded."""
 
 _SNAPSHOT_TIME = _tm.histogram(
     "zoo_train_checkpoint_snapshot_seconds",
@@ -81,6 +98,87 @@ def snapshot_state(state: Any) -> List[np.ndarray]:
     return host
 
 
+def param_tree_signature(leaves: List[np.ndarray]) -> str:
+    """Stable digest of a parameter tree's SHAPE — ``(shape, dtype)`` per
+    leaf, in flatten order. Two states with equal signatures are mutually
+    swappable into the same compiled executable (same avals, no recompile);
+    the hot-swap staging check compares this before touching live params."""
+    parts = []
+    for l in leaves:
+        dt = getattr(l, "dtype", None)   # no host transfer for device arrays
+        if dt is None:
+            dt = np.asarray(l).dtype
+        parts.append(f"{tuple(np.shape(l))}:{np.dtype(dt).name}")
+    return hashlib.sha256(";".join(parts).encode("utf-8")).hexdigest()[:16]
+
+
+def content_checksum(path: str) -> str:
+    """sha256 of a file's bytes (the manifest's torn-write detector)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _build_manifest(state_path: str, host_leaves: List[np.ndarray],
+                    meta: Dict) -> Dict:
+    checksum = content_checksum(state_path)
+    manifest = {
+        "version": f"v{meta['iteration']}-{checksum[:8]}",
+        "iteration": meta["iteration"],
+        "epoch": meta.get("epoch", 0),
+        "n_leaves": len(host_leaves),
+        "signature": param_tree_signature(host_leaves),
+        "checksum": checksum,
+        "state_bytes": os.path.getsize(state_path),
+        "time": meta.get("time", time.time()),
+    }
+    # per-leaf tree paths (jax keystr format): lets a consumer that only
+    # knows a SUBTREE — the serving hot-swap validates against the live
+    # model's params, while the trainer snapshots its whole train_state
+    # (params + opt_state + model_state + counters) — select the matching
+    # leaves instead of rejecting the shape wholesale
+    if meta.get("leaf_paths"):
+        manifest["leaf_paths"] = list(meta["leaf_paths"])
+    return manifest
+
+
+def read_manifest(path: str) -> Optional[Dict]:
+    """The snapshot dir's manifest, or ``None`` for pre-manifest snapshots."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return None
+    with open(mpath) as f:
+        return json.load(f)
+
+
+def verify_checkpoint(path: str) -> Optional[Dict]:
+    """Validate a snapshot dir against its manifest; returns the manifest
+    (``None`` when the snapshot predates manifests — nothing to check
+    against). Raises :class:`CheckpointCorruptError` on a missing/truncated
+    ``state.npz`` or a content-checksum mismatch, with the failing field in
+    the message — never lets np.load deserialize garbage."""
+    manifest = read_manifest(path)
+    if manifest is None:
+        return None
+    state = os.path.join(path, "state.npz")
+    if not os.path.exists(state):
+        raise CheckpointCorruptError(f"{path}: state.npz missing "
+                                     "(manifest present — torn snapshot)")
+    size = os.path.getsize(state)
+    if size != manifest["state_bytes"]:
+        raise CheckpointCorruptError(
+            f"{path}: state.npz is {size} bytes, manifest says "
+            f"{manifest['state_bytes']} — truncated or torn write")
+    checksum = content_checksum(state)
+    if checksum != manifest["checksum"]:
+        raise CheckpointCorruptError(
+            f"{path}: state.npz checksum {checksum[:12]}… does not match "
+            f"manifest {manifest['checksum'][:12]}… — corrupt snapshot")
+    return manifest
+
+
 def _fsync(path: str) -> None:
     try:
         fd = os.open(path, os.O_RDONLY)
@@ -95,11 +193,17 @@ def _fsync(path: str) -> None:
 
 
 def _write_snapshot(directory: str, host_leaves: List[np.ndarray],
-                    meta: Dict, keep: int) -> str:
-    """Durable publication: stage under ``*.tmp``, fsync, atomic rename."""
+                    meta: Dict, keep: int,
+                    on_durable: Optional[Callable[[str, Dict], None]] = None
+                    ) -> str:
+    """Durable publication: stage under ``*.tmp``, fsync, atomic rename.
+    ``on_durable(path, manifest)`` fires only after the rename AND the parent
+    directory fsync — the checkpoint it announces can never be lost to a
+    crash that happens right after the callback."""
     path = os.path.join(directory, f"checkpoint_{meta['iteration']}")
     tmp = path + ".tmp"
     t0 = time.perf_counter()
+    manifest: Optional[Dict] = None
     try:
         os.makedirs(tmp, exist_ok=True)
         np.savez(os.path.join(tmp, "state.npz"),
@@ -109,6 +213,15 @@ def _write_snapshot(directory: str, host_leaves: List[np.ndarray],
             f.flush()
             os.fsync(f.fileno())
         _fsync(os.path.join(tmp, "state.npz"))
+        # sidecar manifest: content checksum + param-tree signature, written
+        # and fsync'd INSIDE the staging dir so publication is all-or-nothing
+        # — a published checkpoint always carries its own validator
+        manifest = _build_manifest(os.path.join(tmp, "state.npz"),
+                                   host_leaves, meta)
+        with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
         # deterministic kill site BETWEEN serialization and publication: the
         # chaos drill killing a writer here must leave only complete,
         # durable checkpoints discoverable
@@ -127,6 +240,8 @@ def _write_snapshot(directory: str, host_leaves: List[np.ndarray],
             shutil.rmtree(old, ignore_errors=True)
             os.rename(path, old)
         os.rename(tmp, path)
+        # the rename itself must be durable before anyone is told about the
+        # checkpoint: fsync the PARENT directory entry
         _fsync(directory)
         if old is not None:
             shutil.rmtree(old, ignore_errors=True)
@@ -136,30 +251,53 @@ def _write_snapshot(directory: str, host_leaves: List[np.ndarray],
     finally:
         _WRITE_TIME.observe(time.perf_counter() - t0)
     _gc(directory, keep)
+    if on_durable is not None and manifest is not None:
+        try:
+            on_durable(path, manifest)
+        except Exception:   # a failed publish is not a failed checkpoint
+            import logging
+
+            logging.getLogger("analytics_zoo_tpu.checkpoint").exception(
+                "on_durable hook failed for %s", path)
     return path
 
 
 def save_checkpoint(directory: str, state: Any, *, iteration: int, epoch: int,
                     extra: Optional[Dict] = None, keep: int = 5,
-                    writer: Optional["CheckpointWriter"] = None) -> str:
+                    writer: Optional["CheckpointWriter"] = None,
+                    on_durable: Optional[Callable[[str, Dict], None]] = None
+                    ) -> str:
     """Snapshot ``state`` (any pytree of arrays) under ``directory``.
 
     With ``writer`` the call returns after the device→host snapshot; the
     write itself happens on the writer's background thread (drain the writer
     before depending on the file). Without it, the write is synchronous.
+    ``on_durable(path, manifest)`` fires once the snapshot is durable on
+    disk — the trainer-side model-publish hook (serving/hotswap.py
+    ``ModelPublisher.on_durable``); the writer's own hook is used when this
+    argument is omitted.
     """
     os.makedirs(directory, exist_ok=True)
     host_leaves = snapshot_state(state)
+    try:
+        paths, _ = zip(*jax.tree_util.tree_flatten_with_path(state)[0]) \
+            if host_leaves else ((), None)
+        leaf_paths = [jax.tree_util.keystr(p) for p in paths]
+    except Exception:       # exotic pytree without path registration
+        leaf_paths = []
     meta = {
         "iteration": iteration,
         "epoch": epoch,
         "time": time.time(),
         "n_leaves": len(host_leaves),
+        "leaf_paths": leaf_paths,
         "extra": extra or {},
     }
     if writer is not None:
-        return writer.submit(directory, host_leaves, meta, keep)
-    return _write_snapshot(directory, host_leaves, meta, keep)
+        return writer.submit(directory, host_leaves, meta, keep,
+                             on_durable=on_durable)
+    return _write_snapshot(directory, host_leaves, meta, keep,
+                           on_durable=on_durable)
 
 
 class CheckpointWriter:
@@ -171,20 +309,30 @@ class CheckpointWriter:
     until the in-flight write is durable. Not a thread pool on purpose: one
     writer at a time means two saves can never interleave on the same
     directory, and the newest snapshot is always the last published.
+
+    ``on_durable(path, manifest)`` — called on the writer thread after each
+    durable publication — is where a :class:`~...serving.hotswap.
+    ModelPublisher` announces the checkpoint to the serving fleet.
     """
 
-    def __init__(self):
+    def __init__(self, on_durable: Optional[Callable[[str, Dict],
+                                                     None]] = None):
+        self.on_durable = on_durable
         self._thread: Optional[threading.Thread] = None
         self._exc: Optional[BaseException] = None
         self._path: Optional[str] = None
 
     def submit(self, directory: str, host_leaves: List[np.ndarray],
-               meta: Dict, keep: int) -> str:
+               meta: Dict, keep: int,
+               on_durable: Optional[Callable[[str, Dict], None]] = None
+               ) -> str:
         self.drain()
+        hook = on_durable or self.on_durable
 
         def run():
             try:
-                self._path = _write_snapshot(directory, host_leaves, meta, keep)
+                self._path = _write_snapshot(directory, host_leaves, meta,
+                                             keep, on_durable=hook)
             except BaseException as e:   # surfaced at the next drain/submit
                 self._exc = e
 
@@ -239,7 +387,13 @@ def latest_checkpoint(directory: str) -> Optional[str]:
 
 
 def load_checkpoint(path: str, state_template: Any) -> Tuple[Any, Dict]:
-    """Restore a snapshot into the structure of ``state_template``."""
+    """Restore a snapshot into the structure of ``state_template``.
+
+    Snapshots carrying a manifest are validated first (size + content
+    checksum): a torn/truncated checkpoint raises
+    :class:`CheckpointCorruptError` with the failing field instead of
+    np.load deserializing garbage bytes into live weights."""
+    verify_checkpoint(path)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     data = np.load(os.path.join(path, "state.npz"))

@@ -1117,10 +1117,21 @@ class Estimator:
                 writer = self._ckpt_writer
             else:
                 self._drain_checkpoints(raise_errors=raise_drain_errors)
+            pub = getattr(self, "_model_publisher", None)
             ckpt.save_checkpoint(directory, self.train_state,
                                  iteration=self.trainer_state.iteration,
                                  epoch=self.trainer_state.epoch,
-                                 writer=writer)
+                                 writer=writer,
+                                 on_durable=(pub.on_durable if pub is not None
+                                             else None))
+
+    def set_model_publisher(self, publisher) -> "Estimator":
+        """Attach a :class:`~..serving.hotswap.ModelPublisher`: every durable
+        checkpoint this estimator saves (async writer-thread AND synchronous
+        epoch/SIGTERM saves) is announced on the serving fleet's publish
+        stream — the trainer half of the continuous-deployment loop."""
+        self._model_publisher = publisher
+        return self
 
     def _drain_checkpoints(self, raise_errors: bool = True):
         """Block until the in-flight async checkpoint write (if any) is

@@ -135,6 +135,21 @@ class InferenceModel:
         self._compiled: Dict[Tuple, Any] = {}
         self._quantized = False
         self.summary = summary
+        # hot-swap support (serving/hotswap.py): the load-time param-tree
+        # template — treedef + per-leaf (shape, dtype-name) + signature of
+        # the UNQUANTIZED params — is what a published checkpoint is
+        # validated against; `version` tags every response this model serves
+        self.version: Optional[str] = None
+        self.load_treedef = None
+        self.load_avals: Optional[List[Tuple[Tuple, str]]] = None
+        self.load_signature: Optional[str] = None
+        self._plain_apply = None    # pre-quantization apply (swap/requantize)
+        self._quant_min_elements: Optional[int] = None
+        # per-thread version snapshot taken INSIDE the concurrency slot
+        # (while any slot is held a swap cannot flip params, so this is
+        # exactly the version whose weights served that thread's last
+        # predict — the attribution a post-predict read would race)
+        self._served_version: Dict[int, Optional[str]] = {}
         # pool metrics (InferenceModel.scala keeps originalModel + clones count)
         self.borrowed_peak = 0
         self._borrowed = 0
@@ -174,6 +189,7 @@ class InferenceModel:
         self._params = jax.device_put(params)
         self._state = jax.device_put(state if state is not None else {})
         self._compiled.clear()
+        self._record_template(params)
         return self
 
     def load_zoo(self, path: str, model_class=None) -> "InferenceModel":
@@ -222,7 +238,26 @@ class InferenceModel:
         self._params = jax.device_put(params)
         self._state = jax.device_put(state if state is not None else {})
         self._compiled.clear()
+        self._record_template(params)
         return self
+
+    def _record_template(self, params) -> None:
+        """Remember the as-loaded (unquantized) param-tree shape: treedef +
+        per-leaf avals + signature. The hot-swap staging path validates a
+        published checkpoint against this BEFORE touching live params —
+        equal signature ⇒ same avals ⇒ the live executables keep serving
+        the new weights without a recompile."""
+        from ..engine.checkpoint import param_tree_signature
+
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        self._plain_apply = self._apply
+        self.load_treedef = treedef
+        self.load_avals = [
+            (tuple(np.shape(l)),
+             np.dtype(getattr(l, "dtype", np.asarray(l).dtype)).name)
+            for l in leaves]
+        self.load_signature = param_tree_signature(leaves)
+        self.version = None
 
     # ------------------------------------------------------------- quantization
 
@@ -244,22 +279,32 @@ class InferenceModel:
         if self._params is None:
             raise RuntimeError("load a model before quantizing")
         t0 = time.perf_counter()
+        self._quant_min_elements = min_elements
+        host_params = jax.device_get(self._params)
+        new_apply, packed = self._build_quantized(host_params, min_elements)
+        self._apply = new_apply
+        self._params = jax.device_put(packed)
+        self._compiled.clear()
+        self._quantized = True
+        self.quantize_seconds += time.perf_counter() - t0
+        return self
+
+    def _build_quantized(self, host_params, min_elements: int):
+        """Pack ``host_params`` (an UNQUANTIZED host tree in the load-time
+        layout) for int8 serving; returns ``(apply_fn, packed_host_params)``.
+        Shared by :meth:`quantize_int8` and the hot-swap requantize path —
+        the swap flips apply+params as one consistent pair."""
         module = getattr(self, "_module", None)
         if module is not None and hasattr(module, "layers"):
-            params = jax.device_get(self._params)
             packed_params, n_native = _quantize_module_params(
-                module, params, min_elements)
+                module, host_params, min_elements)
             if n_native:
-                self._params = jax.device_put(packed_params)
-                self._compiled.clear()
-                self._quantized = True
-                self.quantize_seconds += time.perf_counter() - t0
-                return self
+                return self._plain_apply, packed_params
             # no int8-computable layer (LSTM/embedding/custom models): fall
             # through to the generic weight-only path so the 4x size cut —
             # the minimum doLoadOpenVINOInt8 property — still happens
 
-        flat, treedef = jax.tree_util.tree_flatten(self._params)
+        flat, treedef = jax.tree_util.tree_flatten(host_params)
         packed = []
         for leaf in flat:
             arr = np.asarray(jax.device_get(leaf))
@@ -268,7 +313,9 @@ class InferenceModel:
                 packed.append(_quantize_leaf(arr))
             else:
                 packed.append(arr)
-        inner_apply = self._apply
+        # wrap the PLAIN apply (not the current one): requantizing after a
+        # swap must not stack a second dequant layer
+        inner_apply = self._plain_apply
 
         def dequant(p):
             flat_q, td = jax.tree_util.tree_flatten(
@@ -277,11 +324,95 @@ class InferenceModel:
                    if isinstance(x, dict) and "q" in x else x for x in flat_q]
             return jax.tree_util.tree_unflatten(td, deq)
 
-        self._apply = lambda p, s, x: inner_apply(dequant(p), s, x)
-        self._params = jax.device_put(jax.tree_util.tree_unflatten(treedef, packed))
-        self._compiled.clear()
-        self._quantized = True
-        self.quantize_seconds += time.perf_counter() - t0
+        apply_fn = lambda p, s, x: inner_apply(dequant(p), s, x)  # noqa: E731
+        return apply_fn, jax.tree_util.tree_unflatten(treedef, packed)
+
+    # ----------------------------------------------------------------- hot-swap
+
+    def host_params(self):
+        """The live params as a HOST tree in the load-time (unquantized)
+        layout — the rollback retention snapshot. For a quantized model the
+        packed int8 kernels are dequantized back to float host-side; the
+        re-quantize on rollback reproduces the same packed values (the
+        round trip is idempotent for already-quantized weights)."""
+        if self._params is None:
+            raise RuntimeError("no model loaded")
+        host = jax.device_get(self._params)
+        if not self._quantized:
+            return host
+
+        def deq(x):
+            if isinstance(x, dict) and "q" in x and "scale" in x:
+                return np.asarray(x["q"], np.float32) * np.asarray(x["scale"])
+            return x
+
+        flat, td = jax.tree_util.tree_flatten(
+            host, is_leaf=lambda x: isinstance(x, dict) and "q" in x)
+        return jax.tree_util.tree_unflatten(td, [deq(x) for x in flat])
+
+    def probe_forward(self, params, x):
+        """Run the load-time forward with CANDIDATE params (host or device
+        tree, unquantized layout) WITHOUT touching live state — the hot-swap
+        warmup probe. Uses the plain apply: quantized packing happens only
+        at swap time, after the probe passed. The caller owns the device
+        placement (the swapper stages one device copy and reuses it for the
+        flip)."""
+        if self._plain_apply is None:
+            raise RuntimeError("no load-time template (use load/load_fn)")
+        return self._plain_apply(params, self._state, jnp.asarray(x))
+
+    def _hold_all_slots(self):
+        """Acquire every concurrency slot — nothing is mid-``predict`` while
+        held, so a reference flip inside lands exactly BETWEEN dispatch
+        waves and no in-flight request can see mixed weights."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def gate():
+            for _ in range(self.concurrent_num):
+                self._sem.acquire()
+            try:
+                yield
+            finally:
+                for _ in range(self.concurrent_num):
+                    self._sem.release()
+
+        return gate()
+
+    def swap_params(self, params, version: Optional[str] = None
+                    ) -> "InferenceModel":
+        """Atomically replace the live params with ``params`` (a host tree
+        in the load-time layout, e.g. staged from a published checkpoint).
+
+        All expensive work — device transfer, int8 re-packing for a
+        quantized model — happens BEFORE the gate; the flip itself holds
+        every concurrency slot so it lands between dispatch waves. Equal
+        avals (enforced by the staging validation) mean the compiled
+        executables keep serving: for an unquantized model the cache
+        survives untouched (params are call arguments, not captures); a
+        quantized model re-packs, and its apply+params+cache flip as one
+        consistent set."""
+        if self._plain_apply is None:
+            raise RuntimeError("swap_params needs a load-time template "
+                               "(use load/load_fn)")
+        if self._quantized:
+            new_apply, packed = self._build_quantized(
+                params, self._quant_min_elements or 4096)
+            new_params = jax.device_put(packed)
+        else:
+            new_apply = self._plain_apply
+            new_params = jax.device_put(params)
+        # same apply identity (unquantized, or module-path int8 packing) ⇒
+        # the compiled cache stays valid: params are call arguments, and the
+        # staging validation guaranteed equal avals. A fresh generic-path
+        # dequant wrapper must drop the cache with the flip.
+        clear = new_apply is not self._apply
+        with self._hold_all_slots():
+            self._apply = new_apply
+            self._params = new_params
+            if clear:
+                self._compiled.clear()
+            self.version = version
         return self
 
     # ---------------------------------------------------------------- predicting
@@ -368,6 +499,11 @@ class InferenceModel:
             with self._lock:
                 self._borrowed += 1
                 self.borrowed_peak = max(self.borrowed_peak, self._borrowed)
+            # slot held ⇒ no swap can be mid-flight: this version IS the one
+            # whose params the dispatch below reads
+            if len(self._served_version) > 4096:   # dead-thread-id bound
+                self._served_version.clear()
+            self._served_version[threading.get_ident()] = self.version
             try:
                 result = self._gather_chunks(
                     self._dispatch_chunks(arrs, multi, n))
@@ -377,6 +513,13 @@ class InferenceModel:
         if self.summary is not None:
             self.summary.add_batch(n, time.perf_counter() - t0)
         return result
+
+    def last_served_version(self) -> Optional[str]:
+        """Version of the params that served THIS thread's last ``predict``
+        (None before the first call, or for never-swapped models). Race-free
+        w.r.t. concurrent hot-swaps — the snapshot is taken inside the
+        concurrency slot."""
+        return self._served_version.get(threading.get_ident())
 
     def predict_async(self, inputs):
         """Dispatch a predict WITHOUT waiting; returns ``fetch() -> result``.
@@ -397,6 +540,7 @@ class InferenceModel:
         with self._lock:
             self._borrowed += 1
             self.borrowed_peak = max(self.borrowed_peak, self._borrowed)
+        self._served_version[threading.get_ident()] = self.version
         try:
             dispatched = self._dispatch_chunks(arrs, multi, n)
         except BaseException:

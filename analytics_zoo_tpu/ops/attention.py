@@ -141,7 +141,11 @@ def _ring_flash_fwd_res(q, k, v, axis_name, causal, block_q, block_k):
 
     interpret = _interpret_default()
     n = axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    # non-causal rings never branch on block position — every visiting block
+    # is dense. Emitting axis_index anyway leaves an (unused) PartitionId in
+    # the shard_map body, which XLA's SPMD partitioner rejects outright
+    # ("meaning is ambiguous"); only materialize it when causal needs it.
+    idx = jax.lax.axis_index(axis_name) if causal else None
     b, t_q, h, d = q.shape
     o0 = jnp.zeros((b, t_q, h, d), jnp.float32)
     lse0 = jnp.full((b, h, t_q), NEG_INF, jnp.float32)
@@ -160,7 +164,7 @@ def _ring_flash_fwd_res(q, k, v, axis_name, causal, block_q, block_k):
 
     def step(carry, i):
         o, lse, k_blk, v_blk = carry
-        src = (idx - i) % n
+        src = (idx - i) % n if causal else None
         o_blk, lse_blk = _block_cases(src, idx, causal, flash(True),
                                       flash(False), future, (q, k_blk, v_blk))
         o, lse = _merge_blocks(o, lse, o_blk, lse_blk)
@@ -188,7 +192,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, block_q, block_k, res, g):
     q, k, v, out, lse = res
     interpret = _interpret_default()
     n = axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    idx = jax.lax.axis_index(axis_name) if causal else None  # see fwd note
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def bwd(causal_flag):
@@ -206,7 +210,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, block_q, block_k, res, g):
 
     def step(carry, i):
         dq, k_blk, v_blk, dk, dv = carry
-        src = (idx - i) % n
+        src = (idx - i) % n if causal else None
         dq_c, dk_c, dv_c = _block_cases(src, idx, causal, bwd(True),
                                         bwd(False), future, (k_blk, v_blk))
         dq = dq + dq_c.astype(jnp.float32)

@@ -19,9 +19,12 @@ from .engine import ClusterServing
 from .fleet import FleetSupervisor, ReplicaRouter
 from .generation import (ContinuousBatcher, GenerationClient,
                          GenerationEngine)
+from .hotswap import (ModelPublisher, ModelSwapper, RolloutController,
+                      SwapRejected)
 from .http_frontend import FrontEndApp
 
 __all__ = ["QueueBroker", "start_broker", "InputQueue", "OutputQueue",
            "ServingConfig", "ClusterServing", "ContinuousBatcher",
            "FleetSupervisor", "GenerationClient", "GenerationEngine",
-           "FrontEndApp", "ReplicaRouter"]
+           "FrontEndApp", "ModelPublisher", "ModelSwapper",
+           "ReplicaRouter", "RolloutController", "SwapRejected"]

@@ -46,17 +46,19 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common import telemetry as _tm
-from .schema import json_default, json_revive, payload_trace
+from .schema import (MODEL_VERSION_KEY, json_default, json_revive,
+                     payload_trace)
 # wire-protocol primitives live in wire.py; re-exported here because the
 # historical import surface for the framing helpers is this module
 from .wire import (MAX_MSG, VERSION as WIRE_VERSION,  # noqa: F401
-                   _recv_exact, received_trace_context, recv_msg, send_msg,
-                   wire_stats)
+                   _recv_exact, received_model_version,
+                   received_trace_context, recv_msg, send_msg,
+                   set_wire_model_version, wire_stats)
 
 _KNOWN_CMDS = frozenset({"XADD", "XGROUPCREATE", "XREADGROUP", "XREAD",
-                         "XDELSTREAM", "XTRANSFER", "XACK", "HSET", "HSETNX",
-                         "HGET", "HDEL", "LEN", "PING", "SHMOPEN", "INFO",
-                         "SHUTDOWN"})
+                         "XLAST", "XDELSTREAM", "XTRANSFER", "XACK", "HSET",
+                         "HSETNX", "HGET", "HDEL", "LEN", "PING", "SHMOPEN",
+                         "INFO", "SHUTDOWN"})
 # unknown verbs collapse to one label value: client-supplied strings must not
 # mint unbounded counter children in the process-wide registry
 _CMDS = _tm.counter("zoo_broker_commands_total",
@@ -407,6 +409,15 @@ class _Store:
             next_cursor = trimmed + start + len(out)
             return next_cursor, list(out)
 
+    def xlast(self, stream: str) -> Optional[Tuple[str, Any]]:
+        """The newest live entry of ``stream`` (or None). The catch-up peek
+        for tail ('$') consumer groups: a model-update subscriber starting
+        after the trainer already published sees the LATEST version without
+        replaying (and re-deploying) the whole publish history."""
+        with self.cond:
+            entries = self.streams.get(stream)
+            return tuple(entries[-1]) if entries else None
+
     def sdel(self, stream: str) -> None:
         """Delete a whole stream and every per-group cursor/pending record
         attached to it (the generation path's per-request ``genout:*``
@@ -571,6 +582,18 @@ _SHMOPEN = object()
 _SHUTDOWN = object()
 
 
+def _stamp_version(payload: Any) -> Any:
+    """Fold a frame-header model version ("v") into a hash write whose
+    payload does not already carry one: an engine that tags only the wire
+    header still yields version-attributed results in the durable store."""
+    ver = received_model_version()
+    if ver is not None and isinstance(payload, dict) \
+            and MODEL_VERSION_KEY not in payload:
+        payload = dict(payload)
+        payload[MODEL_VERSION_KEY] = ver
+    return payload
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def setup(self):
         super().setup()
@@ -640,6 +663,12 @@ class _Handler(socketserver.BaseRequestHandler):
                         return
                     elif cmd == "INFO":
                         resp["shm_attached"] = shm_ch is not None
+                # result-fetch replies re-carry the stored payload's serving
+                # model version in the frame header (hot-swap end-to-end
+                # tagging: engine header → stored payload → client header)
+                set_wire_model_version(
+                    resp.get(MODEL_VERSION_KEY)
+                    if isinstance(resp, dict) else None)
                 send_msg(self.request, resp, shm=shm_ch)
         except (ConnectionError, OSError):
             return
@@ -661,6 +690,8 @@ class _Handler(socketserver.BaseRequestHandler):
         if cmd == "XREAD":
             return store.xread(req[1], req[2], req[3],
                                req[4] if len(req) > 4 else 0)
+        if cmd == "XLAST":
+            return store.xlast(req[1])
         if cmd == "XDELSTREAM":
             store.sdel(req[1])
             return "OK"
@@ -669,10 +700,10 @@ class _Handler(socketserver.BaseRequestHandler):
         if cmd == "XACK":
             return store.xack(req[1], req[2], req[3])
         if cmd == "HSET":
-            store.hset(req[1], req[2])
+            store.hset(req[1], _stamp_version(req[2]))
             return "OK"
         if cmd == "HSETNX":
-            return store.hsetnx(req[1], req[2])
+            return store.hsetnx(req[1], _stamp_version(req[2]))
         if cmd == "HGET":
             return store.hget(req[1], req[2] if len(req) > 2 else 0)
         if cmd == "HDEL":

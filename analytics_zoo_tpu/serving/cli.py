@@ -140,14 +140,43 @@ def do_info(args) -> int:
         print(f"broker on {args.host}:{args.port} unreachable: {e}",
               file=sys.stderr)
         return 3
+    # hot-swap operator view: per-replica model versions + rollout phase
+    # (present only when a fleet/rollout has registered on this broker)
+    try:
+        from .engine import FLEET_HB_PREFIX
+        from .fleet import MEMBERS_KEY
+        from .hotswap import ROLLOUT_KEY
+
+        members = _call(args.host, args.port, "HGET", MEMBERS_KEY, 0)
+        if isinstance(members, dict):
+            versions = {}
+            for rid in members.get("replicas", ()):
+                hb = _call(args.host, args.port, "HGET",
+                           FLEET_HB_PREFIX + rid, 0)
+                if isinstance(hb, dict):
+                    versions[rid] = {
+                        "model_version": hb.get("model_version"),
+                        "state": hb.get("state"),
+                        "swap_state": hb.get("swap_state")}
+            info["fleet_model_versions"] = versions
+        rollout = _call(args.host, args.port, "HGET", ROLLOUT_KEY, 0)
+        if isinstance(rollout, dict):
+            info["rollout"] = {k: rollout.get(k) for k in
+                               ("phase", "current", "target", "canary")}
+    except (OSError, ConnectionError, ValueError):
+        pass
     print(json.dumps(info, indent=1, sort_keys=True))
     return 0
 
 
 def do_fleet_status(args) -> int:
-    """Roster + per-replica heartbeat view of a fleet-mode stack."""
+    """Roster + per-replica heartbeat view of a fleet-mode stack, including
+    each replica's active model version and the rollout-controller phase —
+    a stuck canary rollout is visible at a glance (one replica on the target
+    version, phase != idle)."""
     from .engine import FLEET_HB_PREFIX
     from .fleet import MEMBERS_KEY
+    from .hotswap import MODEL_CURRENT_KEY, ROLLOUT_KEY
 
     try:
         members = _call(args.host, args.port, "HGET", MEMBERS_KEY, 0)
@@ -165,13 +194,26 @@ def do_fleet_status(args) -> int:
     for rid in members.get("replicas", ()):
         hb = _call(args.host, args.port, "HGET", FLEET_HB_PREFIX + rid, 0)
         if isinstance(hb, dict):
-            out["replicas"][rid] = {
+            entry = {
                 "state": hb.get("state"),
                 "served": hb.get("served"),
                 "inflight": hb.get("inflight"),
+                "model_version": hb.get("model_version"),
+                "swap_state": hb.get("swap_state"),
                 "hb_age_s": round(now - float(hb.get("ts", 0)), 3)}
+            if hb.get("swap_error"):
+                entry["swap_error"] = hb["swap_error"]
+            out["replicas"][rid] = entry
         else:
             out["replicas"][rid] = {"state": "no-heartbeat"}
+    rollout = _call(args.host, args.port, "HGET", ROLLOUT_KEY, 0)
+    if isinstance(rollout, dict):
+        out["rollout"] = {k: rollout.get(k) for k in
+                          ("phase", "current", "target", "canary")}
+    current = _call(args.host, args.port, "HGET", MODEL_CURRENT_KEY, 0)
+    if isinstance(current, dict):
+        out["model_current"] = {k: current.get(k)
+                                for k in ("version", "step", "path")}
     print(json.dumps(out, indent=1, sort_keys=True))
     return 0
 

@@ -18,8 +18,8 @@ from ..common import telemetry as _tm
 from ..common.chaos import chaos_point
 from ..common.resilience import RetryPolicy
 from .shm import MIN_SHM_BUFFER_BYTES, ShmChannel, shm_enabled
-from .wire import WireError, recv_msg, send_msg
-from .schema import TRACE_KEY, decode_payload
+from .wire import WireError, received_model_version, recv_msg, send_msg
+from .schema import TRACE_KEY, decode_payload, payload_model_version
 
 INPUT_STREAM = "serving_stream"
 RESULT_PREFIX = "result:"
@@ -225,6 +225,10 @@ class OutputQueue:
         self._conn = _Conn(host, port, policy=policy or default_conn_policy(),
                            tag="client.output")
         self._known: List[str] = []
+        # serving model version of the LAST result query() returned (payload
+        # field, falling back to the reply frame's "v" header) — None for
+        # results from pre-hot-swap engines
+        self.last_model_version: Optional[str] = None
 
     def register(self, uri: str) -> None:
         self._known.append(uri)
@@ -236,6 +240,8 @@ class OutputQueue:
                                    int(timeout_s * 1000))
             if resp is None:
                 raise TimeoutError(f"no result for {uri!r} within {timeout_s}s")
+            self.last_model_version = (payload_model_version(resp)
+                                       or received_model_version())
             self._conn.call("HDEL", RESULT_PREFIX + uri)
         decoded = decode_payload(resp)
         if "error" in decoded:

@@ -61,6 +61,28 @@ class ServingConfig:
     fleet_spawn_grace_s: float = 30.0    # extra liveness budget for a replica
                                          # that is still loading/compiling its
                                          # model (first heartbeat pending)
+    # --- model hot-swap / canary rollout (serving/hotswap.py) ---
+    hot_swap: bool = True                # consume the trainer's publish
+                                         # stream: fleet stacks run the
+                                         # canary RolloutController, single
+                                         # engines swap directly on publish
+    swap_warmup: bool = True             # staged params run a probe forward
+                                         # (needs warmup_shape) before the
+                                         # swap — NaN/crash checkpoints are
+                                         # rejected pre-traffic
+    swap_timeout_s: float = 30.0         # command -> heartbeat-confirmed
+                                         # version, per replica (covers the
+                                         # staging load + validation)
+    rollout_canary_fraction: float = 0.25  # traffic share routed to the
+                                         # canary during validation
+    rollout_window_s: float = 2.0        # canary validation window
+    rollout_min_requests: int = 8        # canary must serve this many before
+                                         # the window can close (else it
+                                         # extends up to 3x window)
+    rollout_max_error_delta: float = 0.05  # canary error RATE may exceed the
+                                         # stable cohort's by at most this
+    rollout_max_latency_ratio: float = 3.0  # canary latency vs stable-cohort
+                                         # median; above => rollback
     # --- resilience (common.resilience wiring) ---
     infer_workers: int = 1               # model-worker threads; dead ones are
                                          # respawned by the engine supervisor
@@ -147,6 +169,24 @@ class ServingConfig:
         if flat.get("fleet_spawn") not in (None, "thread", "process"):
             raise ValueError(f"fleet spawn must be 'thread'/'process', "
                              f"got {flat['fleet_spawn']!r}")
+        rollout = raw.get("rollout") or {}
+        for key, alias in (("hot_swap", "enabled"),
+                           ("swap_warmup", "warmup"),
+                           ("swap_timeout_s", "swap_timeout_s"),
+                           ("rollout_canary_fraction", "canary_fraction"),
+                           ("rollout_window_s", "window_s"),
+                           ("rollout_min_requests", "min_requests"),
+                           ("rollout_max_error_delta", "max_error_delta"),
+                           ("rollout_max_latency_ratio",
+                            "max_latency_ratio")):
+            if key in raw:
+                flat[key] = type(getattr(cls, key))(raw[key])
+            elif alias in rollout:
+                flat[key] = type(getattr(cls, key))(rollout[alias])
+        frac = flat.get("rollout_canary_fraction")
+        if frac is not None and not (0.0 < frac <= 1.0):
+            raise ValueError(f"rollout canary_fraction must be in (0, 1], "
+                             f"got {frac!r}")
         for key in ("infer_workers", "heartbeat_timeout_s",
                     "http_max_inflight", "breaker_failure_threshold",
                     "breaker_reset_timeout_s"):

@@ -30,7 +30,9 @@ from ..common.resilience import (HealthRegistry, RetryAbortedError,
 from ..inference import InferenceModel, InferenceSummary
 from .client import INPUT_STREAM, RESULT_PREFIX, _Conn
 from .config import ServingConfig
-from .schema import decode_payload, payload_trace
+from .hotswap import MODEL_STREAM, ModelSwapper, SwapRejected
+from .schema import MODEL_VERSION_KEY, decode_payload, payload_trace
+from .wire import set_wire_model_version
 
 logger = logging.getLogger("analytics_zoo_tpu.serving")
 
@@ -112,6 +114,19 @@ class ClusterServing:
         self._inflight = 0              # batches popped but not yet sunk
         self._inflight_lock = threading.Lock()
         self.served = 0
+        self.errors = 0                 # records answered with an error —
+                                        # the canary-validation signal
+        self._lat_ema_s = 0.0           # EMA of receipt->computed latency
+        # model hot-swap (serving/hotswap.py): staging + the atomic flip.
+        # Commands arrive via the fleet control hash (replica mode) or the
+        # publisher stream directly (single-engine mode, config.hot_swap)
+        self.swapper = ModelSwapper(
+            self.model, warmup=getattr(self.config, "swap_warmup", True),
+            probe_shape=getattr(self.config, "warmup_shape", None))
+        self._swap_state = "idle"       # idle | staging | ok | error
+        self._swap_error: Optional[str] = None
+        self._swap_thread: Optional[threading.Thread] = None
+        self._swap_nonce_seen: Any = None
 
     # ------------------------------------------------------------------ stages
 
@@ -215,15 +230,28 @@ class ClusterServing:
                     x = self._collate(batch)
                     y = self.model.predict(x)
                     outs = self._postprocess(y)
+                    # version attribution at COMPUTE time, not sink time: a
+                    # swap landing while this batch sits in the sink queue
+                    # must not relabel results the OLD weights produced.
+                    # last_served_version is snapshotted inside the model's
+                    # concurrency slot, so it is race-free vs the flip.
+                    getver = getattr(self.model, "last_served_version", None)
+                    ver = ((getver() if getver is not None else None)
+                           or self.model_version)
                     t_done = time.perf_counter()
+                    # receipt -> computed latency EMA, published in the fleet
+                    # heartbeat — the canary-validation latency signal
+                    lat = t_done - min(rec[4] for rec in batch)
+                    self._lat_ema_s = (lat if self._lat_ema_s == 0.0
+                                       else 0.8 * self._lat_ema_s + 0.2 * lat)
                     for ctx in ctxs:
                         if ctx is not None:
                             _tm.record_span("serving.engine.dispatch", t_pick,
                                             t_done, remote=ctx, worker=widx,
                                             batch=len(batch))
-                    self._sink_q.put([(i, u, {"value": o}, c)
-                                      for i, u, o, c
-                                      in zip(ids, uris, outs, ctxs)])
+                    self._sink_q.put([
+                        (i, u, {"value": o, MODEL_VERSION_KEY: ver}, c)
+                        for i, u, o, c in zip(ids, uris, outs, ctxs)])
                 except WorkerKilled:
                     # simulated hard death: hand the un-sunk batch back (it is
                     # still unacked broker-side) and die; the supervisor
@@ -282,6 +310,17 @@ class ClusterServing:
                 try:
                     done_ids = []
                     for entry_id, uri, value, ctx in results:
+                        # version tagging: results stamped at compute time
+                        # keep their tag; error/malformed records (never ran
+                        # the model) get the current version. The payload
+                        # field is the durable copy; the ambient wire-header
+                        # "v" tags this result's binary frame to match.
+                        if isinstance(value, dict) \
+                                and MODEL_VERSION_KEY not in value:
+                            value[MODEL_VERSION_KEY] = self.model_version
+                        set_wire_model_version(
+                            value.get(MODEL_VERSION_KEY)
+                            if isinstance(value, dict) else None)
                         # the connection's policy retries across reconnects; a
                         # RetryAbortedError means stopping AND broker gone.
                         # Result tensors ride raw binary frames (no npy/base64)
@@ -294,9 +333,11 @@ class ClusterServing:
                                     self._write_result(conn, uri, value)
                             else:
                                 self._write_result(conn, uri, value)
+                        is_err = isinstance(value, dict) and "error" in value
                         _RECORDS.labels(
-                            outcome="error" if isinstance(value, dict)
-                            and "error" in value else "ok").inc()
+                            outcome="error" if is_err else "ok").inc()
+                        if is_err:
+                            self.errors += 1
                         self.served += 1
                         done_ids.append(entry_id)
                     # results are durably written: release the broker's pending
@@ -418,6 +459,12 @@ class ClusterServing:
                  ("supervisor", self._supervise_loop)]
         if self.replica_id is not None:
             loops.append(("fleet-hb", self._fleet_heartbeat_loop))
+        elif getattr(self.config, "hot_swap", True) \
+                and self.swapper.supported():
+            # single-engine hot-swap: consume the trainer's publish stream
+            # directly (fleet replicas get swap commands from the
+            # RolloutController via the control hash instead)
+            loops.append(("swap-listener", self._swap_listener_loop))
         for name, fn in loops:
             t = threading.Thread(target=fn, daemon=True, name=f"serving-{name}")
             t.start()
@@ -425,6 +472,117 @@ class ClusterServing:
         for widx in range(max(1, self.config.infer_workers)):
             self._threads.append(self._spawn_infer_worker(widx))
         return self
+
+    # --------------------------------------------------------------- hot-swap
+
+    @property
+    def model_version(self) -> str:
+        """The version id every response is tagged with: the hot-swapped
+        checkpoint version, or ``"initial"`` for the boot params."""
+        return getattr(self.model, "version", None) or "initial"
+
+    def _run_swap(self, record: Dict[str, Any]) -> None:
+        """Stage + swap one published version (worker thread — staging is
+        OFF the hot path; only the reference flip holds the dispatch gate).
+        A chaos kill inside staging is replica death mid-swap: the whole
+        engine goes silent so the supervisor respawns it (and the rollout
+        reconciler brings the respawn back to the correct version)."""
+        if record.get("rollback"):
+            self._swap_state = "staging"
+            self._swap_error = None
+            try:
+                self.swapper.rollback()
+                self._swap_state = "ok"
+            except Exception as e:
+                self._swap_state = "error"
+                self._swap_error = f"rollback failed: {e!r}"
+                logger.exception("model rollback failed")
+            return
+        self._swap_state = "staging"
+        self._swap_error = None
+        try:
+            self.swapper.stage_and_swap(record,
+                                        force=bool(record.get("force")))
+            self._swap_state = "ok"
+        except SwapRejected as e:
+            self._swap_state = "error"
+            self._swap_error = f"{e.reason}: {e}"
+            logger.warning("model swap rejected (%s): %s", e.reason, e)
+        except WorkerKilled:
+            logger.warning("replica killed mid-swap (chaos)")
+            self.kill()
+        except Exception as e:
+            self._swap_state = "error"
+            self._swap_error = f"swap failed: {e!r}"
+            logger.exception("model swap failed")
+
+    def _handle_swap_command(self, swap: Dict[str, Any]) -> None:
+        """One swap command from the control hash (deduped by nonce); runs
+        on a dedicated thread so heartbeats keep flowing while staging. The
+        nonce is published back in the heartbeat so the controller can scope
+        ``swap_state``/``swap_error`` to ITS command — a stale error from a
+        previously rejected version must not fail a later good rollout."""
+        nonce = swap.get("nonce")
+        if nonce == self._swap_nonce_seen:
+            return
+        if self._swap_thread is not None and self._swap_thread.is_alive():
+            return          # staging busy: the command re-arrives next poll
+        self._swap_nonce_seen = nonce
+        self._swap_state = "staging"
+        self._swap_error = None
+        self._swap_thread = threading.Thread(
+            target=self._run_swap, args=(dict(swap),), daemon=True,
+            name="serving-swap")
+        self._swap_thread.start()
+
+    def _swap_listener_loop(self):
+        """Single-engine (non-fleet) hot-swap: consume the trainer's publish
+        stream directly and swap on every new version. Group-at-tail plus an
+        XLAST catch-up peek — a restarted engine adopts the latest published
+        version without replaying (and re-serving) the whole history."""
+        conn = self._connect("engine.swap-listener")
+        group = f"swap-{self.group}"
+        try:
+            try:
+                conn.call("XGROUPCREATE", MODEL_STREAM, group, "$")
+                last = conn.call("XLAST", MODEL_STREAM)
+            except RetryAbortedError:
+                return
+            if last is not None and isinstance(last[1], dict):
+                self._run_swap(last[1])
+                self._report_rejection(conn, last[1])
+            while not self._stop.is_set() and not self._killed.is_set():
+                try:
+                    entries = conn.call("XREADGROUP", MODEL_STREAM, group,
+                                        1, 200)
+                except RetryAbortedError:
+                    break
+                for entry_id, record in entries or ():
+                    if isinstance(record, dict):
+                        self._run_swap(record)
+                        self._report_rejection(conn, record)
+                    try:
+                        conn.call("XACK", MODEL_STREAM, group, [entry_id])
+                    except RetryAbortedError:
+                        return
+        finally:
+            conn.close()
+
+    def _report_rejection(self, conn: _Conn, record: Dict[str, Any]) -> None:
+        """Single-engine mode has no RolloutController; a rejected publish
+        still trips the rejection stream so the trainer sees it."""
+        if self._swap_state != "error":
+            return
+        from .hotswap import MODEL_REJECT_STREAM
+
+        try:
+            conn.call("XADD", MODEL_REJECT_STREAM,
+                      {"version": record.get("version"),
+                       "step": record.get("step"),
+                       "reason": self._swap_error,
+                       "outcome": "rejected", "ts": time.time()})
+        except Exception:
+            logger.exception("rejection record write failed")
 
     # ------------------------------------------------------------- fleet mode
 
@@ -477,7 +635,13 @@ class ClusterServing:
                     conn.call("HSET", FLEET_HB_PREFIX + self.replica_id,
                               {"ts": time.time(), "state": self.state(),
                                "pid": os.getpid(), "served": self.served,
-                               "inflight": self._infer_q.qsize()})
+                               "inflight": self._infer_q.qsize(),
+                               "errors": self.errors,
+                               "lat_ms": round(self._lat_ema_s * 1e3, 3),
+                               "model_version": self.model_version,
+                               "swap_state": self._swap_state,
+                               "swap_error": self._swap_error,
+                               "swap_nonce": self._swap_nonce_seen})
                     ctl = conn.call("HGET",
                                     FLEET_CTL_PREFIX + self.replica_id, 0)
                 except RetryAbortedError:
@@ -486,6 +650,12 @@ class ClusterServing:
                     ctl_seen = ctl
                     if ctl.get("state") == "drain":
                         self.drain()
+                if isinstance(ctl, dict) and isinstance(ctl.get("swap"),
+                                                        dict):
+                    # swap commands are nonce-deduped (NOT ctl_seen-deduped:
+                    # a busy staging thread defers the command to the next
+                    # poll instead of dropping it)
+                    self._handle_swap_command(ctl["swap"])
                 self._stop.wait(interval)
             # deliberate shutdown (not kill): publish a terminal state so the
             # supervisor can tell "stopped on purpose" from "went silent"
@@ -506,7 +676,12 @@ class ClusterServing:
         dispatch path is a dict lookup — ``compiles`` staying flat under
         traffic is the no-mid-traffic-recompile property)."""
         out: Dict[str, Any] = {"served": self.served,
-                               "workers_respawned": self.workers_respawned}
+                               "errors": self.errors,
+                               "workers_respawned": self.workers_respawned,
+                               "model_version": self.model_version,
+                               "swap_state": self._swap_state}
+        if self._swap_error:
+            out["swap_error"] = self._swap_error
         if hasattr(self.model, "compile_stats"):
             out.update(self.model.compile_stats())
         return out

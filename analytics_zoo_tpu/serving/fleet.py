@@ -143,6 +143,19 @@ class _ReplicaSlot:
         # (served_at_dispatch, t_dispatch) while a half-open probe request
         # is outstanding; progress on `served` closes the breaker
         self.probe: Optional[Tuple[int, float]] = None
+        # hot-swap telemetry folded from the heartbeat (serving/hotswap.py):
+        # the rollout controller validates the canary on these
+        self.last_seen = time.monotonic()   # last alive=True liveness feed
+        self.model_version: Optional[str] = None
+        self.swap_state: Optional[str] = None
+        self.swap_error: Optional[str] = None
+        self.swap_nonce: Any = None   # nonce of the replica's LAST swap
+                                      # command — scopes swap_error to it
+        self.errors = 0             # cumulative error-result counter
+        self.lat_ms = 0.0           # receipt->computed latency EMA
+        # canary traffic weight: 1.0 = full member of the rotation; a
+        # fraction f < 1 admits this replica on ~every (1/f)th pick only
+        self.weight = 1.0
 
 
 class ReplicaRouter:
@@ -181,6 +194,7 @@ class ReplicaRouter:
         for rid in replica_ids:
             self.add_replica(rid)
         self._rr_next = 0
+        self._pick_seq = 0          # canary-weight admission counter
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -217,7 +231,13 @@ class ReplicaRouter:
 
     def set_liveness(self, rid: str, alive: bool, state: str = "up",
                      served: Optional[int] = None,
-                     inflight: Optional[int] = None) -> None:
+                     inflight: Optional[int] = None,
+                     model_version: Optional[str] = None,
+                     errors: Optional[int] = None,
+                     lat_ms: Optional[float] = None,
+                     swap_state: Optional[str] = None,
+                     swap_error: Optional[str] = None,
+                     swap_nonce: Any = None) -> None:
         """Heartbeat-poll feed from the supervisor. Also resolves half-open
         probes: a probe request counts as SUCCEEDED when the replica's
         cumulative ``served`` advanced past its at-dispatch value, and as
@@ -231,10 +251,23 @@ class ReplicaRouter:
                 return
             slot.alive = alive
             slot.state = state
+            if alive:
+                slot.last_seen = time.monotonic()
             if served is not None:
                 slot.served = served
             if inflight is not None:
                 slot.reported_inflight = inflight
+            if model_version is not None:
+                slot.model_version = model_version
+            if errors is not None:
+                slot.errors = errors
+            if lat_ms is not None:
+                slot.lat_ms = lat_ms
+            if swap_state is not None:
+                slot.swap_state = swap_state
+            slot.swap_error = swap_error
+            if swap_nonce is not None:
+                slot.swap_nonce = swap_nonce
             # probe resolution stays under the lock: _pick() reserves
             # slot.probe while holding it, and clearing the reservation here
             # without it could admit a second in-flight probe (the breaker's
@@ -264,6 +297,19 @@ class ReplicaRouter:
                 and s.breaker.state != CircuitBreaker.OPEN
                 and s.probe is None]
 
+    def set_traffic_fraction(self, rid: str, fraction: float) -> None:
+        """Canary traffic weighting (the rollout-policy hook): route roughly
+        ``fraction`` of dispatch decisions to ``rid``, the rest to the full-
+        weight members. Deterministic (every k-th pick admits the canary, k
+        = round(1/fraction)) — no RNG in the dispatch path. ``1.0`` restores
+        full membership."""
+        if not (0.0 < fraction <= 1.0):
+            raise ValueError(f"fraction must be in (0, 1], got {fraction!r}")
+        with self._lock:
+            slot = self._slots.get(rid)
+            if slot is not None:
+                slot.weight = float(fraction)
+
     def depths(self) -> Dict[str, int]:
         with self._lock:
             return {rid: s.depth for rid, s in self._slots.items()}
@@ -275,7 +321,10 @@ class ReplicaRouter:
                 "replicas": {
                     s.rid: {"dispatched": s.dispatched, "depth": s.depth,
                             "alive": s.alive, "state": s.state,
-                            "served": s.served,
+                            "served": s.served, "errors": s.errors,
+                            "model_version": s.model_version,
+                            "swap_state": s.swap_state,
+                            "weight": s.weight, "lat_ms": s.lat_ms,
                             "breaker": s.breaker.state} for s in slots}}
 
     # -- routing -------------------------------------------------------------
@@ -314,12 +363,21 @@ class ReplicaRouter:
     def _pick(self) -> Optional[str]:
         """Choose an eligible replica per the policy; reserves a half-open
         probe slot via ``breaker.allow()`` (so at most one in-flight probe
-        per recovering replica)."""
+        per recovering replica). Weighted (canary) replicas are admitted as
+        candidates only on every ``round(1/weight)``-th pick."""
         with self._lock:
             slots = [s for s in self._slots.values()
                      if s.alive and s.state == "up"]
             if not slots:
                 return None
+            self._pick_seq += 1
+            if any(s.weight < 1.0 for s in slots):
+                admitted = [
+                    s for s in slots
+                    if s.weight >= 1.0
+                    or self._pick_seq % max(1, round(1.0 / s.weight)) == 0]
+                # a rotation of only weighted members must not stall traffic
+                slots = admitted or slots
             if self.policy == "least_pending":
                 order = sorted(slots, key=lambda s: s.depth)
             else:                       # round_robin over the stable roster
@@ -519,6 +577,13 @@ class FleetSupervisor:
         self.requeued = 0
         self.respawns = 0
         self.failovers: List[float] = []
+        # canary rollout controller (serving/hotswap.py): consumes the
+        # trainer's publish stream and drives per-replica swap commands
+        self.rollout = None
+        if getattr(config, "hot_swap", True):
+            from .hotswap import RolloutController
+
+            self.rollout = RolloutController(self, config)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -553,6 +618,8 @@ class FleetSupervisor:
                                          daemon=True,
                                          name="zoo-fleet-supervisor")
         self._monitor.start()
+        if self.rollout is not None:
+            self.rollout.start()
         return self
 
     def _replica_config(self) -> ServingConfig:
@@ -653,7 +720,13 @@ class FleetSupervisor:
                 self.router.set_liveness(
                     rid, True, state=state,
                     served=int(hb.get("served", 0)),
-                    inflight=int(hb.get("inflight", 0)))
+                    inflight=int(hb.get("inflight", 0)),
+                    model_version=hb.get("model_version"),
+                    errors=int(hb.get("errors", 0)),
+                    lat_ms=float(hb.get("lat_ms", 0.0)),
+                    swap_state=hb.get("swap_state"),
+                    swap_error=hb.get("swap_error"),
+                    swap_nonce=hb.get("swap_nonce"))
             elif proc_dead:
                 # hard process exit: expire the component immediately by
                 # re-registering with a zero budget — check_transitions
@@ -811,12 +884,24 @@ class FleetSupervisor:
 
     def readiness(self) -> Tuple[bool, Dict[str, Any]]:
         """/readyz payload: ready iff >= 1 replica is eligible for dispatch
-        (distinct from liveness — a fleet mid-drain is alive but not ready)."""
+        (distinct from liveness — a fleet mid-drain is alive but not ready).
+        Carries each replica's active model version and the rollout phase so
+        an operator probing readiness sees a stuck rollout at a glance."""
         eligible = self.router.eligible_ids()
-        return (len(eligible) >= 1,
-                {"eligible": eligible,
-                 "replicas": self.router.replica_ids(),
-                 "requeued": self.requeued, "respawns": self.respawns})
+        detail: Dict[str, Any] = {
+            "eligible": eligible,
+            "replicas": self.router.replica_ids(),
+            "requeued": self.requeued, "respawns": self.respawns,
+            "model_versions": self.model_versions()}
+        if self.rollout is not None:
+            detail["rollout"] = self.rollout.state()
+        return len(eligible) >= 1, detail
+
+    def model_versions(self) -> Dict[str, Optional[str]]:
+        """Per-replica active model version, from the heartbeat-fed slots."""
+        with self.router._lock:
+            return {rid: s.model_version
+                    for rid, s in self.router._slots.items()}
 
     def stats(self) -> Dict[str, Any]:
         """Aggregated engine stats + router view (feeds /metrics.json)."""
@@ -825,6 +910,8 @@ class FleetSupervisor:
                                "requeued": self.requeued,
                                "respawns": self.respawns,
                                "served": 0}
+        if self.rollout is not None:
+            out["rollout"] = self.rollout.state()
         slots = router_stats.get("replicas", {})
         for rid, handle in list(self._handles.items()):
             if handle.engine is not None:
@@ -848,6 +935,8 @@ class FleetSupervisor:
         traffic), then replicas drain + stop (in-flight work finishes and
         acks), then the monitor. Undispatched client entries stay on the
         broker for the next incarnation (AOF redelivery)."""
+        if self.rollout is not None:
+            self.rollout.stop()
         self.router.stop(drain_s=min(2.0, drain_s))
         for rid, handle in list(self._handles.items()):
             if handle.engine is not None:

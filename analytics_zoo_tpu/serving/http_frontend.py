@@ -74,11 +74,15 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # quiet
         pass
 
-    def _respond(self, code: int, obj) -> None:
+    def _respond(self, code: int, obj,
+                 model_version: Optional[str] = None) -> None:
         data = json.dumps(obj).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        if model_version:
+            # the HTTP twin of the wire header's "v" field
+            self.send_header("X-Zoo-Model-Version", model_version)
         self.end_headers()
         self.wfile.write(data)
 
@@ -170,10 +174,18 @@ class _Handler(BaseHTTPRequestHandler):
             # query hops (and through them broker + engine) nest under it
             with timing("http.predict"), \
                     _tm.span("serving.http.predict", n=len(instances)):
-                preds = app.predict_instances(instances,
-                                              timeout_s=app.timeout_s)
+                preds, versions = app.predict_instances(
+                    instances, timeout_s=app.timeout_s)
             code = "200"
-            self._respond(200, {"predictions": preds})
+            body = {"predictions": preds}
+            # hot-swap attribution: which model version(s) served this
+            # request — a string normally, a list mid-swap (mixed versions
+            # ACROSS instances are legal; within one tensor they are not)
+            if versions:
+                body["model_version"] = (versions[0] if len(versions) == 1
+                                         else versions)
+            self._respond(200, body,
+                          model_version=",".join(versions) or None)
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             code = "400"
             self._respond(400, {"error": str(e)})
@@ -456,6 +468,10 @@ class FrontEndApp:
             self._oq_pool.put(oq)
 
     def predict_instances(self, instances, timeout_s: float = 30.0):
+        """Returns ``(predictions, versions)`` where ``versions`` is the
+        deduped (order-preserving) list of serving model versions that
+        produced them — normally one entry; two legitimately appear when a
+        hot-swap lands between instances of one request."""
         parsed = []
         for inst in instances:
             if not isinstance(inst, dict) or not inst:
@@ -469,13 +485,15 @@ class FrontEndApp:
                 val = self._batcher.wait(slot, timeout_s=timeout_s)
                 out.append(val.tolist() if isinstance(val, np.ndarray)
                            else [np.asarray(v).tolist() for v in val])
-            return out
+            ver = getattr(self._model, "version", None) or "initial"
+            return out, [ver]
         # queue mode: the whole broker round trip rides the circuit breaker —
         # when the broker/engine is down, requests fail fast (503 upstream)
         # instead of each burning a thread for the full timeout
         if not self.breaker.allow():
             raise CircuitOpenError(self.breaker.name,
                                    self.breaker.retry_after_s())
+        versions: list = []
         try:
             uris = [self._input.enqueue(None, **tensors) for tensors in parsed]
             out = []
@@ -484,6 +502,9 @@ class FrontEndApp:
                     val = oq.query(uri, timeout_s=timeout_s)
                     out.append(val.tolist() if isinstance(val, np.ndarray)
                                else val)
+                    v = oq.last_model_version
+                    if v and v not in versions:
+                        versions.append(v)
         except (TimeoutError, ConnectionError, OSError, ResilienceError):
             self.breaker.record_failure()
             raise
@@ -496,7 +517,7 @@ class FrontEndApp:
             self.breaker.record_success()
             raise
         self.breaker.record_success()
-        return out
+        return out, versions
 
     @contextlib.contextmanager
     def _gen_client(self):

@@ -31,6 +31,22 @@ import numpy as np
 # by peers that predate it — interop never depends on its presence.
 TRACE_KEY = "trace"
 
+# Serving-model-version field carried inside RESULT payload dicts (the
+# durable twin of the binary frame header's "v" field): the version id of
+# the hot-swappable model that produced the result (serving/hotswap.py),
+# stamped by the engine sink, surviving the broker hash + AOF replay to the
+# client. Absent from pre-hot-swap engines — consumers must tolerate that.
+MODEL_VERSION_KEY = "model_version"
+
+
+def payload_model_version(payload: Any) -> Optional[str]:
+    """Tolerant read of a result payload's serving model version."""
+    if isinstance(payload, dict):
+        v = payload.get(MODEL_VERSION_KEY)
+        if isinstance(v, str) and v:
+            return v
+    return None
+
 
 def payload_trace(payload: Any) -> Optional[Dict[str, str]]:
     """Tolerant read of a payload dict's trace context (``None`` when absent

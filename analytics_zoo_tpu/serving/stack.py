@@ -75,6 +75,11 @@ def main(argv=None) -> int:
                          "engine). >1 enables health-routed dispatch, "
                          "failover requeue, and rolling `cli drain`/restart")
     ap.add_argument("--int8", action="store_true")
+    ap.add_argument("--no-hot-swap", action="store_true",
+                    help="ignore the trainer's model_updates publish stream "
+                         "(default: fleet stacks run the canary "
+                         "RolloutController; single engines swap in place "
+                         "on every published checkpoint)")
     ap.add_argument("--demo", action="store_true",
                     help="serve a built-in demo model (no bundle needed)")
     ap.add_argument("--platform", default=None, choices=("cpu", "tpu"),
@@ -111,6 +116,8 @@ def main(argv=None) -> int:
 
     if args.replicas is not None:
         cfg.replicas = args.replicas
+    if args.no_hot_swap:
+        cfg.hot_swap = False
 
     broker = start_broker("127.0.0.1", args.broker_port, aof_path=args.aof)
     # one registry spans the stack: engine stage/worker heartbeats feed the

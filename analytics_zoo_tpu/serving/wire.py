@@ -114,6 +114,26 @@ def received_trace_context() -> Optional[Dict[str, str]]:
 
 
 # ---------------------------------------------------------------------------
+# serving-model-version propagation: binary frames carry an optional header
+# field "v" — the model version of the serving engine that produced the
+# payload. Set ambiently per thread (the engine's sink thread tags its result
+# writes; the broker tags result-fetch replies from the stored payload), read
+# after recv like the trace context. Old peers ignore/omit it.
+# ---------------------------------------------------------------------------
+
+def set_wire_model_version(version: Optional[str]) -> None:
+    """Tag binary frames SENT from this thread with a serving model version
+    (header field "v"); ``None`` clears the tag."""
+    _TLS.send_version = version
+
+
+def received_model_version() -> Optional[str]:
+    """Model version carried by the last frame ``recv_msg`` returned on
+    THIS thread, or ``None`` (JSON frame, old sender, untagged)."""
+    return getattr(_TLS, "recv_version", None)
+
+
+# ---------------------------------------------------------------------------
 # msgpack subset (nil/bool/int/float64/str/bin/array/map — standard format
 # codes, interoperable with any msgpack reader)
 # ---------------------------------------------------------------------------
@@ -447,6 +467,9 @@ def send_msg(sock: socket.socket, obj: Any, shm=None) -> None:
     ctx = _tm.current_wire_context()
     if ctx is not None:
         meta["c"] = ctx
+    ver = getattr(_TLS, "send_version", None)
+    if ver is not None:
+        meta["v"] = str(ver)
     header = pack(meta)
     inline_bytes = sum(len(m) for m in inline)
     total = _PRE.size + len(header) + inline_bytes
@@ -489,6 +512,7 @@ def recv_msg(sock: socket.socket, shm=None) -> Any:
             recv_exact_into(sock, memoryview(body)[1:])
         _account(bytes_received=4 + n, frames_json=1)
         _TLS.ctx = None       # JSON control frames carry context in-payload
+        _TLS.recv_version = None
         return json.loads(bytes(body))
     pre = bytearray(_PRE.size)
     pre[0] = first[0]
@@ -505,9 +529,12 @@ def recv_msg(sock: socket.socket, shm=None) -> Any:
     header = bytearray(header_len)
     recv_exact_into(sock, memoryview(header))
     meta = unpack(header)
-    # optional trace context ("c"): absent from old senders — tolerated
+    # optional trace context ("c") / model version ("v"): absent from old
+    # senders — tolerated
     ctx = meta.get("c")
     _TLS.ctx = ctx if _tm.TraceContext.from_wire(ctx) is not None else None
+    ver = meta.get("v")
+    _TLS.recv_version = str(ver) if isinstance(ver, str) and ver else None
     expect = _PRE.size + header_len + sum(
         d["n"] for d in meta["b"] if "o" not in d)
     if expect != n:

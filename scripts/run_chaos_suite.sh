@@ -1,9 +1,11 @@
 #!/usr/bin/env bash
 # Run the deterministic fault-injection suite (tests marked `chaos`, plus the
-# replica-fleet failover drills marked `fleet`) on the CPU backend with a
+# replica-fleet failover drills marked `fleet` and the model hot-swap /
+# canary-rollout drills marked `hotswap` — kill-the-canary-mid-rollout,
+# kill-the-engine-mid-swap, NaN-poisoned publish) on the CPU backend with a
 # hard wall-clock cap, independently of tier-1.
 #
-#   scripts/run_chaos_suite.sh            # chaos + fleet marker sets
+#   scripts/run_chaos_suite.sh            # chaos + fleet + hotswap markers
 #   scripts/run_chaos_suite.sh -k broker  # usual pytest filters pass through
 #
 # CHAOS_SUITE_TIMEOUT (seconds, default 600) bounds the run even if a
@@ -13,4 +15,5 @@ cd "$(dirname "$0")/.."
 
 TIMEOUT="${CHAOS_SUITE_TIMEOUT:-600}"
 exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
-    python -m pytest tests -q -m "chaos or fleet" -p no:cacheprovider "$@"
+    python -m pytest tests -q -m "chaos or fleet or hotswap" \
+    -p no:cacheprovider "$@"

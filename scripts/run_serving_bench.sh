@@ -57,6 +57,14 @@ if [[ "${1:-}" == "--quick" ]]; then
     # and routed throughput scales >= 2.5x from 1 to 4 replicas
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
         python bench.py --fleet --quick
+    # hot-swap gate: sustained load through >= 3 consecutive canary-rolled
+    # version swaps on a 4-replica fleet, one canary chaos-killed mid-
+    # rollout, one NaN-poisoned publish — zero failed client requests,
+    # every response tagged with the serving model version AND the value
+    # matching its tag (no mixed weights), automatic rollback observed,
+    # fleet converged on the last good version, bounded p95 inflation
+    timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+        python bench.py --hotswap --quick
     # int8 kernel-tier structural gate (writes KERNEL_BENCH.json for the
     # CPU leg; the TPU run overwrites it with real ratios + MFU)
     exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \

@@ -88,8 +88,14 @@ def test_cached_checkpoint_trigger_crosses_block(tmp_path):
 
 
 def test_cached_shuffled_trains():
-    est = _fit(cache=True, scan_block=5, epochs=3, shuffle=True)
-    assert est.trainer_state.iteration == 30
+    # 10 epochs, not 3: 30 SGD(lr=0.01) steps on this problem is a seed
+    # lottery around the 0.5 bar (a reference jax+optax implementation of
+    # the identical recipe lands anywhere in ~0.24-0.55 across seeds, and
+    # the streaming path scores the same 0.433 as the cached path here) —
+    # 100 steps puts the deterministic seed-7 run at ~0.61, so the assert
+    # tests "the shuffled cached path learns", not optimizer luck
+    est = _fit(cache=True, scan_block=5, epochs=10, shuffle=True)
+    assert est.trainer_state.iteration == 100
     assert np.isfinite(est.trainer_state.last_loss)
     x, y = _data()
     res = est.evaluate((x, y), batch_size=64, metrics=("accuracy",))

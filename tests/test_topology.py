@@ -201,7 +201,16 @@ def test_partial_weight_donation(zoo_ctx):
 def test_recalibrate_batchnorm_closes_train_eval_gap(zoo_ctx):
     """Short trainings leave the 0.99-EMA BatchNorm stats behind the final
     weights; Estimator.recalibrate_batchnorm (update_bn analog) re-estimates
-    them so eval-mode forward matches train-mode statistics."""
+    them so eval-mode forward matches train-mode STATISTICS.
+
+    The gap is measured dropout-silenced on the full recalibration batch:
+    the property under test is moving-stats vs batch-stats alignment, and
+    with dropout active the train branch carries an ~O(max|activation|)
+    noise floor from the zeroed units (identical before/after, since the
+    mask depends only on the rng key) that buries the BN signal, while a
+    32-row probe batch adds stats sampling noise on top — both made the old
+    assertion a coin flip on jax/PRNG details rather than a recalibration
+    check."""
     import jax
 
     from analytics_zoo_tpu.nn import Input, Model
@@ -210,7 +219,8 @@ def test_recalibrate_batchnorm_closes_train_eval_gap(zoo_ctx):
     inp = Input((12,))
     h = L.Dense(32, activation="relu")(inp)
     h = L.BatchNormalization()(h)
-    h = L.Dropout(0.3)(h)
+    drop_layer = L.Dropout(0.3)
+    h = drop_layer(h)
     out = L.Dense(2)(h)
     net = Model(inp, out)
     net.compile(optimizer="adam", loss="mse")
@@ -224,15 +234,20 @@ def test_recalibrate_batchnorm_closes_train_eval_gap(zoo_ctx):
     def gap():
         params = jax.device_get(est.train_state["params"])
         mstate = jax.device_get(est.train_state["model_state"])
-        ev, _ = net.apply(params, mstate, x[:32], training=False)
-        tr, _ = net.apply(params, mstate, x[:32], training=True,
-                          rng=jax.random.PRNGKey(0))
+        saved, drop_layer.rate = drop_layer.rate, 0.0
+        try:
+            ev, _ = net.apply(params, mstate, x, training=False)
+            tr, _ = net.apply(params, mstate, x, training=True,
+                              rng=jax.random.PRNGKey(0))
+        finally:
+            drop_layer.rate = saved
         return float(np.abs(np.asarray(ev) - np.asarray(tr)).max())
 
     before = gap()
     est.recalibrate_batchnorm((x, y), batch_size=64)   # (x, y) tuple accepted
     after = gap()
-    assert after <= before + 1e-6
+    # strictly closer (0.19 -> 0.14 here), with margin against fp jitter
+    assert after < before * 0.95, (before, after)
     # dropout rate and BN momentum restored after the pass
     drop = [l for l in net.layers if isinstance(l, L.Dropout)][0]
     bn = [l for l in net.layers if isinstance(l, L.BatchNormalization)][0]
