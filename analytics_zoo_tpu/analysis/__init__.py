@@ -10,9 +10,17 @@ Two layers over one rule engine (:mod:`analysis.core`):
   baked into the jaxpr, dtype-discipline leaks, and recompilation hazards.
 * **Host layer** (:mod:`analysis.astlint`) — an AST lint for the Python-side
   hazards around the traced region: tracer leaks, wall-clock/RNG reads
-  inside jitted functions, telemetry-registry mutation outside its lock,
-  unregistered ``chaos_point`` sites. Inline suppressions:
-  ``# zoo-lint: disable=<rule> — reason``.
+  inside jitted functions, unregistered ``chaos_point`` sites. Inline
+  suppressions: ``# zoo-lint: disable=<rule> — reason``.
+* **Concurrency tier** (:mod:`analysis.concurrency` +
+  :mod:`analysis.rules.concurrency`) — per-class lock models inferred from
+  the AST: guarded-by sets (the generalized ``telemetry-lock``), a static
+  lock-order graph with cycle detection (ABBA deadlocks), hold-hazard rules
+  (blocking ops / user callbacks under a lock — the PR-8/9 bug class), leaf/
+  unused/reach-in checks, declared intent via ``# zoo-lock:`` annotations,
+  and a runtime witness (:mod:`analytics_zoo_tpu.common.locks.TracedLock`)
+  whose recorded acquisition edges are unioned with the static graph by the
+  chaos-suite gate (:func:`analysis.concurrency.check_witness`).
 
 Wired three ways: the CLI (``python -m analytics_zoo_tpu.analysis``,
 ``scripts/run_lint.sh``) lints the package; ``TrainConfig.graph_checks``
@@ -25,15 +33,18 @@ See docs/programming-guide/static-analysis.md for the rule catalog and how
 to write a rule.
 """
 
-from .core import (Finding, GraphLintError, Rule, RuleContext, all_rules,
-                   enforce, finding, get_rule, register, report)
+from .core import (Finding, GraphLintError, Rule, RuleContext, RULE_ALIASES,
+                   all_rules, enforce, finding, get_rule, register, report)
 from .graphlint import (SignatureTracker, lint_hlo, lint_jaxpr,
                         lint_signatures, lint_traced, walk_eqns)
 from .astlint import lint_file, lint_package, lint_source
+from .concurrency import (build_module_model, check_witness,
+                          collect_lock_graph, find_cycles)
 
 __all__ = [
-    "Finding", "GraphLintError", "Rule", "RuleContext", "SignatureTracker",
-    "all_rules", "enforce", "finding", "get_rule", "lint_file", "lint_hlo",
-    "lint_jaxpr", "lint_package", "lint_signatures", "lint_source",
-    "lint_traced", "register", "report", "walk_eqns",
+    "Finding", "GraphLintError", "Rule", "RuleContext", "RULE_ALIASES",
+    "SignatureTracker", "all_rules", "build_module_model", "check_witness",
+    "collect_lock_graph", "enforce", "find_cycles", "finding", "get_rule",
+    "lint_file", "lint_hlo", "lint_jaxpr", "lint_package", "lint_signatures",
+    "lint_source", "lint_traced", "register", "report", "walk_eqns",
 ]

@@ -4,22 +4,81 @@
     python -m analytics_zoo_tpu.analysis path1 path2    # lint files/dirs
     python -m analytics_zoo_tpu.analysis --json         # machine-readable
     python -m analytics_zoo_tpu.analysis --list-rules   # full rule catalog
+    python -m analytics_zoo_tpu.analysis --rules 'lock-*'
+                                                        # only matching rules
+    python -m analytics_zoo_tpu.analysis --witness w.jsonl
+                                                        # check a recorded
+                                                        # lock-order trace
 
 Exit status: 1 when any unsuppressed error-severity finding remains, else 0
 (``scripts/run_lint.sh`` gates CI on this). Graph-layer rules need a traced
 computation and therefore run at fit/model-load/bench time, not here —
 ``--list-rules`` still catalogs them.
+
+``--witness`` is the chaos-suite gate's offline half: it loads the JSONL a
+:class:`~analytics_zoo_tpu.common.locks.TracedLock` run dumped
+(``ZOO_TPU_TRACE_LOCKS=1 ZOO_TPU_LOCK_WITNESS=<path>``), unions the
+witnessed acquisition edges with the static lock-order graph of the linted
+paths, and fails on any cycle or leaf-lock violation (plus over-budget holds
+when ``--max-hold-s``/``ZOO_TPU_LOCK_MAX_HOLD_S`` is set) — so CI and local
+debugging drive the same checker.
 """
 
 from __future__ import annotations
 
 import argparse
+import fnmatch
 import json
 import os
 import sys
 
 from . import all_rules
 from .astlint import lint_file, lint_package
+
+
+def _env_max_hold_s():
+    """ZOO_TPU_LOCK_MAX_HOLD_S as a float, or None — a malformed value must
+    not crash plain lint runs that never touch witness mode."""
+    raw = os.environ.get("ZOO_TPU_LOCK_MAX_HOLD_S")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"[zoo-lint] ignoring malformed ZOO_TPU_LOCK_MAX_HOLD_S="
+              f"{raw!r} (want a float)", file=sys.stderr)
+        return None
+
+
+def _selected_rules(pattern):
+    """AST-layer rules whose id matches the ``--rules`` glob (None = all)."""
+    if pattern is None:
+        return None
+    sel = [r for r in all_rules("ast") if fnmatch.fnmatch(r.id, pattern)]
+    if not sel:
+        raise SystemExit(f"--rules {pattern!r} matches no AST rule; known: "
+                         f"{[r.id for r in all_rules('ast')]}")
+    return sel
+
+
+def _check_witness(witness_path, paths, max_hold_s):
+    from ..common.locks import load_witness
+    from .concurrency import check_witness, collect_lock_graph
+    from .core import report
+
+    static_edges, leaves, declared = [], set(), []
+    for path in paths:
+        e, lv, de = collect_lock_graph(path)
+        static_edges.extend((x.src, x.dst) for x in e)
+        leaves |= lv
+        declared.extend(de)
+    static_edges.extend((a, b) for a, b, _line in declared)
+    w_edges, w_holds = load_witness(witness_path)
+    findings = report(check_witness(
+        static_edges, w_edges, leaf_locks=leaves,
+        max_holds=w_holds, max_hold_s=max_hold_s,
+        where=os.path.basename(witness_path)))
+    return findings, len(w_edges), len(set(static_edges))
 
 
 def main(argv=None) -> int:
@@ -34,7 +93,23 @@ def main(argv=None) -> int:
                         help="emit findings as one JSON object")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog (all layers) and exit")
+    parser.add_argument("--rules", metavar="GLOB", default=None,
+                        help="run only AST rules whose id matches this glob "
+                             "(e.g. 'lock-*' for the concurrency tier)")
+    parser.add_argument("--witness", metavar="JSONL", default=None,
+                        help="check a recorded lock-order witness "
+                             "(TracedLock dump) against the static lock "
+                             "graph of PATHS instead of linting source")
+    parser.add_argument("--max-hold-s", type=float, default=None,
+                        help="with --witness: fail locks observed held "
+                             "longer than this many seconds (default: env "
+                             "ZOO_TPU_LOCK_MAX_HOLD_S, else off)")
     args = parser.parse_args(argv)
+    if args.max_hold_s is None:
+        args.max_hold_s = _env_max_hold_s()
+    if args.witness is not None and args.rules is not None:
+        parser.error("--rules filters source lint rules and does not apply "
+                     "to --witness checks; pass one or the other")
 
     if args.list_rules:
         for rule in all_rules():
@@ -44,12 +119,31 @@ def main(argv=None) -> int:
     # default target: the analytics_zoo_tpu package this module lives in
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     paths = args.paths or [pkg_root]
+
+    if args.witness is not None:
+        findings, n_witnessed, n_static = _check_witness(
+            args.witness, paths, args.max_hold_s)
+        errors = [f for f in findings if f.severity == "error"]
+        if args.json:
+            print(json.dumps({
+                "findings": [f.as_dict() for f in findings],
+                "witnessed_edges": n_witnessed, "static_edges": n_static,
+                "errors": len(errors)}, indent=1))
+        else:
+            for f in findings:
+                print(f)
+            print(f"[zoo-lint] witness: {n_witnessed} witnessed edge(s) ∪ "
+                  f"{n_static} static edge(s); {len(findings)} finding(s) "
+                  f"({len(errors)} error(s))", file=sys.stderr)
+        return 1 if errors else 0
+
+    rules = _selected_rules(args.rules)
     findings, suppressed = [], 0
     for path in paths:
         if os.path.isdir(path):
-            fs, ns = lint_package(path)
+            fs, ns = lint_package(path, rules=rules)
         else:
-            fs, ns = lint_file(path)
+            fs, ns = lint_file(path, rules=rules)
         findings.extend(fs)
         suppressed += ns
 

@@ -11,10 +11,6 @@ live in the host code around the traced region:
   ``datetime.now`` inside a traced function: the value is frozen at trace
   time, so the "random"/"current" value is a compile-time constant replayed
   on every step.
-* ``telemetry-lock`` — mutation of the telemetry registry's guarded dicts
-  (``_families``/``_collectors``/``_children``) outside a ``with *_lock``
-  block (the scrape path copies under that lock; an unguarded write races
-  it).
 * ``chaos-site`` — ``chaos_point("name")`` call sites whose name is not in
   :data:`analytics_zoo_tpu.common.chaos.KNOWN_SITES`: a typo'd site silently
   never fires, so the chaos drill that targets it tests nothing.
@@ -28,6 +24,12 @@ wrapper (``jit``, ``pmap``, ``shard_map``, ``pallas_call``, ``scan``,
 ``checkpoint``, ``grad``/``value_and_grad``, ``vmap``, ``make_jaxpr``,
 ``eval_shape``), or (c) defined inside such a function. False positives are
 silenced inline with a justified ``# zoo-lint: disable=<rule> — reason``.
+
+The concurrency tier (``lock-guarded-by`` — the generalized successor of
+the old hard-coded ``telemetry-lock`` rule — plus ``lock-order-cycle``,
+``lock-hold-hazard`` and friends) shares this module's traversal and
+suppression machinery but lives in :mod:`analysis.rules.concurrency` over
+the per-class lock models of :mod:`analysis.concurrency`.
 """
 
 from __future__ import annotations
@@ -38,8 +40,8 @@ import os
 import re
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from .core import (Finding, Rule, RuleContext, all_rules, finding, get_rule,
-                   register, report)
+from .core import (Finding, Rule, RuleContext, RULE_ALIASES, all_rules,
+                   finding, get_rule, register, report)
 
 _SUPPRESS_RE = re.compile(r"zoo-lint:\s*disable=([\w,-]+)")
 
@@ -76,9 +78,6 @@ _WALLCLOCK: Tuple[Tuple[str, Optional[frozenset]], ...] = (
     ("uuid", frozenset(("uuid1", "uuid4"))),
     ("os", frozenset(("urandom",))),
 )
-_LOCK_GUARDED_ATTRS = frozenset(("_families", "_collectors", "_children"))
-_MUTATING_METHODS = frozenset(("append", "pop", "clear", "update",
-                               "setdefault", "remove", "extend"))
 
 
 def _attr_chain(node: ast.AST) -> List[str]:
@@ -310,72 +309,6 @@ class WallclockRule(Rule):
         return out
 
 
-def _is_lock_expr(expr: ast.AST) -> bool:
-    """True when a ``with`` context expression is a lock: the terminal
-    name/attribute ends with ``_lock`` (``self._lock``, ``reg._scrape_lock``,
-    ``self._lock()``) — NOT a substring match over the whole expression, so
-    ``open(path + "_lock")`` doesn't count as guarded."""
-    if isinstance(expr, ast.Call):
-        expr = expr.func
-    chain = _attr_chain(expr)
-    return bool(chain) and chain[-1].endswith("_lock")
-
-
-@register
-class TelemetryLockRule(Rule):
-    id = "telemetry-lock"
-    layer = "ast"
-    severity = "error"
-    doc = ("mutation of a lock-guarded registry dict (_families/_collectors/"
-           "_children) outside a `with *_lock` block — races the scrape's "
-           "copy-under-lock")
-
-    def _guarded_target(self, node: ast.AST) -> Optional[str]:
-        """The watched attr when ``node`` mutates one, else None."""
-        if isinstance(node, (ast.Assign, ast.AugAssign)):
-            targets = (node.targets if isinstance(node, ast.Assign)
-                       else [node.target])
-            for t in targets:
-                if isinstance(t, ast.Subscript) \
-                        and isinstance(t.value, ast.Attribute) \
-                        and t.value.attr in _LOCK_GUARDED_ATTRS:
-                    return t.value.attr
-        elif isinstance(node, ast.Delete):
-            for t in node.targets:
-                if isinstance(t, ast.Subscript) \
-                        and isinstance(t.value, ast.Attribute) \
-                        and t.value.attr in _LOCK_GUARDED_ATTRS:
-                    return t.value.attr
-        elif isinstance(node, ast.Call) \
-                and isinstance(node.func, ast.Attribute) \
-                and node.func.attr in _MUTATING_METHODS \
-                and isinstance(node.func.value, ast.Attribute) \
-                and node.func.value.attr in _LOCK_GUARDED_ATTRS:
-            return node.func.value.attr
-        return None
-
-    def check(self, art: SourceArtifact, ctx: RuleContext
-              ) -> Iterable[Finding]:
-        out: List[Finding] = []
-        for node in ast.walk(art.tree):
-            attr = self._guarded_target(node)
-            if attr is None:
-                continue
-            under_lock = any(
-                isinstance(anc, ast.With)
-                and any(_is_lock_expr(item.context_expr)
-                        for item in anc.items)
-                for anc in art.ancestors(node))
-            if not under_lock:
-                out.append(finding(
-                    self.id, self.severity,
-                    f"{art.path}:{node.lineno}",
-                    f"mutation of lock-guarded {attr!r} outside a "
-                    f"`with *_lock` block — races the scrape path's "
-                    f"copy-under-lock"))
-        return out
-
-
 @register
 class ChaosSiteRule(Rule):
     id = "chaos-site"
@@ -425,7 +358,11 @@ def _suppressed(f: Finding, lines: List[str]) -> bool:
     for line in candidates:
         m = _SUPPRESS_RE.search(line)
         if m:
-            rules = {r.strip() for r in m.group(1).split(",")}
+            # historical names resolve through RULE_ALIASES, so a
+            # `disable=telemetry-lock` written before the guarded-by
+            # generalization still silences its successor's findings
+            rules = {RULE_ALIASES.get(r.strip(), r.strip())
+                     for r in m.group(1).split(",")}
             if "all" in rules or f.rule in rules:
                 return True
     return False

@@ -136,6 +136,13 @@ class Rule:
 
 _REGISTRY: Dict[str, Rule] = {}
 
+#: historical rule names that generalized into a successor: resolved by
+#: :func:`get_rule` and honored by inline ``zoo-lint: disable=`` comments,
+#: so pre-migration suppressions and docs stay valid. ``telemetry-lock``
+#: (the hard-coded _families/_collectors check) became the inferred
+#: guarded-by rule in PR 11.
+RULE_ALIASES: Dict[str, str] = {"telemetry-lock": "lock-guarded-by"}
+
 
 def register(cls: Type[Rule]) -> Type[Rule]:
     """Class decorator: instantiate + register a rule by id."""
@@ -158,6 +165,7 @@ def all_rules(layer: Optional[str] = None) -> List[Rule]:
 def get_rule(rule_id: str) -> Rule:
     from . import rules as _rules  # noqa: F401
 
+    rule_id = RULE_ALIASES.get(rule_id, rule_id)
     try:
         return _REGISTRY[rule_id]
     except KeyError:

@@ -4,11 +4,15 @@ Graph layer (jaxpr/HLO): :mod:`collectives` (ZeRO-1 collective budgets),
 :mod:`fused_int8` (the PR-6 fused-dispatch structure), :mod:`decode` (the
 KV-cache decode step's shape-stability contract), :mod:`graph_hygiene`
 (host transfers, baked-in constants, dtype discipline, recompilation
-hazards). Host layer (AST): the rules live in :mod:`analysis.astlint`
-alongside their traversal machinery and are registered by this import too.
+hazards). Host layer (AST): tracer/wallclock/chaos-site rules live in
+:mod:`analysis.astlint` alongside their traversal machinery; the
+concurrency tier (guarded-by, lock-order cycles, hold hazards, leaf/unused/
+reach-in checks) lives in :mod:`concurrency` over the lock models of
+:mod:`analysis.concurrency`. All are registered by this import.
 """
 
-from . import collectives, decode, fused_int8, graph_hygiene  # noqa: F401
+from . import (collectives, concurrency, decode, fused_int8,  # noqa: F401
+               graph_hygiene)
 from .. import astlint  # noqa: F401  (registers the AST rules)
 
 from .collectives import collective_counts, jaxpr_collective_counts
@@ -16,7 +20,7 @@ from .decode import lint_decode_stability
 from .fused_int8 import fused_dispatch_report, fused_structure_counts
 
 __all__ = [
-    "collective_counts", "collectives", "decode", "fused_dispatch_report",
-    "fused_int8", "fused_structure_counts", "graph_hygiene",
-    "jaxpr_collective_counts", "lint_decode_stability",
+    "collective_counts", "collectives", "concurrency", "decode",
+    "fused_dispatch_report", "fused_int8", "fused_structure_counts",
+    "graph_hygiene", "jaxpr_collective_counts", "lint_decode_stability",
 ]

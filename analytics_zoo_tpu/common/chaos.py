@@ -35,9 +35,10 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import os
-import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+from .locks import traced_lock
 
 
 class WorkerKilled(BaseException):
@@ -82,7 +83,8 @@ class ChaosSchedule:
     def __init__(self, seed: int = 0):
         self.seed = seed
         self._rules: List[_Rule] = []
-        self._lock = threading.Lock()
+        # zoo-lock: leaf — fire() counts under it, actions run outside
+        self._lock = traced_lock("ChaosSchedule._lock")
         self._counts: Dict[Tuple[str, Any], int] = {}
 
     # -- authoring -----------------------------------------------------------
@@ -141,7 +143,8 @@ class ChaosSchedule:
     def __setstate__(self, state):
         self.seed = state["seed"]
         self._rules = state["_rules"]
-        self._lock = threading.Lock()
+        # zoo-lock: leaf — see __init__
+        self._lock = traced_lock("ChaosSchedule._lock")
         self._counts = {}
 
     # -- install -------------------------------------------------------------

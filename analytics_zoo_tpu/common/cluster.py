@@ -25,10 +25,11 @@ import os
 import signal
 import subprocess
 import sys
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
+
+from .locks import traced_lock
 
 
 @dataclass
@@ -55,7 +56,9 @@ class ProcessMonitor:
     def __init__(self):
         self.workers: List[WorkerProc] = []
         self._registered = False
-        self._lock = threading.Lock()
+        # zoo-lock: guards(workers) — kill_all snapshots under it and signals
+        # outside (holding it through the grace wait was a hold-hazard)
+        self._lock = traced_lock("ProcessMonitor._lock")
 
     def register(self, worker: WorkerProc):
         with self._lock:
@@ -74,22 +77,26 @@ class ProcessMonitor:
         return all(not w.alive() for w in self.workers)
 
     def kill_all(self, sig=signal.SIGTERM, grace_s: float = 3.0):
+        # snapshot under the lock; signalling and the grace wait run OUTSIDE
+        # it — holding it through the full grace window would stall any
+        # concurrent register() (and a re-entrant kill) for grace_s
         with self._lock:
-            for w in self.workers:
-                if w.alive():
-                    try:
-                        w.proc.send_signal(sig)
-                    except ProcessLookupError:
-                        pass
-            deadline = time.time() + grace_s
-            for w in self.workers:
-                while w.alive() and time.time() < deadline:
-                    time.sleep(0.05)
-                if w.alive():
-                    try:
-                        w.proc.kill()
-                    except ProcessLookupError:
-                        pass
+            workers = list(self.workers)
+        for w in workers:
+            if w.alive():
+                try:
+                    w.proc.send_signal(sig)
+                except ProcessLookupError:
+                    pass
+        deadline = time.time() + grace_s
+        for w in workers:
+            while w.alive() and time.time() < deadline:
+                time.sleep(0.05)
+            if w.alive():
+                try:
+                    w.proc.kill()
+                except ProcessLookupError:
+                    pass
 
     def wait(self, timeout_s: Optional[float] = None,
              on_failure: str = "kill") -> Dict[int, Optional[int]]:

@@ -28,10 +28,14 @@ from typing import Optional, Sequence
 import numpy as np
 
 from .config import MeshConfig, RuntimeConfig, apply_env_overrides
+from .locks import traced_lock
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
-_CONTEXT_LOCK = threading.Lock()
+# NOT a leaf: the runtime witness shows context init acquiring
+# module._POLICY_LOCK (nn precision policy) while holding this — a leaf
+# declaration here would fail the chaos-suite witness gate
+_CONTEXT_LOCK = traced_lock("context._CONTEXT_LOCK")
 _CURRENT: Optional["ZooContext"] = None
 
 

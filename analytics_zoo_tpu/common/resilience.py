@@ -37,6 +37,7 @@ import weakref
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 from . import telemetry as _tm
+from .locks import traced_lock
 
 # breaker/heartbeat state lands on the shared scrape: live instances register
 # into weak sets and scrape-time collectors walk them — no per-beat overhead
@@ -268,7 +269,12 @@ class CircuitBreaker:
         self.reset_timeout_s = reset_timeout_s
         self.half_open_max_calls = half_open_max_calls
         self._clock = clock or time.monotonic
-        self._lock = threading.Lock()
+        # the breaker lock is taken UNDER other locks (the router resolves
+        # probes while holding ReplicaRouter._lock) and acquires no lock of
+        # its own — the leaf declaration is what makes that nesting legal,
+        # and the static pass + runtime witness both enforce it
+        # zoo-lock: leaf
+        self._lock = traced_lock("CircuitBreaker._lock")
         self._outcomes: collections.deque = collections.deque(maxlen=window)
         self._state = self.CLOSED
         self._opened_at = 0.0
@@ -395,7 +401,8 @@ class HealthRegistry:
     """
 
     _seq = 0
-    _seq_lock = threading.Lock()
+    # zoo-lock: leaf
+    _seq_lock = traced_lock("HealthRegistry._seq_lock")
 
     def __init__(self, default_timeout_s: float = 5.0,
                  clock: Optional[Callable[[], float]] = None,
@@ -407,7 +414,10 @@ class HealthRegistry:
                 name = f"hr{HealthRegistry._seq}"
         self.name = name     # distinguishes registries on the shared scrape
         self._clock = clock or time.monotonic
-        self._lock = threading.Lock()
+        # zoo-lock: guards(_entries, _listeners, _last_dead) — transition
+        # listeners fire OUTSIDE it (check_transitions), so listing a
+        # callback here would be a hold-hazard, not a convenience
+        self._lock = traced_lock("HealthRegistry._lock")
         self._entries: Dict[str, Dict[str, Any]] = {}
         # liveness-transition listeners (fleet eviction/readmission hooks):
         # fired by check_transitions(), never under the lock

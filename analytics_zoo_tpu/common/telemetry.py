@@ -32,6 +32,15 @@ Two halves:
 
 Metric naming convention (docs/observability.md): ``zoo_<area>_<what>_<unit>``,
 counters end in ``_total``, durations are seconds-based histograms.
+
+Lock discipline: the registry/family/shard locks here stay plain
+``threading.Lock()`` rather than :func:`common.locks.traced_lock` — they are
+terminal by construction (nothing is acquired under them), they sit on the
+metric hot path, and the lock witness itself reports through this registry,
+so tracing them would recurse. The concurrency lint's guarded-by inference
+still covers them (``_families``/``_collectors``/``_children`` mutate under
+their locks; the old hard-coded ``telemetry-lock`` rule generalized into
+``lock-guarded-by``).
 """
 
 from __future__ import annotations

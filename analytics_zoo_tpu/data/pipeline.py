@@ -47,6 +47,8 @@ import time
 import weakref
 from typing import Any, Callable, Iterable, Iterator, Optional
 
+from ..common.locks import traced_lock
+
 from ..common import telemetry as _tm
 from ..common.chaos import chaos_point
 
@@ -258,7 +260,8 @@ class _OrderedThreadPool:
         self._name = name
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._threads: list = []
-        self._lock = threading.Lock()
+        # zoo-lock: guards(_threads)
+        self._lock = traced_lock("_OrderedThreadPool._lock")
 
     def ensure_workers(self, n: int) -> None:
         with self._lock:

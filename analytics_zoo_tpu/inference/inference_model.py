@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common import telemetry as _tm
+from ..common.locks import traced_lock
 from .summary import InferenceSummary, timing
 
 _COMPILES = _tm.counter("zoo_infer_compiles_total",
@@ -128,7 +129,7 @@ class InferenceModel:
         self.concurrent_num = supported_concurrent_num
         self.max_batch_size = max_batch_size
         self._sem = threading.Semaphore(supported_concurrent_num)
-        self._lock = threading.Lock()
+        self._lock = traced_lock("InferenceModel._lock")
         self._apply = None          # (params, state, x) -> y
         self._params = None
         self._state = None

@@ -10,11 +10,11 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import threading
 import time
 from typing import Dict, Optional
 
 from ..common import telemetry as _tm
+from ..common.locks import traced_lock
 
 logger = logging.getLogger("analytics_zoo_tpu.inference")
 
@@ -34,7 +34,8 @@ class _TimingStats:
 
 
 _STATS: Dict[str, _TimingStats] = {}
-_STATS_LOCK = threading.Lock()
+# zoo-lock: leaf
+_STATS_LOCK = traced_lock("summary._STATS_LOCK")
 
 
 @contextlib.contextmanager
@@ -76,7 +77,8 @@ class InferenceSummary:
     a TensorBoard event file (InferenceSummary.scala parity)."""
 
     def __init__(self, log_dir: Optional[str] = None, name: str = "inference"):
-        self._lock = threading.Lock()
+        # zoo-lock: guards(records, batches, total_latency_s)
+        self._lock = traced_lock("InferenceSummary._lock")
         self.records = 0
         self.batches = 0
         self.total_latency_s = 0.0

@@ -8,8 +8,9 @@ import os
 import subprocess
 import sysconfig
 import tempfile
-import threading
 from typing import Optional
+
+from ..common.locks import traced_lock
 
 import numpy as np
 
@@ -22,7 +23,8 @@ _SO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
 _SO = os.path.join(_SO_DIR, "zoo_native.so")
 
 _lib = None
-_lib_lock = threading.Lock()
+# zoo-lock: leaf
+_lib_lock = traced_lock("lib._lib_lock")
 _build_failed = False
 
 

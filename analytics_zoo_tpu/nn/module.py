@@ -20,19 +20,21 @@ Conventions
 from __future__ import annotations
 
 import contextlib
-import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common.locks import traced_lock
+
 Shape = Tuple[Optional[int], ...]
 PyTree = Any
 
 # ------------------------------------------------------------------ precision policy
 
-_POLICY_LOCK = threading.Lock()
+# zoo-lock: leaf
+_POLICY_LOCK = traced_lock("module._POLICY_LOCK")
 _POLICY = {"param_dtype": jnp.float32, "compute_dtype": jnp.float32}
 
 
@@ -146,7 +148,8 @@ def get_initializer(init: Union[str, Callable]) -> Callable:
 # -------------------------------------------------------------------------- layers
 
 _NAME_COUNTS: Dict[str, int] = {}
-_NAME_LOCK = threading.Lock()
+# zoo-lock: leaf
+_NAME_LOCK = traced_lock("module._NAME_LOCK")
 
 
 def _auto_name(cls_name: str) -> str:

@@ -33,12 +33,13 @@ jnp gather/scatter so it runs on any backend and stays one jaxpr.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..common.locks import traced_lock
 
 NEG_INF = -1e30
 
@@ -96,7 +97,10 @@ class PagePool:
 
     def __init__(self, cfg: KVCacheConfig):
         self.cfg = cfg
-        self._lock = threading.Lock()
+        # taken under ContinuousBatcher._lock by the decode loop's page-grow
+        # path and acquires nothing itself
+        # zoo-lock: leaf
+        self._lock = traced_lock("PagePool._lock")
         self._free: List[int] = list(range(cfg.total_pages - 1, 0, -1))
         self._capacity = len(self._free)
 

@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
+from ..common.locks import traced_lock
 from ..common.resilience import HealthRegistry
 
 
@@ -162,8 +163,7 @@ class ActorHandle:
         return lambda *a, **kw: self.call(name, *a, **kw)
 
     def terminate(self):
-        with self._pool._flock:
-            self._pool._actors.pop(self.actor_id, None)
+        self._pool._forget_actor(self.actor_id)
         self._pool._send(self.worker, "actor_del", self.actor_id)
 
 
@@ -208,7 +208,8 @@ class TaskPool:
         sched = get_chaos()
         self._chaos_blob = cloudpickle.dumps(sched) if sched else None
         self._futures: Dict[int, Dict[str, Any]] = {}   # tid -> pending rec
-        self._flock = threading.Lock()
+        # zoo-lock: guards(_futures, _actors)
+        self._flock = traced_lock("TaskPool._flock")
         self._tid = itertools.count()
         self._aid = itertools.count()
         self._rr = itertools.count()
@@ -377,6 +378,12 @@ class TaskPool:
         # 3) resubmit in-flight work (idempotent-task contract)
         for tid, rec in pending:
             inbox.put(rec["msg"])
+
+    def _forget_actor(self, actor_id: int) -> None:
+        """Drop an actor from the respawn roster (handle.terminate();
+        keeps the _flock acquisition inside its owning class)."""
+        with self._flock:
+            self._actors.pop(actor_id, None)
 
     def _send(self, worker: int, kind: str, *payload) -> Future:
         if self._closed:

@@ -46,6 +46,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common import telemetry as _tm
+from ..common.locks import traced_lock
 from .schema import (MODEL_VERSION_KEY, json_default, json_revive,
                      payload_trace)
 # wire-protocol primitives live in wire.py; re-exported here because the
@@ -93,7 +94,12 @@ class _Store:
     def __init__(self, maxlen: int = 65536, aof_path: Optional[str] = None,
                  reclaim_idle_ms: int = 60_000,
                  aof_rewrite_min_bytes: int = 64 << 20):
-        self.lock = threading.Lock()
+        # every store structure mutates under the condition below (over this
+        # lock); _log/fsync-under-lock is the durability contract (fsync
+        # before the client sees the ack)
+        # zoo-lock: guards(streams, cursors, hashes, pending)
+        # zoo-lock: guards(redeliver, deliveries, trimmed, _answered)
+        self.lock = traced_lock("_Store.lock")
         self.cond = threading.Condition(self.lock)
         self.maxlen = maxlen
         # size-triggered compaction floor: once the log grows past this, the
@@ -553,6 +559,14 @@ class _Store:
             self.hashes.pop(key, None)
             self._log("D", key)
 
+    def info_counts(self) -> Tuple[Dict[str, int], int, Dict[str, int]]:
+        """INFO's store slice, snapshotted under the store lock:
+        ``(per-stream live lengths, hash count, AOF replay counts)`` — the
+        handler must not reach into the store's guarded dicts directly."""
+        with self.cond:
+            return ({s: len(e) for s, e in self.streams.items()},
+                    len(self.hashes), dict(self.replayed))
+
     def slen(self, stream: str, group: Optional[str] = None) -> int:
         """Stream depth. With ``group``, counts the work OWED to that
         group's consumer: entries not yet delivered (past the group cursor,
@@ -716,10 +730,7 @@ class _Handler(socketserver.BaseRequestHandler):
         if cmd == "SHMOPEN":
             return _SHMOPEN
         if cmd == "INFO":
-            with store.lock:
-                streams = {s: len(e) for s, e in store.streams.items()}
-                n_hashes = len(store.hashes)
-                replayed = dict(store.replayed)
+            streams, n_hashes, replayed = store.info_counts()
             server = self.server  # type: ignore[attr-defined]
             return {"wire_version": WIRE_VERSION,
                     "streams": streams, "hashes": n_hashes,
@@ -750,7 +761,8 @@ class QueueBroker(socketserver.ThreadingTCPServer):
                             aof_rewrite_min_bytes=aof_rewrite_min_bytes)
         # per-instance observability counts for INFO (a process can host
         # several brokers; the registry counters aggregate across them)
-        self._counts_lock = threading.Lock()
+        # zoo-lock: guards(_commands, _shm_neg)
+        self._counts_lock = traced_lock("QueueBroker._counts_lock")
         self._commands: Dict[str, int] = {}
         self._shm_neg = {"ok": 0, "fallback": 0}
 

@@ -25,6 +25,7 @@ import numpy as np
 
 from ..common import telemetry as _tm
 from ..common.chaos import WorkerKilled, chaos_point
+from ..common.locks import traced_lock
 from ..common.resilience import (HealthRegistry, RetryAbortedError,
                                  RetryPolicy)
 from ..inference import InferenceModel, InferenceSummary
@@ -112,7 +113,8 @@ class ClusterServing:
         self._infer_q: "queue.Queue" = queue.Queue(maxsize=8)
         self._sink_q: "queue.Queue" = queue.Queue(maxsize=32)
         self._inflight = 0              # batches popped but not yet sunk
-        self._inflight_lock = threading.Lock()
+        # zoo-lock: guards(_inflight)
+        self._inflight_lock = traced_lock("ClusterServing._inflight_lock")
         self.served = 0
         self.errors = 0                 # records answered with an error —
                                         # the canary-validation signal

@@ -62,6 +62,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common import telemetry as _tm
 from ..common.chaos import chaos_point
+from ..common.locks import traced_lock
 from ..common.resilience import (CircuitBreaker, HealthRegistry,
                                  RetryAbortedError, RetryPolicy)
 from .client import INPUT_STREAM, _Conn
@@ -72,6 +73,12 @@ logger = logging.getLogger("analytics_zoo_tpu.serving.fleet")
 
 REPLICA_STREAM_PREFIX = "fleet:req:"
 ROUTER_GROUP = "fleet-router"
+
+# the router resolves half-open probes and trips/queries breakers while
+# holding its own lock; the breaker lock is a declared leaf (resilience.py),
+# so this nesting is the one legal order — the witness + static graph fail
+# on any inversion
+# zoo-lock: order(ReplicaRouter._lock < CircuitBreaker._lock)
 MEMBERS_KEY = "fleet:members"
 ROLLING_KEY = "fleet:ctl:__rolling__"
 
@@ -188,7 +195,8 @@ class ReplicaRouter:
             raise ValueError(f"unknown routing policy {self.policy!r}")
         self.registry = registry
         self.name = name
-        self._lock = threading.Lock()
+        # zoo-lock: guards(_slots, _rr_next, _pick_seq)
+        self._lock = traced_lock("ReplicaRouter._lock")
         self._slots: "collections.OrderedDict[str, _ReplicaSlot]" = \
             collections.OrderedDict()
         for rid in replica_ids:
@@ -216,6 +224,21 @@ class ReplicaRouter:
     def replica_ids(self) -> List[str]:
         with self._lock:
             return list(self._slots)
+
+    def slot(self, rid: str) -> Optional[_ReplicaSlot]:
+        """Live slot handle (or None), looked up under the router lock —
+        the accessor the rollout controller reads canary/cohort telemetry
+        through (reaching into ``_slots`` unlocked would race membership
+        churn from add/remove/failover)."""
+        with self._lock:
+            return self._slots.get(rid)
+
+    def model_versions(self) -> Dict[str, Optional[str]]:
+        """Per-replica active model version from the heartbeat-fed slots,
+        snapshotted under the router lock."""
+        with self._lock:
+            return {rid: s.model_version
+                    for rid, s in self._slots.items()}
 
     def evict(self, rid: str) -> None:
         """Force a replica out of the rotation NOW (death, operator action).
@@ -566,10 +589,12 @@ class FleetSupervisor:
         self.registry = registry or HealthRegistry(
             default_timeout_s=config.fleet_failover_timeout_s, name="fleet")
         self.registry.add_transition_listener(self._on_transition)
+        # single-writer state: _handles/_hb_seen are mutated only by the
+        # monitor thread + lifecycle calls; the shared telemetry the router
+        # needs lives on ITS slots (under ITS lock), so no supervisor lock
         self._handles: Dict[str, _ReplicaHandle] = {}
         self._hb_seen: Dict[str, bool] = {}      # first fresh hb observed?
         self._stop = threading.Event()
-        self._lock = threading.Lock()
         self._monitor: Optional[threading.Thread] = None
         self._conn: Optional[_Conn] = None
         self._rolling_seen: Any = None
@@ -899,9 +924,7 @@ class FleetSupervisor:
 
     def model_versions(self) -> Dict[str, Optional[str]]:
         """Per-replica active model version, from the heartbeat-fed slots."""
-        with self.router._lock:
-            return {rid: s.model_version
-                    for rid, s in self.router._slots.items()}
+        return self.router.model_versions()
 
     def stats(self) -> Dict[str, Any]:
         """Aggregated engine stats + router view (feeds /metrics.json)."""

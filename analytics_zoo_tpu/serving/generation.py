@@ -41,6 +41,7 @@ import numpy as np
 
 from ..common import telemetry as _tm
 from ..common.chaos import WorkerKilled, chaos_point
+from ..common.locks import traced_lock
 from ..common.resilience import HealthRegistry, RetryAbortedError, RetryPolicy
 from ..ops.kv_cache import OutOfPages, PagePool, SCRATCH_PAGE
 from .client import _Conn
@@ -232,7 +233,10 @@ class ContinuousBatcher:
             collections.deque(maxlen=1024)
         self._wake = threading.Event()
         self._stop = threading.Event()
-        self._lock = threading.Lock()     # slots/table vs stats readers
+        # slots/table vs stats readers; final-frame callbacks run OUTSIDE it
+        # (the PR-8 fix) — the hold-hazard rule keeps that true
+        # zoo-lock: guards(_slots, _table)
+        self._lock = traced_lock("ContinuousBatcher._lock")
         # accounting
         self.steps = 0
         self.tokens_generated = 0

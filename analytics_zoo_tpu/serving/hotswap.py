@@ -556,7 +556,9 @@ class RolloutController:
         return self._swap_nonce
 
     def _slot(self, rid: str):
-        return self.sup.router._slots.get(rid)
+        # locked router accessor: a bare _slots read would race membership
+        # churn (failover remove/respawn add) from the supervisor threads
+        return self.sup.router.slot(rid)
 
     def _generation(self, rid: str) -> int:
         h = self.sup._handles.get(rid)

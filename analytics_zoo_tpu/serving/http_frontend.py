@@ -49,6 +49,7 @@ from typing import Optional
 import numpy as np
 
 from ..common import telemetry as _tm
+from ..common.locks import traced_lock
 from ..common.resilience import (CircuitBreaker, CircuitOpenError,
                                  HealthRegistry, ResilienceError)
 from ..inference.summary import timing, timing_stats
@@ -344,7 +345,8 @@ class FrontEndApp:
         # 503 while already-admitted ones finish (wait_idle)
         self._draining = False
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        # zoo-lock: guards(_inflight)
+        self._inflight_lock = traced_lock("FrontEndApp._inflight_lock")
         self._model = model
         # queue-backed stacks pass the ClusterServing job's ``stats`` here so
         # /metrics carries the engine's compile-cache gauges too

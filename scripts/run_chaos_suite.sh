@@ -10,10 +10,29 @@
 #
 # CHAOS_SUITE_TIMEOUT (seconds, default 600) bounds the run even if a
 # resilience regression wedges a retry loop — the suite must never hang CI.
+#
+# Lock witness (ISSUE 11): the suite runs with ZOO_TPU_TRACE_LOCKS=1, so
+# every traced lock (common/locks.py) records its real acquisition-order
+# edges and hold times into $ZOO_TPU_LOCK_WITNESS (subprocess replicas
+# inherit the env and append their edges too). Afterwards the witnessed
+# edges are unioned with the STATIC lock-order graph and the run fails on
+# any cycle — a lock-order inversion that only materializes across objects
+# at runtime is caught here, not in production. Set ZOO_TPU_LOCK_MAX_HOLD_S
+# to additionally gate on the per-lock max observed hold time.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 TIMEOUT="${CHAOS_SUITE_TIMEOUT:-600}"
-exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+WITNESS="${ZOO_TPU_LOCK_WITNESS:-$(mktemp -t zoo_lock_witness.XXXXXX.jsonl)}"
+: > "$WITNESS"
+echo "[chaos-suite] lock witness: $WITNESS" >&2
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    ZOO_TPU_TRACE_LOCKS=1 ZOO_TPU_LOCK_WITNESS="$WITNESS" \
     python -m pytest tests -q -m "chaos or fleet or hotswap" \
     -p no:cacheprovider "$@"
+
+# gate: witnessed ∪ static lock-order graph must be cycle-free (and leaf
+# declarations must hold against the witnessed edges)
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m analytics_zoo_tpu.analysis --witness "$WITNESS"

@@ -1,17 +1,23 @@
 #!/usr/bin/env bash
-# Graph-lint runner (ISSUE 7).
+# Graph-lint runner (ISSUE 7; concurrency tier added in ISSUE 11).
 #
-#   scripts/run_lint.sh            # AST-lint the package; non-zero exit on
-#                                  # any unsuppressed error-severity finding
+#   scripts/run_lint.sh            # AST-lint the package (tracer/wallclock/
+#                                  # chaos-site rules + the concurrency tier:
+#                                  # guarded-by, lock-order cycles, hold
+#                                  # hazards, leaf/unused/reach-in); non-zero
+#                                  # exit on any unsuppressed error finding
 #   scripts/run_lint.sh --full     # also run the analysis pytest marker
 #                                  # (golden fixtures + clean-repo gate +
-#                                  # graph_checks hooks)
+#                                  # graph_checks hooks + TracedLock witness)
 #
 # The graph-layer rules need a traced computation, so they run where one
 # exists: TrainConfig.graph_checks at fit() start, InferenceModel/serving
 # warmup at model-load time, and the bench gates (--int8-dispatch /
 # --update-sharding). This script is the host-layer CI gate and is wired
-# into scripts/run_serving_bench.sh --quick.
+# into scripts/run_serving_bench.sh --quick. The dynamic half of the
+# concurrency tier (witnessed lock-order edges) is gated by
+# scripts/run_chaos_suite.sh via `python -m analytics_zoo_tpu.analysis
+# --witness`.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -188,11 +188,37 @@ def test_golden_wallclock_in_jit():
 
 
 def test_golden_telemetry_lock():
-    _ast_one(
-        "class R:\n"
-        "    def add(self, k, v):\n"
-        "        self._families[k] = v\n",
-        "telemetry-lock")
+    """The one-off telemetry-lock rule generalized into guarded-by
+    inference (ISSUE 11): the registry-shaped fixture now trips
+    ``lock-guarded-by``, and the OLD rule name still works as a
+    suppression/get_rule alias so pre-migration comments stay valid."""
+    src = ("import threading\n"
+           "class R:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._families = {}\n"
+           "    def add(self, k, v):\n"
+           "        with self._lock:\n"
+           "            self._families[k] = v\n"
+           "    def drop(self, k):\n"
+           "        with self._lock:\n"
+           "            self._families.pop(k, None)\n"
+           "    def sneak(self, k, v):\n"
+           "        self._families[k] = v\n")
+    f = _ast_one(src, "lock-guarded-by")
+    assert f.location.endswith(":13")
+    # the historical name resolves to the successor rule...
+    from analytics_zoo_tpu.analysis import get_rule
+
+    assert get_rule("telemetry-lock").id == "lock-guarded-by"
+    # ...and historical suppressions still silence it
+    suppressed_src = src.replace(
+        "    def sneak(self, k, v):\n        self._families[k] = v\n",
+        "    def sneak(self, k, v):\n"
+        "        # zoo-lint: disable=telemetry-lock — fixture\n"
+        "        self._families[k] = v\n")
+    findings, n_suppressed = lint_source(suppressed_src, "fixture.py")
+    assert findings == [] and n_suppressed == 1
 
 
 def test_golden_chaos_site():

@@ -1,0 +1,628 @@
+"""Concurrency lint tier tests (ISSUE 11).
+
+Golden fixtures per rule in both polarities (tripping exactly once / clean),
+the ``zoo-lock:`` annotation vocabulary (guards / leaf / order), held-method
+context propagation, the suppression + telemetry-lock alias semantics, the
+TracedLock runtime witness (edge recording, hold-time histogram, dump/load,
+the witnessed∪static cycle gate), the repo-wide clean + acyclic gates, and
+the CLI's ``--rules`` / ``--witness`` modes.
+
+The acceptance pair: a seeded ABBA deadlock fixture and a
+blocking-callback-under-lock fixture are each caught by BOTH the static pass
+and the witness-gate checker (`check_witness`, what
+``scripts/run_chaos_suite.sh`` drives through ``--witness``).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from analytics_zoo_tpu.analysis import (check_witness, find_cycles,
+                                        lint_source)
+from analytics_zoo_tpu.common import locks as zlk
+from analytics_zoo_tpu.common import telemetry as _tm
+
+pytestmark = pytest.mark.analysis
+
+PKG_ROOT = os.path.join(os.path.dirname(__file__), "..", "analytics_zoo_tpu")
+
+LOCK_RULES = ["lock-guarded-by", "lock-order-cycle", "lock-hold-hazard",
+              "lock-leaf-violation", "lock-unused", "lock-reachin"]
+
+
+def _lint(src, rules=LOCK_RULES):
+    findings, suppressed = lint_source(src, "fixture.py", rules=rules)
+    return findings, suppressed
+
+
+def _one(src, rule, rules=None):
+    findings, _ = _lint(src, rules=rules or [rule])
+    assert len(findings) == 1, [str(f) for f in findings]
+    assert findings[0].rule == rule, str(findings[0])
+    return findings[0]
+
+
+# ------------------------------------------------------------ guarded-by rule
+
+GUARDED = (
+    "import threading\n"
+    "class R:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._items = {}\n"
+    "    def put(self, k, v):\n"
+    "        with self._lock:\n"
+    "            self._items[k] = v\n"
+    "    def drop(self, k):\n"
+    "        with self._lock:\n"
+    "            self._items.pop(k, None)\n"
+    "    def sneak(self, k, v):\n"
+    "        self._items[k] = v\n")
+
+
+def test_golden_guarded_by_inferred():
+    f = _one(GUARDED, "lock-guarded-by")
+    assert f.location.endswith(":13")
+    assert dict(f.data)["lock"] == "R._lock"
+
+
+def test_guarded_by_clean_polarity():
+    clean = GUARDED.replace(
+        "    def sneak(self, k, v):\n        self._items[k] = v\n", "")
+    findings, _ = _lint(clean)
+    assert findings == []
+
+
+def test_guarded_by_declared_annotation():
+    """guards(...) makes the set authoritative even with zero locked
+    mutation sites — and __init__ stays exempt."""
+    src = ("import threading\n"
+           "class G:\n"
+           "    def __init__(self):\n"
+           "        # zoo-lock: guards(_data)\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._data = {}\n"
+           "    def sneak(self):\n"
+           "        self._data.clear()\n")
+    f = _one(src, "lock-guarded-by")
+    assert f.location.endswith(":8")
+
+
+def test_guarded_by_held_method_propagation():
+    """A helper whose every intra-class call site holds the lock inherits
+    the held context (the _retire_locked pattern) — no false positive."""
+    src = ("import threading\n"
+           "class P:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._xs = []\n"
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            self._helper()\n"
+           "    def b(self):\n"
+           "        with self._lock:\n"
+           "            self._helper()\n"
+           "    def _helper(self):\n"
+           "        self._xs.append(1)\n")
+    findings, _ = _lint(src)
+    assert findings == []
+
+
+def test_guarded_by_init_only_helper_exempt():
+    """A helper reachable only from __init__ (the broker _replay pattern)
+    inherits the constructor exemption."""
+    src = ("import threading\n"
+           "class Q:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._xs = []\n"
+           "        self._load()\n"
+           "    def _load(self):\n"
+           "        self._xs.append(0)\n"
+           "    def put(self, v):\n"
+           "        with self._lock:\n"
+           "            self._xs.append(v)\n")
+    findings, _ = _lint(src)
+    assert findings == []
+
+
+def test_suppression_and_telemetry_lock_alias():
+    for name in ("lock-guarded-by", "telemetry-lock"):
+        src = GUARDED.replace(
+            "    def sneak(self, k, v):\n        self._items[k] = v\n",
+            "    def sneak(self, k, v):\n"
+            f"        # zoo-lint: disable={name} — fixture\n"
+            "        self._items[k] = v\n")
+        findings, suppressed = _lint(src)
+        assert findings == [] and suppressed == 1, name
+
+
+# ------------------------------------------------------------ lock-order rule
+
+ABBA = (
+    "import threading\n"
+    "class S:\n"
+    "    def __init__(self):\n"
+    "        self._a_lock = threading.Lock()\n"
+    "        self._b_lock = threading.Lock()\n"
+    "    def x(self):\n"
+    "        with self._a_lock:\n"
+    "            with self._b_lock:\n"
+    "                return 1\n"
+    "    def y(self):\n"
+    "        with self._b_lock:\n"
+    "            with self._a_lock:\n"
+    "                return 2\n")
+
+
+def test_golden_abba_cycle_static():
+    f = _one(ABBA, "lock-order-cycle")
+    assert set(dict(f.data)["cycle"]) == {"S._a_lock", "S._b_lock"}
+
+
+def test_consistent_order_clean():
+    clean = ABBA.replace(
+        "    def y(self):\n"
+        "        with self._b_lock:\n"
+        "            with self._a_lock:\n",
+        "    def y(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n")
+    findings, _ = _lint(clean)
+    assert findings == []
+
+
+def test_declared_order_annotation_conflicts_with_code():
+    """# zoo-lock: order(a<b) is an edge in the graph: code nesting the
+    other way around completes a cycle."""
+    src = ("import threading\n"
+           "from analytics_zoo_tpu.common.locks import traced_lock\n"
+           "# zoo-lock: order(X.b < X.a)\n"
+           "class X:\n"
+           "    def __init__(self):\n"
+           "        self.a = traced_lock('X.a')\n"
+           "        self.b = traced_lock('X.b')\n"
+           "    def m(self):\n"
+           "        with self.a:\n"
+           "            with self.b:\n"
+           "                pass\n")
+    f = _one(src, "lock-order-cycle")
+    assert set(dict(f.data)["cycle"]) == {"X.a", "X.b"}
+
+
+def test_order_edge_through_held_method_call():
+    """x() holds A and calls _locked-style helper that takes B; y() nests
+    B then A directly — the call edge completes the inversion."""
+    src = ("import threading\n"
+           "class T:\n"
+           "    def __init__(self):\n"
+           "        self._a_lock = threading.Lock()\n"
+           "        self._b_lock = threading.Lock()\n"
+           "    def x(self):\n"
+           "        with self._a_lock:\n"
+           "            self._tail()\n"
+           "    def _tail(self):\n"
+           "        with self._b_lock:\n"
+           "            pass\n"
+           "    def y(self):\n"
+           "        with self._b_lock:\n"
+           "            with self._a_lock:\n"
+           "                pass\n")
+    _one(src, "lock-order-cycle")
+
+
+# ----------------------------------------------------------- hold-hazard rule
+
+def test_golden_hold_hazard_sleep():
+    src = ("import threading, time\n"
+           "class H:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def bad(self):\n"
+           "        with self._lock:\n"
+           "            time.sleep(0.1)\n")
+    f = _one(src, "lock-hold-hazard")
+    assert "time.sleep" in f.message and f.location.endswith(":7")
+
+
+def test_hold_hazard_clean_polarity():
+    src = ("import threading, time\n"
+           "class H:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def good(self):\n"
+           "        with self._lock:\n"
+           "            x = 1\n"
+           "        time.sleep(0.1)\n"
+           "        return x\n")
+    findings, _ = _lint(src)
+    assert findings == []
+
+
+CALLBACK_UNDER_LOCK = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self, on_chunk):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.on_chunk = on_chunk\n"
+    "    def emit(self, toks):\n"
+    "        with self._lock:\n"
+    "            self.on_chunk(toks, False, {})\n")
+
+
+def test_golden_hold_hazard_callback():
+    """The PR-8 bug class verbatim: a final-frame-style callback invoked
+    under the batcher lock."""
+    f = _one(CALLBACK_UNDER_LOCK, "lock-hold-hazard")
+    assert "callback" in f.message
+
+
+def test_hold_hazard_queue_timeout_and_event_wait():
+    src = ("import threading\n"
+           "class H:\n"
+           "    def __init__(self, q, ev):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._q = q\n"
+           "        self._ev = ev\n"
+           "    def bad_q(self):\n"
+           "        with self._lock:\n"
+           "            return self._q.get(timeout=1.0)\n"
+           "    def bad_ev(self):\n"
+           "        with self._lock:\n"
+           "            self._ev.wait(1.0)\n")
+    findings, _ = _lint(src, rules=["lock-hold-hazard"])
+    assert len(findings) == 2
+
+
+def test_condition_wait_on_held_lock_is_fine():
+    """cond.wait() inside `with cond:` is the CV pattern, not a hazard."""
+    src = ("import threading\n"
+           "class W:\n"
+           "    def __init__(self):\n"
+           "        self._cond = threading.Condition()\n"
+           "    def wait_for_it(self):\n"
+           "        with self._cond:\n"
+           "            self._cond.wait(timeout=1.0)\n")
+    findings, _ = _lint(src)
+    assert findings == []
+
+
+# ------------------------------------------------- leaf / unused / reach-in
+
+def test_golden_leaf_violation():
+    src = ("import threading\n"
+           "class L:\n"
+           "    def __init__(self):\n"
+           "        # zoo-lock: leaf\n"
+           "        self._leaf_lock = threading.Lock()\n"
+           "        self._other_lock = threading.Lock()\n"
+           "    def bad(self):\n"
+           "        with self._leaf_lock:\n"
+           "            with self._other_lock:\n"
+           "                pass\n")
+    f = _one(src, "lock-leaf-violation")
+    assert dict(f.data)["src"] == "L._leaf_lock"
+    clean = src.replace("        # zoo-lock: leaf\n", "")
+    findings, _ = _lint(clean, rules=["lock-leaf-violation"])
+    assert findings == []
+
+
+def test_golden_unused_lock():
+    src = ("import threading\n"
+           "class U:\n"
+           "    def __init__(self):\n"
+           "        self._dead_lock = threading.Lock()\n"
+           "        self._live_lock = threading.Lock()\n"
+           "    def ok(self):\n"
+           "        with self._live_lock:\n"
+           "            pass\n")
+    f = _one(src, "lock-unused")
+    assert dict(f.data)["lock"] == "U._dead_lock"
+
+
+def test_golden_reachin():
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self, other):\n"
+           "        self.other = other\n"
+           "    def poke(self):\n"
+           "        with self.other._lock:\n"
+           "            pass\n")
+    f = _one(src, "lock-reachin")
+    assert "other._lock" in f.message
+
+
+# --------------------------------------------------------- runtime witness
+
+@pytest.fixture()
+def traced(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_TRACE_LOCKS", "1")
+    zlk.reset_witness()
+    yield
+    zlk.reset_witness()
+
+
+def test_traced_lock_records_edges_and_holds(traced):
+    a = zlk.traced_lock("TW.a")
+    b = zlk.traced_lock("TW.b")
+    assert isinstance(a, zlk.TracedLock)
+    before = _tm.snapshot().get("zoo_lock_hold_seconds", {}) \
+        .get("samples", {}).get("TW.b", {}).get("count", 0)
+    with a:
+        with b:
+            time.sleep(0.01)
+    edges = zlk.witness_edges()
+    assert edges.get(("TW.a", "TW.b"), 0) >= 1
+    assert ("TW.b", "TW.a") not in edges
+    assert zlk.witness_max_holds()["TW.b"] >= 0.01
+    after = _tm.snapshot()["zoo_lock_hold_seconds"]["samples"]["TW.b"]["count"]
+    assert after == before + 1
+
+
+def test_traced_lock_disabled_is_plain():
+    os.environ.pop("ZOO_TPU_TRACE_LOCKS", None)
+    lock = zlk.traced_lock("plain")
+    assert not isinstance(lock, zlk.TracedLock)
+    with lock:
+        pass
+
+
+def test_traced_condition_wait_excludes_wait_from_hold(traced):
+    """Condition over a TracedLock: wait() releases the traced lock, so the
+    wait itself is never counted as hold time and notify works."""
+    lock = zlk.traced_lock("TW.cond_lock")
+    cond = threading.Condition(lock)
+    done = []
+
+    def waker():
+        with cond:
+            done.append(1)
+            cond.notify_all()
+
+    with cond:
+        t = threading.Thread(target=waker)
+        t.start()
+        cond.wait(timeout=2.0)
+    t.join(timeout=2.0)
+    assert done == [1]
+    assert zlk.witness_max_holds()["TW.cond_lock"] < 1.0
+
+
+def test_witness_abba_caught_by_gate(traced):
+    """The acceptance ABBA pair, runtime half: opposite nesting orders are
+    each fine alone, but the witnessed union is cyclic and the chaos-suite
+    gate's checker fails it."""
+    a = zlk.traced_lock("WG.a")
+    b = zlk.traced_lock("WG.b")
+    with a:
+        with b:
+            pass
+    assert check_witness([], zlk.witness_edges()) == []   # one order: fine
+    with b:
+        with a:
+            pass
+    findings = check_witness([], zlk.witness_edges())
+    assert [f.rule for f in findings] == ["lock-order-cycle"]
+
+
+def test_witness_union_with_static_edges(traced):
+    """A runtime edge that inverts a STATIC edge is a cycle only in the
+    union — exactly what the witnessed∪static gate exists for."""
+    a = zlk.traced_lock("WU.a")
+    b = zlk.traced_lock("WU.b")
+    with b:
+        with a:
+            pass
+    assert check_witness([], zlk.witness_edges()) == []
+    findings = check_witness([("WU.a", "WU.b")], zlk.witness_edges())
+    assert [f.rule for f in findings] == ["lock-order-cycle"]
+
+
+def test_witness_leaf_violation_and_hold_budget(traced):
+    """The blocking-callback acceptance fixture, runtime half: a callback
+    sleeping under a traced lock shows up in the hold watermark and trips
+    the gate's hold budget; a witnessed edge out of a declared leaf trips
+    the leaf check."""
+    leaf = zlk.traced_lock("WL.leaf")
+    other = zlk.traced_lock("WL.other")
+
+    def on_chunk():
+        time.sleep(0.05)
+
+    with leaf:
+        with other:
+            on_chunk()
+    findings = check_witness([], zlk.witness_edges(),
+                             leaf_locks=["WL.leaf"],
+                             max_holds=zlk.witness_max_holds(),
+                             max_hold_s=0.02)
+    rules = sorted(f.rule for f in findings)
+    assert "lock-leaf-violation" in rules
+    assert "lock-hold-witness" in rules
+
+
+def test_witness_cross_thread_release_no_stale_edges(traced):
+    """threading.Lock may legally be released by another thread (handoff
+    patterns): the acquirer's stack entry is pruned, so later acquisitions
+    don't record fabricated src edges from a lock it no longer holds."""
+    handoff = zlk.traced_lock("XT.handoff")
+    other = zlk.traced_lock("XT.other")
+    handoff.acquire()
+    t = threading.Thread(target=handoff.release)
+    t.start()
+    t.join(timeout=2.0)
+    with other:                       # acquirer thread, handoff released
+        pass
+    assert ("XT.handoff", "XT.other") not in zlk.witness_edges()
+    assert zlk.witness_max_holds().get("XT.handoff", 0.0) >= 0.0
+
+
+def test_witness_dump_load_roundtrip(traced, tmp_path):
+    a = zlk.traced_lock("WD.a")
+    b = zlk.traced_lock("WD.b")
+    with a:
+        with b:
+            pass
+    path = tmp_path / "witness.jsonl"
+    zlk.dump_witness(str(path))
+    zlk.dump_witness(str(path))          # two process dumps append
+    edges, holds = zlk.load_witness(str(path))
+    assert edges[("WD.a", "WD.b")] == 2
+    assert holds["WD.b"] >= 0.0
+
+
+# ------------------------------------------------------------- repo gates
+
+def test_repo_lock_graph_acyclic():
+    """Repo-wide static lock-order graph (incl. declared order edges) is
+    cycle-free and every leaf declaration holds."""
+    from analytics_zoo_tpu.analysis import collect_lock_graph
+
+    edges, leaves, declared = collect_lock_graph(PKG_ROOT)
+    pairs = [(e.src, e.dst) for e in edges]
+    pairs += [(a, b) for a, b, _line in declared]
+    assert find_cycles(pairs) == []
+    bad = [e for e in edges if e.src in leaves]
+    assert bad == [], [f"{e.src}->{e.dst} at line {e.line}" for e in bad]
+
+
+def test_repo_declares_fleet_breaker_order():
+    """The documented router<breaker nesting is declared AND exercised by
+    the code's own edges."""
+    from analytics_zoo_tpu.analysis import collect_lock_graph
+
+    edges, leaves, declared = collect_lock_graph(PKG_ROOT)
+    assert ("ReplicaRouter._lock", "CircuitBreaker._lock") in {
+        (a, b) for a, b, _line in declared}
+    assert "CircuitBreaker._lock" in leaves
+
+
+# --------------------------------------------------------------------- CLI
+
+def test_cli_rules_glob(tmp_path):
+    from analytics_zoo_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(ABBA)
+    assert main([str(bad), "--rules", "lock-order-*"]) == 1
+    # the glob excludes the tripping rule -> clean exit
+    assert main([str(bad), "--rules", "lock-hold-*"]) == 0
+    with pytest.raises(SystemExit):
+        main([str(bad), "--rules", "no-such-rule-*"])
+
+
+def test_cli_witness_mode(tmp_path, monkeypatch, capsys):
+    from analytics_zoo_tpu.analysis.__main__ import main
+
+    monkeypatch.setenv("ZOO_TPU_TRACE_LOCKS", "1")
+    zlk.reset_witness()
+    a = zlk.traced_lock("CLI.a")
+    b = zlk.traced_lock("CLI.b")
+    with a:
+        with b:
+            pass
+    src_dir = tmp_path / "pkg"
+    src_dir.mkdir()
+    (src_dir / "m.py").write_text("x = 1\n")
+    wfile = tmp_path / "w.jsonl"
+    zlk.dump_witness(str(wfile))
+    assert main(["--witness", str(wfile), str(src_dir)]) == 0
+    with b:                                   # invert: union now cyclic
+        with a:
+            pass
+    wfile2 = tmp_path / "w2.jsonl"
+    zlk.dump_witness(str(wfile2))
+    assert main(["--witness", str(wfile2), str(src_dir)]) == 1
+    zlk.reset_witness()
+
+
+def test_cli_witness_static_module(tmp_path):
+    """--witness unions the witnessed edges with the static graph of the
+    linted paths: a module whose code nests a->b plus a witness with b->a
+    fails even though each alone is clean."""
+    from analytics_zoo_tpu.analysis.__main__ import main
+
+    src_dir = tmp_path / "pkg"
+    src_dir.mkdir()
+    (src_dir / "m.py").write_text(
+        "import threading\n"
+        "from analytics_zoo_tpu.common.locks import traced_lock\n"
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self.a = traced_lock('M.a')\n"
+        "        self.b = traced_lock('M.b')\n"
+        "    def m(self):\n"
+        "        with self.a:\n"
+        "            with self.b:\n"
+        "                pass\n")
+    wfile = tmp_path / "w.jsonl"
+    wfile.write_text('{"src": "M.b", "dst": "M.a", "n": 3}\n')
+    assert main(["--witness", str(wfile), str(src_dir)]) == 1
+    wfile_ok = tmp_path / "ok.jsonl"
+    wfile_ok.write_text('{"src": "M.a", "dst": "M.b", "n": 3}\n')
+    assert main(["--witness", str(wfile_ok), str(src_dir)]) == 0
+
+
+# ----------------------------------------------- regression: fixed findings
+
+def test_kill_all_does_not_hold_lock_through_grace():
+    """cluster.ProcessMonitor.kill_all held its lock through the 3s kill
+    grace window (a hold-hazard the analyzer surfaced); it now snapshots and
+    signals outside, so a concurrent register() never stalls behind it."""
+    from analytics_zoo_tpu.common.cluster import ProcessMonitor, WorkerProc
+
+    class _SlowProc:
+        pid = 4242
+
+        def __init__(self):
+            self.signals = []
+
+        def poll(self):
+            return None if len(self.signals) < 2 else 0
+
+        def send_signal(self, sig):
+            self.signals.append(sig)
+
+        def kill(self):
+            self.signals.append("KILL")
+
+    mon = ProcessMonitor()
+    slow = _SlowProc()
+    mon.register(WorkerProc(rank=0, proc=slow, cmd=["x"]))
+    t = threading.Thread(target=mon.kill_all, kwargs={"grace_s": 1.0})
+    t.start()
+    time.sleep(0.05)                      # kill_all is inside its grace wait
+    t0 = time.perf_counter()
+    mon.register(WorkerProc(rank=1, proc=_SlowProc(), cmd=["y"]))
+    dt = time.perf_counter() - t0
+    t.join(timeout=5.0)
+    assert dt < 0.5, f"register() stalled {dt:.3f}s behind kill_all's grace"
+
+
+def test_router_model_versions_locked_accessor():
+    """FleetSupervisor/RolloutController no longer reach into the router's
+    private lock/slots: the router exposes locked accessors."""
+    from analytics_zoo_tpu.serving.fleet import ReplicaRouter
+
+    router = ReplicaRouter(replica_ids=("r0", "r1"))
+    assert router.model_versions() == {"r0": None, "r1": None}
+    slot = router.slot("r0")
+    assert slot is not None and slot.rid == "r0"
+    slot.model_version = "v7"
+    assert router.model_versions()["r0"] == "v7"
+    assert router.slot("nope") is None
+
+
+def test_serving_modules_have_no_concurrency_findings():
+    """Targeted regression for the audited serving files: zero unsuppressed
+    concurrency findings (the fleet unused-lock, broker INFO reach-in and
+    rollout slot reach-in stay fixed)."""
+    from analytics_zoo_tpu.analysis import lint_file
+
+    for mod in ("fleet.py", "generation.py", "hotswap.py", "broker.py",
+                "engine.py"):
+        path = os.path.join(PKG_ROOT, "serving", mod)
+        findings, _ = lint_file(path, rules=LOCK_RULES)
+        assert findings == [], (mod, [str(f) for f in findings])
