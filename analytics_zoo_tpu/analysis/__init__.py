@@ -21,6 +21,18 @@ Two layers over one rule engine (:mod:`analysis.core`):
   and a runtime witness (:mod:`analytics_zoo_tpu.common.locks.TracedLock`)
   whose recorded acquisition edges are unioned with the static graph by the
   chaos-suite gate (:func:`analysis.concurrency.check_witness`).
+* **Memory tier** (:mod:`analysis.memory` + :mod:`analysis.rules.memory`) —
+  a donation-aware jaxpr live-range analyzer (per-equation live-set bytes,
+  peak estimate, top-k temporaries; scan- and pallas-kernel-aware) plus HLO
+  buffer-table ingestion, feeding ``donation-missed`` (dead-but-undonated
+  dispatch args, repo-wide AST + trace-time halves), ``cache-alias`` (the
+  decode step's KV pool must donate input→output), ``hbm-budget`` (static
+  peak vs the per-device budget in TrainConfig/ServingConfig), and
+  ``peak-temporary``; the runtime allocation witness
+  (:mod:`analytics_zoo_tpu.common.memwitness`, ``ZOO_TPU_MEM_WITNESS``)
+  samples live device bytes at step/dispatch boundaries and
+  :func:`analysis.memory.check_memory_witness` cross-checks measured peaks
+  against the static estimates and budget.
 
 Wired three ways: the CLI (``python -m analytics_zoo_tpu.analysis``,
 ``scripts/run_lint.sh``) lints the package; ``TrainConfig.graph_checks``
@@ -40,11 +52,15 @@ from .graphlint import (SignatureTracker, lint_hlo, lint_jaxpr,
 from .astlint import lint_file, lint_package, lint_source
 from .concurrency import (build_module_model, check_witness,
                           collect_lock_graph, find_cycles)
+from .memory import (MemoryProfile, check_memory_witness, memory_fields,
+                     parse_xla_memory_analysis, profile_jaxpr)
 
 __all__ = [
-    "Finding", "GraphLintError", "Rule", "RuleContext", "RULE_ALIASES",
-    "SignatureTracker", "all_rules", "build_module_model", "check_witness",
-    "collect_lock_graph", "enforce", "find_cycles", "finding", "get_rule",
-    "lint_file", "lint_hlo", "lint_jaxpr", "lint_package", "lint_signatures",
-    "lint_source", "lint_traced", "register", "report", "walk_eqns",
+    "Finding", "GraphLintError", "MemoryProfile", "Rule", "RuleContext",
+    "RULE_ALIASES", "SignatureTracker", "all_rules", "build_module_model",
+    "check_memory_witness", "check_witness", "collect_lock_graph", "enforce",
+    "find_cycles", "finding", "get_rule", "lint_file", "lint_hlo",
+    "lint_jaxpr", "lint_package", "lint_signatures", "lint_source",
+    "lint_traced", "memory_fields", "parse_xla_memory_analysis",
+    "profile_jaxpr", "register", "report", "walk_eqns",
 ]

@@ -9,6 +9,9 @@
     python -m analytics_zoo_tpu.analysis --witness w.jsonl
                                                         # check a recorded
                                                         # lock-order trace
+    python -m analytics_zoo_tpu.analysis --mem-witness m.jsonl --budget-mb 64
+                                                        # check a recorded
+                                                        # allocation trace
 
 Exit status: 1 when any unsuppressed error-severity finding remains, else 0
 (``scripts/run_lint.sh`` gates CI on this). Graph-layer rules need a traced
@@ -22,6 +25,14 @@ witnessed acquisition edges with the static lock-order graph of the linted
 paths, and fails on any cycle or leaf-lock violation (plus over-budget holds
 when ``--max-hold-s``/``ZOO_TPU_LOCK_MAX_HOLD_S`` is set) — so CI and local
 debugging drive the same checker.
+
+``--mem-witness`` is the memory tier's analog: it loads the JSONL a
+``ZOO_TPU_MEM_WITNESS=<path>`` run dumped (live device-array bytes sampled
+at step/dispatch boundaries, plus the static peak estimates noted alongside
+them) and fails when a site's measured peak exceeds its declared HBM budget
+(``--budget-mb``/``ZOO_TPU_HBM_BUDGET_MB`` as the global fallback), warning
+when it diverges far above the static estimate — allocation the traced
+computation cannot see.
 """
 
 from __future__ import annotations
@@ -59,6 +70,31 @@ def _selected_rules(pattern):
         raise SystemExit(f"--rules {pattern!r} matches no AST rule; known: "
                          f"{[r.id for r in all_rules('ast')]}")
     return sel
+
+
+def _env_budget_mb():
+    raw = os.environ.get("ZOO_TPU_HBM_BUDGET_MB")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"[zoo-lint] ignoring malformed ZOO_TPU_HBM_BUDGET_MB="
+              f"{raw!r} (want a float)", file=sys.stderr)
+        return None
+
+
+def _check_mem_witness(witness_path, budget_mb):
+    from ..common.memwitness import load_witness
+    from .core import report
+    from .memory import check_memory_witness
+
+    samples, statics = load_witness(witness_path)
+    findings = report(check_memory_witness(
+        samples, statics,
+        budget_bytes=int(budget_mb * 2 ** 20) if budget_mb else None,
+        where=os.path.basename(witness_path)))
+    return findings, samples, statics
 
 
 def _check_witness(witness_path, paths, max_hold_s):
@@ -104,12 +140,25 @@ def main(argv=None) -> int:
                         help="with --witness: fail locks observed held "
                              "longer than this many seconds (default: env "
                              "ZOO_TPU_LOCK_MAX_HOLD_S, else off)")
+    parser.add_argument("--mem-witness", metavar="JSONL", default=None,
+                        help="check a recorded memory witness "
+                             "(ZOO_TPU_MEM_WITNESS dump) against the HBM "
+                             "budget and the static peak estimates noted "
+                             "in it")
+    parser.add_argument("--budget-mb", type=float, default=None,
+                        help="with --mem-witness: global per-device HBM "
+                             "budget in MiB for sites without a recorded "
+                             "budget (default: env ZOO_TPU_HBM_BUDGET_MB, "
+                             "else off)")
     args = parser.parse_args(argv)
     if args.max_hold_s is None:
         args.max_hold_s = _env_max_hold_s()
-    if args.witness is not None and args.rules is not None:
+    if args.budget_mb is None:
+        args.budget_mb = _env_budget_mb()
+    if (args.witness is not None or args.mem_witness is not None) \
+            and args.rules is not None:
         parser.error("--rules filters source lint rules and does not apply "
-                     "to --witness checks; pass one or the other")
+                     "to witness checks; pass one or the other")
 
     if args.list_rules:
         for rule in all_rules():
@@ -120,21 +169,33 @@ def main(argv=None) -> int:
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     paths = args.paths or [pkg_root]
 
-    if args.witness is not None:
-        findings, n_witnessed, n_static = _check_witness(
-            args.witness, paths, args.max_hold_s)
+    if args.witness is not None or args.mem_witness is not None:
+        findings, extra, detail = [], {}, []
+        if args.witness is not None:
+            fs, n_witnessed, n_static = _check_witness(
+                args.witness, paths, args.max_hold_s)
+            findings += fs
+            extra.update(witnessed_edges=n_witnessed, static_edges=n_static)
+            detail.append(f"{n_witnessed} witnessed edge(s) ∪ "
+                          f"{n_static} static edge(s)")
+        if args.mem_witness is not None:
+            fs, samples, statics = _check_mem_witness(
+                args.mem_witness, args.budget_mb)
+            findings += fs
+            extra.update(mem_sites=samples, mem_statics=statics)
+            detail.append(f"{len(samples)} memory site(s), "
+                          f"{len(statics)} static peak record(s)")
         errors = [f for f in findings if f.severity == "error"]
         if args.json:
             print(json.dumps({
                 "findings": [f.as_dict() for f in findings],
-                "witnessed_edges": n_witnessed, "static_edges": n_static,
-                "errors": len(errors)}, indent=1))
+                "errors": len(errors), **extra}, indent=1))
         else:
             for f in findings:
                 print(f)
-            print(f"[zoo-lint] witness: {n_witnessed} witnessed edge(s) ∪ "
-                  f"{n_static} static edge(s); {len(findings)} finding(s) "
-                  f"({len(errors)} error(s))", file=sys.stderr)
+            print(f"[zoo-lint] witness: {'; '.join(detail)}; "
+                  f"{len(findings)} finding(s) ({len(errors)} error(s))",
+                  file=sys.stderr)
         return 1 if errors else 0
 
     rules = _selected_rules(args.rules)

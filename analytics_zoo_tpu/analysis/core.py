@@ -111,6 +111,20 @@ class RuleContext:
     # unchanged among the outputs (cache threaded, no per-step growth) and
     # bounds intermediate sizes by the largest cache leaf
     decode_cache_avals: Optional[Sequence[Tuple[Tuple[int, ...], str]]] = None
+    # memory tier (analysis/memory.py + rules/memory.py):
+    # hbm-budget: declared per-device HBM budget; the static live-range peak
+    # (and, via the witness, the measured peak) must stay under it
+    hbm_budget_bytes: Optional[int] = None
+    # donation truth for the dispatch being linted: one flag per FLATTENED
+    # positional arg leaf (jax.jit donate_argnums order) — drives the
+    # analyzer's in-place-aliasing credit, cache-alias, and donation-missed
+    donated_invars: Optional[Sequence[bool]] = None
+    # donation-missed: which flattened arg leaves are DEAD after the call
+    # (the caller rebinds/discards them) and therefore donation-eligible
+    dead_invars: Optional[Sequence[bool]] = None
+    # peak-temporary: byte bound a single HBM temporary may not exceed
+    # (None = the largest argument leaf, i.e. "the largest model leaf")
+    param_leaf_bytes: Optional[int] = None
 
 
 class Rule:

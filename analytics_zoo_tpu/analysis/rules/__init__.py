@@ -4,23 +4,28 @@ Graph layer (jaxpr/HLO): :mod:`collectives` (ZeRO-1 collective budgets),
 :mod:`fused_int8` (the PR-6 fused-dispatch structure), :mod:`decode` (the
 KV-cache decode step's shape-stability contract), :mod:`graph_hygiene`
 (host transfers, baked-in constants, dtype discipline, recompilation
-hazards). Host layer (AST): tracer/wallclock/chaos-site rules live in
-:mod:`analysis.astlint` alongside their traversal machinery; the
-concurrency tier (guarded-by, lock-order cycles, hold hazards, leaf/unused/
-reach-in checks) lives in :mod:`concurrency` over the lock models of
-:mod:`analysis.concurrency`. All are registered by this import.
+hazards), :mod:`memory` (HBM budgets, outsized temporaries, cache aliasing
+over the live-range analyzer of :mod:`analysis.memory`). Host layer (AST):
+tracer/wallclock/chaos-site rules live in :mod:`analysis.astlint` alongside
+their traversal machinery; the concurrency tier (guarded-by, lock-order
+cycles, hold hazards, leaf/unused/reach-in checks) lives in
+:mod:`concurrency` over the lock models of :mod:`analysis.concurrency`; the
+memory tier's repo-wide ``donation-missed`` rebind check lives in
+:mod:`memory` too. All are registered by this import.
 """
 
 from . import (collectives, concurrency, decode, fused_int8,  # noqa: F401
-               graph_hygiene)
+               graph_hygiene, memory)
 from .. import astlint  # noqa: F401  (registers the AST rules)
 
 from .collectives import collective_counts, jaxpr_collective_counts
 from .decode import lint_decode_stability
 from .fused_int8 import fused_dispatch_report, fused_structure_counts
+from .memory import flatten_donation, lint_donation, lint_memory
 
 __all__ = [
     "collective_counts", "collectives", "concurrency", "decode",
-    "fused_dispatch_report", "fused_int8", "fused_structure_counts",
-    "graph_hygiene", "jaxpr_collective_counts", "lint_decode_stability",
+    "flatten_donation", "fused_dispatch_report", "fused_int8",
+    "fused_structure_counts", "graph_hygiene", "jaxpr_collective_counts",
+    "lint_decode_stability", "lint_donation", "lint_memory", "memory",
 ]

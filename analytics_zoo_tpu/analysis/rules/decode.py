@@ -125,11 +125,22 @@ def _aval_nbytes(aval) -> Optional[int]:
 def lint_decode_stability(model, params, cache_cfg, cache, *,
                           top_k: int = 0,
                           where: str = "serving.generation",
-                          ctx: Optional[RuleContext] = None) -> List[Finding]:
+                          ctx: Optional[RuleContext] = None,
+                          donate_cache: Optional[bool] = None,
+                          hbm_budget_bytes: Optional[int] = None,
+                          note_static_site: Optional[str] = None
+                          ) -> List[Finding]:
     """Trace ``model.decode_step`` at the cache's fixed shapes (abstract —
     no compile, no execution) and run the stability rule. This is the
     warmup entry point (``ContinuousBatcher.check_decode_stability``) and
-    the bench's decode-lint gate."""
+    the bench's decode-lint gate.
+
+    ``donate_cache`` states whether the dispatch donates the cache argument;
+    when given, the memory tier runs too — ``cache-alias`` (un-donated pool
+    ⇒ XLA copies it every step) and ``hbm-budget`` when
+    ``hbm_budget_bytes`` is declared. ``note_static_site`` additionally
+    records the donation-aware static peak into the runtime memory witness
+    (:mod:`analytics_zoo_tpu.common.memwitness`) under that site name."""
     import jax
     import jax.numpy as jnp
 
@@ -151,8 +162,31 @@ def lint_decode_stability(model, params, cache_cfg, cache, *,
     cache_avals = [(tuple(leaf.shape), str(leaf.dtype))
                    for leaf in jtu.tree_leaves(cache)]
     ctx = ctx or RuleContext(where=where)
-    ctx = RuleContext(**{**ctx.__dict__, "decode_cache_avals": cache_avals})
-    return lint_jaxpr(closed, ctx=ctx, rules=["decode-shape-stability"])
+    updates: dict = {"decode_cache_avals": cache_avals}
+    rules = ["decode-shape-stability"]
+    if donate_cache is not None:
+        n_params = len(jtu.tree_leaves(params))
+        n_cache = len(jtu.tree_leaves(cache))
+        # flattened positional signature: params, cache, then 6 scalar rows
+        updates["donated_invars"] = ([False] * n_params
+                                     + [donate_cache] * n_cache
+                                     + [False] * 6)
+        updates["hbm_budget_bytes"] = hbm_budget_bytes
+        rules += ["cache-alias"] + (["hbm-budget"] if hbm_budget_bytes
+                                    else [])
+    ctx = RuleContext(**{**ctx.__dict__, **updates})
+    findings = lint_jaxpr(closed, ctx=ctx, rules=rules)
+    if note_static_site:
+        from ...common import memwitness as _mw
+
+        if _mw.enabled():
+            from ..memory import profile_jaxpr
+
+            prof = profile_jaxpr(closed,
+                                 donated_invars=ctx.donated_invars)
+            _mw.note_static(note_static_site, prof.peak_live_bytes,
+                            hbm_budget_bytes)
+    return findings
 
 
 __all__ = ["DecodeShapeStabilityRule", "lint_decode_stability"]

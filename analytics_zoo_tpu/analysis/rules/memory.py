@@ -1,0 +1,434 @@
+"""Memory rules: donation, cache aliasing, HBM budgets, outsized temporaries.
+
+The memory tier's four invariants, over the live-range analyzer of
+:mod:`analysis.memory`:
+
+* ``donation-missed`` — a jitted callee's argument is dead after the call
+  (the caller rebinds the same expression to the output) and shape/dtype-
+  matches an output, but is not in ``donate_argnums``: XLA must materialize
+  the output next to the still-live input, doubling that buffer's footprint
+  per dispatch. Two halves share the id: the **AST rule** (repo-wide,
+  ``run_lint.sh``) finds the ``x, ... = jitted(x, ...)`` rebind pattern
+  statically; the **jaxpr helper** :func:`lint_donation` checks the traced
+  step at fit start with exact leaf shapes (``TrainConfig.graph_checks``).
+* ``cache-alias`` — the decode step's KV-cache leaves must be donated into
+  the dispatch so input→output alias in place: an un-donated page pool means
+  XLA copies the whole pool every decode step (a second pool-sized buffer in
+  the decode executable — precisely the footprint the paged design exists to
+  avoid).
+* ``hbm-budget`` — the static live-range peak must stay under the per-device
+  budget declared in ``TrainConfig``/``ServingConfig`` (enforced at fit
+  start and model warmup exactly like ``collective-budget``). The runtime
+  witness re-checks the same id against *measured* bytes
+  (:func:`analysis.memory.check_memory_witness`).
+* ``peak-temporary`` — a single HBM temporary larger than the largest model
+  leaf (warning): the usual shapes are an accidentally-unsharded gather, a
+  full-precision upcast of a bf16 tree, or an O(T²) attention score buffer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import (Finding, Rule, RuleContext, finding, register, report)
+from ..memory import aval_nbytes, profile_jaxpr
+
+__all__ = [
+    "CacheAliasRule", "DonationMissedRule", "HbmBudgetRule",
+    "PeakTemporaryRule", "flatten_donation", "lint_donation", "lint_memory",
+]
+
+
+def flatten_donation(n_leaves_per_arg: Sequence[int],
+                     donate_argnums: Sequence[int]) -> List[bool]:
+    """Per-flattened-leaf donation flags for a positional signature:
+    ``n_leaves_per_arg`` is each positional arg's leaf count (pytree order),
+    ``donate_argnums`` the jit's donated positions."""
+    donated = set(donate_argnums)
+    out: List[bool] = []
+    for i, n in enumerate(n_leaves_per_arg):
+        out.extend([i in donated] * n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# jaxpr layer
+# ---------------------------------------------------------------------------
+
+@register
+class HbmBudgetRule(Rule):
+    """Active when ``ctx.hbm_budget_bytes`` declares a per-device budget."""
+
+    id = "hbm-budget"
+    layer = "jaxpr"
+    severity = "error"
+    doc = ("Static live-range peak of the traced computation must stay "
+           "under the per-device HBM budget declared in TrainConfig/"
+           "ServingConfig; the memory witness re-checks the same budget "
+           "against measured bytes")
+
+    def check(self, closed_jaxpr, ctx: RuleContext) -> Iterable[Finding]:
+        if not ctx.hbm_budget_bytes:
+            return []
+        prof = profile_jaxpr(closed_jaxpr, donated_invars=ctx.donated_invars)
+        if prof.peak_live_bytes <= ctx.hbm_budget_bytes:
+            return []
+        top = [f"{t.primitive}:{t.dtype}{tuple(t.shape)}={t.nbytes}B"
+               for t in prof.temporaries[:3]]
+        return [self.emit(
+            ctx, f"static peak-live estimate {prof.peak_live_bytes} bytes "
+                 f"exceeds the declared per-device HBM budget "
+                 f"{ctx.hbm_budget_bytes} bytes (resident "
+                 f"{prof.resident_bytes}B, top temporaries: "
+                 f"{', '.join(top) or 'none'})",
+            peak_live_bytes=prof.peak_live_bytes,
+            budget_bytes=int(ctx.hbm_budget_bytes),
+            resident_bytes=prof.resident_bytes,
+            top_temporaries=tuple(top))]
+
+
+#: peak-temporary ignores temporaries under this size regardless of the
+#: leaf bound — a kilobyte-scale buffer "larger than" a toy model's largest
+#: leaf is never an actionable finding (same spirit as large-constant's
+#: 1 MiB const_bytes_limit)
+PEAK_TEMP_FLOOR_BYTES = 1 << 20
+
+
+@register
+class PeakTemporaryRule(Rule):
+    """A single temporary larger than the largest model leaf (warning)."""
+
+    id = "peak-temporary"
+    layer = "jaxpr"
+    severity = "warning"
+    doc = ("A single HBM temporary (>= 1 MiB) larger than the largest model "
+           "leaf — an unsharded gather, an f32 upcast of a bf16 tree, or an "
+           "O(T^2) score buffer hiding in the step")
+
+    def check(self, closed_jaxpr, ctx: RuleContext) -> Iterable[Finding]:
+        prof = profile_jaxpr(closed_jaxpr, donated_invars=ctx.donated_invars)
+        limit = ctx.param_leaf_bytes or prof.largest_arg_leaf_bytes
+        if not limit:
+            return []
+        limit = max(limit, PEAK_TEMP_FLOOR_BYTES)
+        out: List[Finding] = []
+        for t in prof.temporaries:
+            if t.nbytes <= limit:
+                break               # sorted descending
+            out.append(self.emit(
+                ctx, f"{t.primitive} materializes a "
+                     f"{t.dtype}{tuple(t.shape)} temporary ({t.nbytes} "
+                     f"bytes) larger than the largest model leaf ({limit} "
+                     f"bytes){' inside a scan/while body' if t.in_loop else ''}",
+                primitive=t.primitive, nbytes=t.nbytes,
+                limit_bytes=int(limit), in_loop=t.in_loop))
+            if len(out) >= 3:       # cap: one graph, a handful of findings
+                break
+        return out
+
+
+@register
+class CacheAliasRule(Rule):
+    """Active when ``ctx.decode_cache_avals`` AND ``ctx.donated_invars``
+    describe a decode dispatch."""
+
+    id = "cache-alias"
+    layer = "jaxpr"
+    severity = "error"
+    doc = ("Decode-step KV-cache leaves must be donated so input and output "
+           "alias in place — an un-donated page pool makes XLA copy the "
+           "whole KV pool every decode step")
+
+    def check(self, closed_jaxpr, ctx: RuleContext) -> Iterable[Finding]:
+        if not ctx.decode_cache_avals or ctx.donated_invars is None:
+            return []
+        jaxpr = closed_jaxpr.jaxpr
+        donated = list(ctx.donated_invars)
+        donated += [False] * (len(jaxpr.invars) - len(donated))
+        by_key: Dict[Tuple, List[int]] = {}
+        for i, v in enumerate(jaxpr.invars):
+            aval = getattr(v, "aval", None)
+            key = (tuple(getattr(aval, "shape", ())),
+                   str(getattr(aval, "dtype", "")))
+            by_key.setdefault(key, []).append(i)
+        out: List[Finding] = []
+        # leaves sharing a (shape, dtype) — the usual k/v pool pair — are
+        # one missing donation, not one finding per leaf
+        leaf_counts: Dict[Tuple, int] = {}
+        for shape, dtype in ctx.decode_cache_avals:
+            key = (tuple(shape), dtype)
+            leaf_counts[key] = leaf_counts.get(key, 0) + 1
+        for (shape, dtype), n_leaves in leaf_counts.items():
+            positions = by_key.get((shape, dtype), [])
+            if not positions:
+                continue    # threading problems are decode-shape-stability's
+            if any(donated[i] for i in positions):
+                continue
+            nbytes = aval_nbytes(jaxpr.invars[positions[0]].aval) or 0
+            leaves = (f"{n_leaves} cache leaves" if n_leaves > 1
+                      else "cache leaf")
+            out.append(self.emit(
+                ctx, f"KV {leaves} {dtype}{shape} ({nbytes} bytes each) "
+                     f"not donated to the decode dispatch — XLA allocates "
+                     f"a second pool-sized buffer and copies the whole pool "
+                     f"every decode step (pass donate_argnums for the cache "
+                     f"argument)",
+                shape=shape, dtype=dtype, nbytes=nbytes, leaves=n_leaves))
+        return out
+
+
+def lint_donation(closed_jaxpr, ctx: RuleContext) -> List[Finding]:
+    """Trace-time half of ``donation-missed``: flag dead-but-undonated arg
+    leaves that shape/dtype-match an output. Active when ``ctx.dead_invars``
+    says which flattened arg leaves the caller rebinds/discards.
+
+    Not a registered :class:`Rule` — the registered ``donation-missed`` is
+    the repo-wide AST rule below; this emits findings under the same id so
+    suppression and documentation cover both halves (the lock-witness
+    precedent). Callers should pass the result through
+    :func:`analysis.core.report` (``lint_memory`` does)."""
+    if not ctx.dead_invars:
+        return []
+    jaxpr = closed_jaxpr.jaxpr
+    dead = list(ctx.dead_invars)
+    dead += [False] * (len(jaxpr.invars) - len(dead))
+    donated = list(ctx.donated_invars or ())
+    donated += [False] * (len(jaxpr.invars) - len(donated))
+
+    # multiset of output avals, minus the claims of already-donated leaves
+    out_counts: Dict[Tuple, int] = {}
+    for v in jaxpr.outvars:
+        aval = getattr(v, "aval", None)
+        key = (tuple(getattr(aval, "shape", ())),
+               str(getattr(aval, "dtype", "")))
+        out_counts[key] = out_counts.get(key, 0) + 1
+    for i, v in enumerate(jaxpr.invars):
+        if donated[i]:
+            key = (tuple(getattr(v.aval, "shape", ())), str(v.aval.dtype))
+            if out_counts.get(key, 0) > 0:
+                out_counts[key] -= 1
+
+    missed_bytes = 0
+    missed = 0
+    example = None
+    for i, v in enumerate(jaxpr.invars):
+        if not dead[i] or donated[i]:
+            continue
+        aval = getattr(v, "aval", None)
+        key = (tuple(getattr(aval, "shape", ())), str(aval.dtype))
+        if out_counts.get(key, 0) > 0:
+            out_counts[key] -= 1
+            b = aval_nbytes(aval) or 0
+            missed_bytes += b
+            missed += 1
+            if example is None or b > example[1]:
+                example = (key, b)
+    if not missed:
+        return []
+    (shape, dtype), ex_bytes = example
+    return [finding(
+        "donation-missed", "error", f"jaxpr:{ctx.where or '<anon>'}",
+        f"{missed} argument leaf(s) totalling {missed_bytes} bytes are dead "
+        f"after the call and shape/dtype-match an output but are not in "
+        f"donate_argnums (largest: {dtype}{tuple(shape)}, {ex_bytes} bytes) "
+        f"— each one is allocated twice per dispatch",
+        leaves=missed, missed_bytes=missed_bytes,
+        largest_shape=tuple(shape), largest_dtype=dtype)]
+
+
+def lint_memory(closed_jaxpr, ctx: Optional[RuleContext] = None,
+                rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the memory tier over one traced computation: the registered jaxpr
+    rules (``hbm-budget`` / ``peak-temporary`` / ``cache-alias``, each
+    self-gating on its ctx knobs) plus the trace-time ``donation-missed``
+    check. Findings are counted into telemetry."""
+    from ..graphlint import lint_jaxpr
+
+    ctx = ctx or RuleContext()
+    findings = lint_jaxpr(
+        closed_jaxpr, ctx=ctx,
+        rules=list(rules) if rules is not None
+        else ["hbm-budget", "peak-temporary", "cache-alias"])
+    findings += report(lint_donation(closed_jaxpr, ctx))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# AST layer: the repo-wide rebind-without-donation pattern
+# ---------------------------------------------------------------------------
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    """Stable key for ``x`` / ``self.attr`` expressions (None otherwise)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _contains_jit(node: ast.AST) -> Optional[ast.Call]:
+    """The ``jit``/``pjit`` Call inside ``node``'s subtree, if any."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name in ("jit", "pjit"):
+                return sub
+    return None
+
+
+#: sentinel for "donation present but not statically resolvable" — stay
+#: silent rather than second-guess a variable donate_argnums
+_UNKNOWN = object()
+
+
+def _donated_set(jit_call: ast.Call):
+    """Statically-known donated positions of a jit call: a frozenset of
+    ints, or ``_UNKNOWN`` when donate_argnums/donate_argnames is present but
+    not a literal."""
+    for kw in jit_call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return frozenset((v.value,))
+            if isinstance(v, (ast.Tuple, ast.List)):
+                vals = []
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, int):
+                        vals.append(elt.value)
+                    else:
+                        return _UNKNOWN
+                return frozenset(vals)
+            return _UNKNOWN
+    return frozenset()
+
+
+@register
+class DonationMissedRule(Rule):
+    """Repo-wide AST half: ``x, ... = jitted(x, ...)`` without donation.
+
+    Pass 1 finds jit-bearing bindings — assignments whose value contains a
+    ``jit(...)`` call (``self._decode = jax.jit(...)``), methods whose
+    return value contains one (factory methods), and one-hop propagation
+    through plain assignments/subscript loads (the compiled-executable-cache
+    pattern). Pass 2 flags call statements where a positional argument
+    expression is also an assignment target of the same statement (the
+    rebind makes the old buffer dead and guarantees a congruent output) and
+    that position is not statically donated. ``jax.device_put`` rebinds
+    without ``donate=`` are the transfer-shaped member of the same class."""
+
+    id = "donation-missed"
+    layer = "ast"
+    severity = "error"
+    doc = ("A jitted callee's argument is rebound to its own output "
+           "(dead after the call, congruent with an output) but is not in "
+           "donate_argnums — the buffer is allocated twice per dispatch; "
+           "device_put rebinds without donate= are the transfer analog")
+
+    def check(self, art, ctx: RuleContext) -> Iterable[Finding]:
+        tree = art.tree
+        # ---- pass 1: jit-bearing symbols -> statically-known donated set
+        jitted: Dict[str, Any] = {}
+
+        def note_binding(target: ast.AST, donated) -> None:
+            key = _expr_key(target)
+            if key is None and isinstance(target, ast.Subscript):
+                key = _expr_key(target.value)
+            if key is not None:
+                # self.x and x normalize to the attr/name the call site uses
+                jitted[key] = donated
+
+        factory_donated: Dict[str, Any] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                jc = _contains_jit(node.value)
+                if jc is not None:
+                    for t in node.targets:
+                        note_binding(t, _donated_set(jc))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Return) and sub.value is not None:
+                        jc = _contains_jit(sub.value)
+                        if jc is not None:
+                            factory_donated[node.name] = _donated_set(jc)
+        # one-hop propagation: y = self._cache[k] / y = self._fn /
+        # self._fn = self._make_fn()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            src_key = None
+            donated = None
+            if isinstance(v, ast.Call):
+                callee = _expr_key(v.func)
+                if callee is not None:
+                    base = callee.split(".")[-1]
+                    if base in factory_donated:
+                        donated = factory_donated[base]
+            elif isinstance(v, ast.Subscript):
+                src_key = _expr_key(v.value)
+            elif isinstance(v, (ast.Name, ast.Attribute)):
+                src_key = _expr_key(v)
+            if src_key is not None and src_key in jitted:
+                donated = jitted[src_key]
+            if donated is not None:
+                for t in node.targets:
+                    note_binding(t, donated)
+
+        # ---- pass 2: rebind-through-dispatch call statements
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            callee = _expr_key(call.func)
+            target_keys: Set[str] = set()
+            for t in node.targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                for e in elts:
+                    k = _expr_key(e)
+                    if k is not None:
+                        target_keys.add(k)
+            if not target_keys:
+                continue
+
+            # device_put rebind: x = jax.device_put(x) without donate=
+            fn = call.func
+            fn_name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if fn_name == "device_put":
+                if any(kw.arg == "donate" for kw in call.keywords):
+                    continue
+                for pos, arg in enumerate(call.args):
+                    k = _expr_key(arg)
+                    if k is not None and k in target_keys and pos == 0:
+                        out.append(finding(
+                            self.id, self.severity,
+                            f"{art.path}:{node.lineno}",
+                            f"{k} is rebound through jax.device_put without "
+                            f"donate=True — the source buffer is dead after "
+                            f"the transfer but both copies coexist"))
+                continue
+
+            if callee is None or callee not in jitted:
+                continue
+            donated = jitted[callee]
+            if donated is _UNKNOWN:
+                continue            # donation present, not resolvable: silent
+            for pos, arg in enumerate(call.args):
+                k = _expr_key(arg)
+                if k is None or k not in target_keys or pos in donated:
+                    continue
+                out.append(finding(
+                    self.id, self.severity, f"{art.path}:{node.lineno}",
+                    f"argument {pos} ({k}) of jitted {callee} is rebound to "
+                    f"the call's output — the input buffer is dead after "
+                    f"the dispatch and congruent with an output, but is not "
+                    f"in donate_argnums: it is allocated twice per call "
+                    f"(add donate_argnums=({pos},) or suppress with a "
+                    f"justification)"))
+        return out

@@ -172,10 +172,25 @@ class TrainConfig:
                                             # (analysis/ graph rules: collective
                                             # budget under update_sharding,
                                             # host transfers, large baked-in
-                                            # constants, dtype discipline).
+                                            # constants, dtype discipline, and
+                                            # the memory tier: donation-missed
+                                            # on a dead-but-undonated train
+                                            # state, hbm-budget, outsized
+                                            # temporaries).
                                             # None/"off" = skip; "warn" = log
                                             # findings; "raise" = GraphLintError
                                             # on error-severity findings
+    hbm_budget_mb: Optional[float] = None   # per-device HBM budget for the
+                                            # traced train step: with
+                                            # graph_checks on, the static
+                                            # live-range peak estimate
+                                            # (analysis/memory.py) must stay
+                                            # under it at fit() start — the
+                                            # memory analog of the collective
+                                            # budget; the runtime memory
+                                            # witness (ZOO_TPU_MEM_WITNESS)
+                                            # re-checks measured bytes against
+                                            # the same number
     async_checkpoint: bool = True           # snapshot-then-write for trigger-based
                                             # mid-epoch saves: the hot loop pays only
                                             # the device→host snapshot; serialization+

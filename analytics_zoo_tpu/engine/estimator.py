@@ -29,6 +29,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..common import memwitness as _mw
 from ..common import telemetry as _tm
 from ..common.chaos import chaos_point
 from ..common.config import TrainConfig
@@ -830,6 +831,7 @@ class Estimator:
                                      / win_steps) * 1e3
                     _COMPUTE.observe(compute_ms / 1e3)
                     self._observe_comm()
+                    _mw.sample("estimator.step")
                     if self.train_summary:
                         self.train_summary.add_scalars(ts.iteration, {
                             "Loss": loss_val, "Throughput": throughput,
@@ -882,6 +884,9 @@ class Estimator:
                     / steps_this_epoch * 1e3})
         ts.epoch += 1
         ts.records_processed += seen
+        # epoch boundary = a guaranteed witness point even when the epoch is
+        # shorter than log_every_n_steps (the tests' usual shape)
+        _mw.sample("estimator.step")
         if cfg.checkpoint_dir:
             # epoch boundary = durability barrier: the save is synchronous
             # (and drains any in-flight mid-epoch write), so a hard kill in
@@ -977,6 +982,7 @@ class Estimator:
                 compute_ms = (now - win_t0) / max(1, win_steps) * 1e3
                 _COMPUTE.observe(compute_ms / 1e3)
                 self._observe_comm()
+                _mw.sample("estimator.step")
                 if self.train_summary:
                     self.train_summary.add_scalars(ts.iteration, {
                         "Loss": loss_val, "Throughput": throughput,
@@ -1024,20 +1030,40 @@ class Estimator:
         path must show exactly one reduce-scatter + one all-gather per global
         step (and none inside the accumulation scan); a declared bf16 policy
         must actually reach the contraction ops; no host callbacks or large
-        closure-captured constants may ride the step."""
-        from ..analysis import RuleContext, enforce, lint_traced
+        closure-captured constants may ride the step. The memory tier rides
+        the same trace: the train state is rebound every step, so an
+        un-donated state (``donate_state=False``) is ``donation-missed``; a
+        declared ``hbm_budget_mb`` bounds the static live-range peak; and
+        outsized temporaries warn (``peak-temporary``)."""
+        from ..analysis import RuleContext, enforce, lint_jaxpr, profile_jaxpr
+        from ..analysis.rules.memory import lint_memory
 
         expect = ({"reduce-scatter": 1, "all-gather": 1}
                   if self._update_mode() == "flat" else None)
+        cfg = self.config
+        n_state = len(jax.tree_util.tree_leaves(self.train_state))
+        batch = self._to_global(sample_batch)
+        n_batch = len(jax.tree_util.tree_leaves(batch))
+        budget = (int(cfg.hbm_budget_mb * 2 ** 20)
+                  if cfg.hbm_budget_mb else None)
         ctx = RuleContext(where="estimator.fit",
                           expect_collectives=expect,
-                          compute_dtype=self.config.compute_dtype)
+                          compute_dtype=cfg.compute_dtype,
+                          hbm_budget_bytes=budget,
+                          donated_invars=[cfg.donate_state] * n_state
+                          + [False] * n_batch,
+                          dead_invars=[True] * n_state + [False] * n_batch)
         step = self._with_policy(self._step_fn())
-        batch = self._to_global(sample_batch)
-        findings = lint_traced(step, self.train_state, batch, ctx=ctx,
-                               rules=["collective-budget", "host-transfer",
-                                      "large-constant", "dtype-discipline"])
-        enforce(findings, self.config.graph_checks, logger)
+        closed = jax.make_jaxpr(step)(self.train_state, batch)
+        findings = lint_jaxpr(closed, ctx=ctx,
+                              rules=["collective-budget", "host-transfer",
+                                     "large-constant", "dtype-discipline"])
+        findings += lint_memory(closed, ctx=ctx)
+        if _mw.enabled():
+            # the runtime witness cross-checks measured bytes against this
+            prof = profile_jaxpr(closed, donated_invars=ctx.donated_invars)
+            _mw.note_static("estimator.step", prof.peak_live_bytes, budget)
+        enforce(findings, cfg.graph_checks, logger)
 
     def _note_step_signature(self, key) -> None:
         """Record a newly-compiled step signature: add it to ``_step_shapes``
@@ -1174,7 +1200,11 @@ class Estimator:
                 y_hat, _ = model.apply(params, mstate, x, training=False)
                 return [m.update(a, y, y_hat) for m, a in zip(metric_objs, accs)]
 
-            self._eval_cache[key] = self._with_policy(jax.jit(eval_step))
+            # the accumulator is rebound to the step's output every batch —
+            # donating it keeps one accumulator buffer live instead of two
+            # (the donation-missed rule's evaluate-jit class)
+            self._eval_cache[key] = self._with_policy(
+                jax.jit(eval_step, donate_argnums=(2,)))
         eval_step = self._eval_cache[key]
         accs = [m.init() for m in metric_objs]
         # same async loader as the train path: gather/decode + device upload
@@ -1292,6 +1322,11 @@ class Estimator:
                     else:
                         hb = hb[:n_in]
                         xb = hb[0] if len(hb) == 1 else list(hb)
+                    # donation is illegal here: the first iteration's mstate
+                    # IS the live train_state["model_state"] — donating would
+                    # delete the training state's buffers if a later batch
+                    # raises before the reassignment below lands
+                    # zoo-lint: disable=donation-missed
                     mstate = fwd(self.train_state["params"], mstate, xb)
             self.train_state["model_state"] = mstate
         finally:

@@ -70,7 +70,9 @@ class GANEstimator:
             "d_opt": self.disc_tx.init(d_params),
             "rng": kt, "step": jnp.zeros((), jnp.int32),
         }
-        self._step = jax.jit(self._make_step())
+        # fit() rebinds self.state to the step's output — donate it so the
+        # G/D param + opt trees update in place instead of doubling per step
+        self._step = jax.jit(self._make_step(), donate_argnums=(0,))
 
     def _make_step(self):
         gen, disc = self.generator, self.discriminator

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import memwitness as _mw
 from ..common import telemetry as _tm
 from ..common.locks import traced_lock
 from .summary import InferenceSummary, timing
@@ -511,6 +512,7 @@ class InferenceModel:
             finally:
                 with self._lock:
                     self._borrowed -= 1
+        _mw.sample("inference.dispatch")
         if self.summary is not None:
             self.summary.add_batch(n, time.perf_counter() - t0)
         return result
@@ -602,6 +604,7 @@ class InferenceModel:
             self.predict(padded if multi else padded[0])
         if graph_checks:
             self.check_fused_dispatch(example_inputs, mode=graph_checks)
+            self.check_memory(example_inputs, mode=graph_checks)
 
     def check_fused_dispatch(self, example_inputs, mode: str = "warn"):
         """Run the ``fused-int8-dispatch`` graph rule over the exact
@@ -631,6 +634,41 @@ class InferenceModel:
         x = arrs if multi else arrs[0]
         ctx = RuleContext(where="inference.load", fused_expected=True)
         findings = lint_fused_dispatch(self, x, ctx=ctx)
+        return enforce(findings, mode,
+                       logging.getLogger("analytics_zoo_tpu.inference"))
+
+    def check_memory(self, example_inputs, mode: str = "warn",
+                     budget_bytes: Optional[int] = None):
+        """Run the memory tier over the exact computation :meth:`predict`
+        compiles: ``hbm-budget`` when ``budget_bytes`` declares a per-device
+        budget (``ServingConfig.hbm_budget_mb`` through ``_warm_model``) and
+        ``peak-temporary`` always — the static live-range estimate of the
+        dispatch, checked at model-load time exactly like the fused-dispatch
+        structure. Also notes the static peak into the runtime memory
+        witness (site ``inference.dispatch``) when witnessing is on.
+        Returns the findings."""
+        from ..analysis import RuleContext, enforce, profile_jaxpr
+        from ..analysis.rules.fused_int8 import _trace_dispatch
+        from ..analysis.rules.memory import lint_memory
+        from ..common import memwitness as _mw
+
+        if not mode or mode == "off":
+            return []
+        import logging
+
+        multi = isinstance(example_inputs, (list, tuple))
+        arrs = [jnp.asarray(np.asarray(a)[:1]) for a in
+                (example_inputs if multi else [example_inputs])]
+        x = arrs if multi else arrs[0]
+        closed = _trace_dispatch(self, x)
+        ctx = RuleContext(where="inference.load",
+                          hbm_budget_bytes=budget_bytes)
+        findings = lint_memory(closed, ctx=ctx,
+                               rules=["hbm-budget", "peak-temporary"])
+        if _mw.enabled():
+            prof = profile_jaxpr(closed)
+            _mw.note_static("inference.dispatch", prof.peak_live_bytes,
+                            budget_bytes)
         return enforce(findings, mode,
                        logging.getLogger("analytics_zoo_tpu.inference"))
 

@@ -9,8 +9,9 @@ fixed constants that earn MFU 0.53 at batch 4 leave the MXU idle at batch 16
 This module sweeps a small candidate grid per (shape-bucket, dtype), scores
 each candidate with a **timed probe** plus the **compiled memory analysis**
 (structured ``compiled.memory_analysis()`` when the backend provides it,
-else the PR-5 ``bench.parse_xla_memory_analysis`` text parser), and persists
-the winner in an on-disk JSON cache keyed by device kind, so every later
+else the text parser — both in ``analysis.memory`` since ISSUE 12), and
+persists the winner in an on-disk JSON cache keyed by device kind, so every
+later
 process — ``InferenceModel.quantize_int8`` dispatch, ``flash_attention``
 call sites, the MFU bench — traces with tuned blocks instead of constants.
 
@@ -40,6 +41,10 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis.memory import memory_fields  # noqa: F401  (re-export: the
+# structured/text ingestion migrated to the analysis subsystem in ISSUE 12 —
+# library code must not import from the bench script; existing callers and
+# the tuning-cache schema keep using tuning.memory_fields)
 from ..common import telemetry as _tm
 
 _SWEEPS = _tm.counter("zoo_kernel_tuning_sweeps_total",
@@ -141,31 +146,6 @@ def record(op: str, key: str, entry: dict) -> None:
     _store(path, data)
 
 
-def memory_fields(compiled) -> dict:
-    """Structured HBM numbers for a compiled executable: the PJRT
-    ``memory_analysis()`` object when present, else the textual dump routed
-    through the PR-5 ``parse_xla_memory_analysis`` parser."""
-    try:
-        ma = compiled.memory_analysis()
-    except Exception:
-        return {}
-    if isinstance(ma, str):
-        try:
-            from bench import parse_xla_memory_analysis
-
-            return parse_xla_memory_analysis(ma) or {}
-        except Exception:
-            return {}
-    fields = {}
-    for k in ("temp_size_in_bytes", "argument_size_in_bytes",
-              "output_size_in_bytes"):
-        v = getattr(ma, k, None)
-        if v is not None:
-            fields[k] = int(v)
-    if "temp_size_in_bytes" in fields and "argument_size_in_bytes" in fields:
-        fields["hbm_peak_bytes"] = (fields["temp_size_in_bytes"]
-                                    + fields["argument_size_in_bytes"])
-    return fields
 
 
 def _time_probe(fn, *args, iters: int = 3, inner: int = 5) -> float:

@@ -29,11 +29,21 @@ class ServingConfig:
                                          # the bucket ladder for it
     graph_checks: str = "warn"           # static analysis of the dispatch
                                          # computation at warmup (analysis/
-                                         # fused-int8-dispatch rule): "warn"
+                                         # fused-int8-dispatch rule + the
+                                         # memory tier: hbm-budget /
+                                         # peak-temporary, and cache-alias
+                                         # on the decode warmup): "warn"
                                          # logs findings, "raise" fails
                                          # start() — catches the PR-6
                                          # regression class at model-load
                                          # time; "off" skips
+    hbm_budget_mb: Optional[float] = None  # per-device HBM budget for the
+                                         # serving dispatch / decode step:
+                                         # with graph_checks on, the static
+                                         # live-range peak must stay under
+                                         # it at warmup (hbm-budget rule);
+                                         # the memory witness re-checks
+                                         # measured bytes in CI
     log_dir: Optional[str] = None        # InferenceSummary TB dir
     # --- autoregressive generation (serving/generation.py) ---
     gen_slots: int = 8                   # concurrent decode sequences (the
@@ -141,6 +151,10 @@ class ServingConfig:
                 raise ValueError(f"graph_checks must be 'off'/'warn'/"
                                  f"'raise', got {gc!r}")
             flat["graph_checks"] = val
+        mem = raw.get("memory") or {}
+        hb = raw.get("hbm_budget_mb", mem.get("hbm_budget_mb"))
+        if hb is not None:
+            flat["hbm_budget_mb"] = float(hb)
         gen = raw.get("generation") or {}
         for key, alias in (("gen_slots", "slots"),
                            ("gen_page_size", "page_size"),

@@ -406,6 +406,21 @@ class ClusterServing:
                         raise
                     logger.exception("fused-dispatch graph check failed "
                                      "(shape=%s); serving anyway", shape)
+            if hasattr(self.model, "check_memory"):
+                budget_mb = getattr(self.config, "hbm_budget_mb", None)
+                try:
+                    # hbm-budget (when declared) + peak-temporary over the
+                    # dispatch's static live-range estimate — same
+                    # enforcement surface as the fused-dispatch check
+                    self.model.check_memory(
+                        sample, mode=checks,
+                        budget_bytes=int(budget_mb * 2 ** 20)
+                        if budget_mb else None)
+                except Exception:
+                    if checks == "raise":
+                        raise
+                    logger.exception("memory graph check failed "
+                                     "(shape=%s); serving anyway", shape)
         elif self.config.int8 and checks and checks != "off":
             logger.info("graph_checks: no warmup_shape configured — the "
                         "fused-dispatch structure check needs an input "

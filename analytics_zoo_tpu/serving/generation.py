@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import memwitness as _mw
 from ..common import telemetry as _tm
 from ..common.chaos import WorkerKilled, chaos_point
 from ..common.locks import traced_lock
@@ -194,6 +195,8 @@ class ContinuousBatcher:
                  admit_policy: str = "continuous",
                  batch_window_s: float = 0.05,
                  graph_checks: Optional[str] = None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 donate_cache: bool = True,
                  registry: Optional[HealthRegistry] = None,
                  autostart: bool = True):
         if admit_policy not in ("continuous", "batch"):
@@ -246,13 +249,24 @@ class ContinuousBatcher:
         self.decode_shapes: set = set()
 
         cfg = self.cfg
+        # Donate the KV page pool into both dispatches (the cache-alias
+        # rule's invariant): the loop rebinds self.cache to each call's
+        # output, so the input pool is dead the moment the step runs — with
+        # donation XLA updates the pool in place instead of materializing a
+        # second pool-sized buffer and copying every decode step.
+        # ``donate_cache=False`` exists for the rule's negative polarity
+        # (tests) and for backends where donation misbehaves.
+        self.donate_cache = bool(donate_cache)
+        self.hbm_budget_bytes = hbm_budget_bytes
+        donate = (1,) if donate_cache else ()
         self._decode = jax.jit(
             lambda p, c, ids, ln, tb, sd, ti, tp: model.decode_step(
                 p, c, ids, ln, tb, sd, ti, tp, page_size=cfg.page_size,
-                top_k=self.top_k))
+                top_k=self.top_k), donate_argnums=donate)
         self._prefill = jax.jit(
             lambda p, c, ids, ln, tb: model.prefill(
-                p, c, ids, ln, tb, page_size=cfg.page_size))
+                p, c, ids, ln, tb, page_size=cfg.page_size),
+            donate_argnums=donate)
         from ..ops.kv_cache import sample_tokens
 
         self._sample = jax.jit(
@@ -540,6 +554,7 @@ class ContinuousBatcher:
         next_ids = np.asarray(next_ids)
         self.steps += 1
         _GEN_STEPS.inc()
+        _mw.sample("serving.decode")
         for i in active:
             with self._lock:
                 slot = self._slots[i]
@@ -620,22 +635,72 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------- diagnostics
 
-    def check_decode_stability(self, mode: str = "warn"):
-        """Run the ``decode-shape-stability`` graph-lint rule over the traced
-        decode step (no compile): the cache must thread through with
-        identical shapes, no host transfers, no per-step growth. Wired into
-        ``ServingConfig.graph_checks`` warmup by :class:`GenerationEngine`
-        alongside the fused-int8 check."""
+    def check_decode_stability(self, mode: str = "warn",
+                               hbm_budget_bytes: Optional[int] = None):
+        """Run the decode graph checks over the traced decode step (no
+        compile): ``decode-shape-stability`` (cache threads through with
+        identical shapes, no host transfers, no per-step growth) plus the
+        memory tier — ``cache-alias`` (the pool must be donated into the
+        dispatch; tripped by ``donate_cache=False``) and, when a budget is
+        declared, ``hbm-budget`` over the donation-aware static peak. Wired
+        into ``ServingConfig.graph_checks`` warmup by
+        :class:`GenerationEngine` alongside the fused-int8 check; the static
+        peak is also noted into the memory witness so the CI gate can
+        cross-check measured decode bytes against it."""
         import logging as _logging
 
         from ..analysis import enforce
         from ..analysis.rules.decode import lint_decode_stability
 
+        budget = (hbm_budget_bytes if hbm_budget_bytes is not None
+                  else self.hbm_budget_bytes)
         findings = lint_decode_stability(
             self.model, self.params, self.cfg, self.cache,
-            top_k=self.top_k, where="serving.generation")
+            top_k=self.top_k, where="serving.generation",
+            donate_cache=self.donate_cache, hbm_budget_bytes=budget,
+            note_static_site="serving.decode")
         return enforce(findings, mode,
                        _logging.getLogger("analytics_zoo_tpu.serving"))
+
+    def decode_memory(self) -> Dict[str, Any]:
+        """Memory picture of the ONE decode executable, for the bench gate:
+        the compiled buffer table (``alias_size_in_bytes`` is the donated
+        pool showing up as an input→output alias) plus the static live-range
+        peak under the actual donation flags AND with donation disabled —
+        their difference is the second pool-sized buffer the ``cache-alias``
+        rule exists to prevent."""
+        import jax
+        import jax.numpy as jnp
+        import jax.tree_util as jtu
+
+        from ..analysis.memory import memory_fields, profile_jaxpr
+
+        cfg = self.cfg
+        b = self.n_slots
+        sds = jax.ShapeDtypeStruct
+        args = (self.params, self.cache, sds((b,), jnp.int32),
+                sds((b,), jnp.int32), sds((b, cfg.pages_per_slot), jnp.int32),
+                sds((b,), jnp.uint32), sds((b,), jnp.uint32),
+                sds((b,), jnp.float32))
+        fields = memory_fields(self._decode.lower(*args).compile())
+        closed = jax.make_jaxpr(
+            lambda p, c, ids, ln, tb, sd, ti, tp: self.model.decode_step(
+                p, c, ids, ln, tb, sd, ti, tp, page_size=cfg.page_size,
+                top_k=self.top_k))(*args)
+        n_params = len(jtu.tree_leaves(self.params))
+        cache_leaves = jtu.tree_leaves(self.cache)
+        donated = ([False] * n_params
+                   + [self.donate_cache] * len(cache_leaves) + [False] * 6)
+        prof = profile_jaxpr(closed, donated_invars=donated)
+        prof_undonated = profile_jaxpr(closed)
+        return {
+            "compiled": fields,
+            "donate_cache": self.donate_cache,
+            "cache_bytes": int(sum(int(l.nbytes) for l in cache_leaves)),
+            "static_peak_bytes": prof.peak_live_bytes,
+            "static_peak_bytes_undonated": prof_undonated.peak_live_bytes,
+            "aliased_bytes": prof.aliased_out_bytes,
+        }
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -693,10 +758,13 @@ class GenerationEngine:
         if isinstance(model, ContinuousBatcher):
             self.batcher = model
         else:
+            budget_mb = getattr(cfg, "hbm_budget_mb", None)
             self.batcher = ContinuousBatcher(
                 model, params, n_slots=cfg.gen_slots,
                 page_size=cfg.gen_page_size, max_seq_len=cfg.gen_max_seq_len,
                 n_pages=cfg.gen_pages or None, top_k=cfg.gen_top_k,
+                hbm_budget_bytes=int(budget_mb * 2 ** 20) if budget_mb
+                else None,
                 graph_checks=None, autostart=False)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -712,9 +780,10 @@ class GenerationEngine:
 
     def _warm(self):
         """Startup decode-graph check (``ServingConfig.graph_checks``): the
-        traced decode step must be shape-stable and host-transfer-free
-        BEFORE the job takes traffic — the decode analog of the one-shot
-        engine's fused-int8 warmup check."""
+        traced decode step must be shape-stable, host-transfer-free, and
+        pool-donating (``cache-alias``; plus ``hbm-budget`` under a declared
+        ``hbm_budget_mb``) BEFORE the job takes traffic — the decode analog
+        of the one-shot engine's fused-int8 warmup check."""
         checks = getattr(self.config, "graph_checks", "warn")
         if not checks or checks == "off":
             return

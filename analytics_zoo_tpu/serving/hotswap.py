@@ -294,7 +294,11 @@ class ModelSwapper:
         # ONE host->device transfer per staging: the probe runs on the same
         # device tree the swap will flip in (device_put inside swap_params
         # is then a no-op view) — a second full-tree transfer would double
-        # the per-swap cost and the transient device-memory spike
+        # the per-swap cost and the transient device-memory spike. Donation
+        # is meaningless here: the source leaves are npz-backed host numpy
+        # views (device_put donate= only reuses device buffers), and the
+        # host tree dies with this scope anyway.
+        # zoo-lint: disable=donation-missed
         params = jax.device_put(params)
         if self.warmup:
             self._probe(params)

@@ -19,20 +19,34 @@
 # any cycle — a lock-order inversion that only materializes across objects
 # at runtime is caught here, not in production. Set ZOO_TPU_LOCK_MAX_HOLD_S
 # to additionally gate on the per-lock max observed hold time.
+#
+# Memory witness (ISSUE 12): the suite also runs with ZOO_TPU_MEM_WITNESS
+# set, so every step/dispatch boundary (estimator log points, inference
+# dispatch, decode steps) samples live device-array bytes; the dump is then
+# checked against the HBM budgets and static peak estimates recorded
+# alongside (`--mem-witness`). Set ZOO_TPU_HBM_BUDGET_MB to gate every
+# sampled site against a global per-device budget.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 TIMEOUT="${CHAOS_SUITE_TIMEOUT:-600}"
 WITNESS="${ZOO_TPU_LOCK_WITNESS:-$(mktemp -t zoo_lock_witness.XXXXXX.jsonl)}"
+MEM_WITNESS="${ZOO_TPU_MEM_WITNESS:-$(mktemp -t zoo_mem_witness.XXXXXX.jsonl)}"
 : > "$WITNESS"
+: > "$MEM_WITNESS"
 echo "[chaos-suite] lock witness: $WITNESS" >&2
+echo "[chaos-suite] memory witness: $MEM_WITNESS" >&2
 
 timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
     ZOO_TPU_TRACE_LOCKS=1 ZOO_TPU_LOCK_WITNESS="$WITNESS" \
+    ZOO_TPU_MEM_WITNESS="$MEM_WITNESS" \
     python -m pytest tests -q -m "chaos or fleet or hotswap" \
     -p no:cacheprovider "$@"
 
-# gate: witnessed ∪ static lock-order graph must be cycle-free (and leaf
-# declarations must hold against the witnessed edges)
+# gates: witnessed ∪ static lock-order graph must be cycle-free (and leaf
+# declarations must hold against the witnessed edges); witnessed device
+# bytes must respect every recorded HBM budget
 timeout -k 10 120 env JAX_PLATFORMS=cpu \
     python -m analytics_zoo_tpu.analysis --witness "$WITNESS"
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m analytics_zoo_tpu.analysis --mem-witness "$MEM_WITNESS"

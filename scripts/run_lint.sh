@@ -1,23 +1,28 @@
 #!/usr/bin/env bash
-# Graph-lint runner (ISSUE 7; concurrency tier added in ISSUE 11).
+# Graph-lint runner (ISSUE 7; concurrency tier ISSUE 11; memory tier
+# ISSUE 12).
 #
 #   scripts/run_lint.sh            # AST-lint the package (tracer/wallclock/
 #                                  # chaos-site rules + the concurrency tier:
 #                                  # guarded-by, lock-order cycles, hold
-#                                  # hazards, leaf/unused/reach-in); non-zero
-#                                  # exit on any unsuppressed error finding
+#                                  # hazards, leaf/unused/reach-in + the
+#                                  # memory tier's donation-missed rebind
+#                                  # check repo-wide); non-zero exit on any
+#                                  # unsuppressed error finding
 #   scripts/run_lint.sh --full     # also run the analysis pytest marker
 #                                  # (golden fixtures + clean-repo gate +
-#                                  # graph_checks hooks + TracedLock witness)
+#                                  # graph_checks hooks + the lock and
+#                                  # memory witnesses)
 #
 # The graph-layer rules need a traced computation, so they run where one
-# exists: TrainConfig.graph_checks at fit() start, InferenceModel/serving
-# warmup at model-load time, and the bench gates (--int8-dispatch /
-# --update-sharding). This script is the host-layer CI gate and is wired
-# into scripts/run_serving_bench.sh --quick. The dynamic half of the
-# concurrency tier (witnessed lock-order edges) is gated by
+# exists: TrainConfig.graph_checks at fit() start (now incl. hbm-budget /
+# donation-missed / peak-temporary), InferenceModel/serving warmup at
+# model-load time (hbm-budget + cache-alias on the decode step), and the
+# bench gates (--int8-dispatch / --update-sharding / --generation). This
+# script is the host-layer CI gate and is wired into
+# scripts/run_serving_bench.sh --quick. The dynamic halves are gated by
 # scripts/run_chaos_suite.sh via `python -m analytics_zoo_tpu.analysis
-# --witness`.
+# --witness` (locks) and `--mem-witness` (allocations).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

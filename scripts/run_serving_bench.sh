@@ -26,7 +26,13 @@
 #                                           # compiled decode shape, empty
 #                                           # decode-lint findings,
 #                                           # continuous >= 1.5x RTC, flat
-#                                           # per-token cost); and gates the
+#                                           # per-token cost, KV-pool
+#                                           # donation — static peak one pool
+#                                           # under the undonated estimate,
+#                                           # compiled alias >= pool, flat
+#                                           # witnessed device bytes — with
+#                                           # the ZOO_TPU_MEM_WITNESS dump
+#                                           # re-checked offline); and gates the
 #                                           # replica fleet (bench.py --fleet
 #                                           # --quick: one of 4 replicas
 #                                           # chaos-killed mid-burst loses
@@ -49,9 +55,17 @@ if [[ "${1:-}" == "--quick" ]]; then
     # generation decode-path gate: N=8 concurrent streams with zero failed
     # streams, ONE compiled decode shape (bucket invariant), empty
     # decode-shape-stability findings, continuous >= 1.5x run-to-completion
-    # on mixed-length traffic, flat per-token decode cost
+    # on mixed-length traffic, flat per-token decode cost. The run carries
+    # the memory witness (ISSUE 12): every decode step samples live device
+    # bytes, the bench gates flatness + KV-pool donation (static peak drops
+    # by one pool; the compiled executable aliases it input->output), and
+    # the dump is re-checked offline below
+    MEM_WITNESS="$(mktemp -t zoo_mem_witness.XXXXXX.jsonl)"
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+        ZOO_TPU_MEM_WITNESS="$MEM_WITNESS" \
         python bench.py --generation --quick
+    timeout -k 10 120 env JAX_PLATFORMS=cpu \
+        python -m analytics_zoo_tpu.analysis --mem-witness "$MEM_WITNESS"
     # replica-fleet gate: zero lost requests with one of 4 replicas chaos-
     # killed mid-burst (requeue + dedup-on-uri verified), fleet reconverges,
     # and routed throughput scales >= 2.5x from 1 to 4 replicas

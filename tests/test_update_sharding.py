@@ -417,6 +417,51 @@ def test_parse_xla_memory_analysis_structured():
     assert bench.parse_xla_memory_analysis("all good") is None
 
 
+def test_memory_parser_lives_in_analysis_and_bench_aliases_it():
+    """ISSUE 12 migration: the parser's home is the analysis subsystem;
+    the bench (and ops.tuning, which used to import FROM bench) alias the
+    same function — one implementation, three entry points."""
+    from analytics_zoo_tpu.analysis.memory import parse_xla_memory_analysis
+    from analytics_zoo_tpu.ops import tuning
+
+    bench = _load_bench()
+    assert bench.parse_xla_memory_analysis is parse_xla_memory_analysis
+    assert tuning.memory_fields.__module__ == \
+        "analytics_zoo_tpu.analysis.memory"
+    assert parse_xla_memory_analysis(_OOM_DUMP)["hbm_peak_bytes"] == \
+        int(17.54 * 2 ** 30)
+
+
+def test_memory_fields_structured_vs_text_parity():
+    """memory_fields reads the structured PJRT stats when present and the
+    text dump otherwise — both land in the same hbm_peak_bytes field."""
+    from analytics_zoo_tpu.analysis.memory import memory_fields
+
+    class _Structured:
+        def memory_analysis(self):
+            class S:
+                temp_size_in_bytes = 1000
+                argument_size_in_bytes = 2000
+                output_size_in_bytes = 500
+                alias_size_in_bytes = 300
+            return S()
+
+    class _Text:
+        def memory_analysis(self):
+            return _OOM_DUMP
+
+    class _Broken:
+        def memory_analysis(self):
+            raise RuntimeError("no analysis on this backend")
+
+    s = memory_fields(_Structured())
+    assert s["hbm_peak_bytes"] == 3000
+    assert s["alias_size_in_bytes"] == 300
+    t = memory_fields(_Text())
+    assert t["hbm_peak_bytes"] == int(17.54 * 2 ** 30)
+    assert memory_fields(_Broken()) == {}
+
+
 # ------------------------------------------------------------------ orca knobs
 def test_orca_fit_threads_update_sharding_knobs(zoo_ctx):
     from analytics_zoo_tpu.orca.learn import Estimator as OrcaEstimator
