@@ -162,6 +162,7 @@ class ChaosSchedule:
 # tests nothing. New subsystems register their sites at import time via
 # :func:`register_chaos_site`.
 KNOWN_SITES = {
+    "autoscale.scale",    # serving/fleet.py autoscaler scale-up/down events
     "broker.handle",      # serving/broker.py command dispatch
     "ckpt.write",         # engine/checkpoint.py writer thread (serialize→publish)
     "conn.call",          # serving/client.py broker round-trip
@@ -169,6 +170,8 @@ KNOWN_SITES = {
     "estimator.step",     # engine/estimator.py per-step (both epoch runners)
     "fleet.route",        # serving/fleet.py per-dispatch routing decision
     "fleet.respawn",      # serving/fleet.py dead-replica respawn path
+    "overload.shed",      # deadline/admission sheds at every serving tier
+                          # (frontend, router, micro-batcher, gen batcher)
     "rollout.phase",      # serving/hotswap.py rollout state-machine phases
     "serving.generate",   # serving/generation.py continuous-batch decode loop
     "serving.infer",      # serving/engine.py model-worker batch loop

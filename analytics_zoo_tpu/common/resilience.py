@@ -231,6 +231,17 @@ class RetryTracker:
             raise RetryExhaustedError(
                 f"gave up after {self.attempts} attempts: {exc}") from exc
         delay = self.policy.backoff_s(self.attempts, self._rng)
+        # a server-provided Retry-After hint (an exception carrying
+        # ``retry_after_s`` — CircuitOpenError, serving ShedError) is the
+        # BACKOFF FLOOR: the server computed it from its real queue drain
+        # time, so retrying sooner is guaranteed wasted load. The policy's
+        # seeded jitter still rides on top (+only — an overloaded server
+        # must never be retried EARLIER than it asked).
+        hint = getattr(exc, "retry_after_s", None)
+        if isinstance(hint, (int, float)) and hint > 0 and hint > delay:
+            delay = float(hint)
+            if self.policy.jitter:
+                delay *= 1.0 + self._rng.uniform(0.0, self.policy.jitter)
         if self.policy.deadline_s is not None and \
                 self._clock() - self._start + delay > self.policy.deadline_s:
             raise DeadlineExceededError(

@@ -22,9 +22,11 @@ from .generation import (ContinuousBatcher, GenerationClient,
 from .hotswap import (ModelPublisher, ModelSwapper, RolloutController,
                       SwapRejected)
 from .http_frontend import FrontEndApp
+from .qos import PRIORITIES, ShedError
 
 __all__ = ["QueueBroker", "start_broker", "InputQueue", "OutputQueue",
            "ServingConfig", "ClusterServing", "ContinuousBatcher",
            "FleetSupervisor", "GenerationClient", "GenerationEngine",
-           "FrontEndApp", "ModelPublisher", "ModelSwapper",
-           "ReplicaRouter", "RolloutController", "SwapRejected"]
+           "FrontEndApp", "ModelPublisher", "ModelSwapper", "PRIORITIES",
+           "ReplicaRouter", "RolloutController", "ShedError",
+           "SwapRejected"]

@@ -29,6 +29,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..common import telemetry as _tm
+from ..common.chaos import chaos_point
+from ..common.locks import traced_lock
+from . import qos as _qos
 
 _B_RECORDS = _tm.counter("zoo_batch_records_total",
                          "Records submitted to micro-batchers")
@@ -39,20 +42,26 @@ _B_PADDED = _tm.counter("zoo_batch_padded_rows_total",
 _B_CANCELLED = _tm.counter("zoo_batch_cancelled_total",
                            "Queued records dropped because their waiter "
                            "timed out/cancelled before the batcher ran them")
+_B_SHED = _tm.counter("zoo_batch_shed_total",
+                      "Queued records shed by the micro-batcher instead of "
+                      "served, by overload class",
+                      labels=("reason",))
 _B_SIZE = _tm.histogram("zoo_batch_size",
                         "Records coalesced per micro-batch",
                         buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
 _LIVE_BATCHERS: "weakref.WeakSet[MicroBatcher]" = weakref.WeakSet()
 _tm.collector("zoo_batch_queue_depth",
-              "Live queue depth summed over this process's micro-batchers",
-              lambda: [((), float(sum(b._q.qsize()
+              "Live queue depth (incl. the priority backlog) summed over "
+              "this process's micro-batchers",
+              lambda: [((), float(sum(b._q.qsize() + len(b._backlog)
                                       for b in list(_LIVE_BATCHERS))))])
 
 
 class _Slot:
-    __slots__ = ("tensors", "event", "result", "error", "cancelled")
+    __slots__ = ("tensors", "event", "result", "error", "cancelled",
+                 "priority", "deadline", "seq")
 
-    def __init__(self, tensors):
+    def __init__(self, tensors, priority=None, deadline=None, seq=0):
         self.tensors = tensors
         self.event = threading.Event()
         self.result = None
@@ -61,6 +70,16 @@ class _Slot:
         # slot instead of computing it into a later batch (nobody is waiting;
         # the work and its batch space would be pure waste)
         self.cancelled = False
+        # overload QoS (serving/qos.py): eligible records run in
+        # (priority, deadline) order; records that provably cannot meet
+        # their deadline are shed before predict_fn ever sees them
+        self.priority = _qos.normalize_priority(priority)
+        self.deadline = _qos.normalize_deadline(deadline)
+        self.seq = seq
+
+    @property
+    def order_key(self) -> Tuple:
+        return _qos.order_key(self.priority, self.deadline, self.seq)
 
 
 class MicroBatcher:
@@ -89,6 +108,18 @@ class MicroBatcher:
         self.batch_sizes = collections.deque(maxlen=1000)
         self.padded_rows = 0
         self.cancelled_drops = 0
+        self.shed_records = 0
+        # (priority, deadline)-ordered staging area between the submit queue
+        # and the next wave; owned by the batcher thread (stats only reads
+        # its len)
+        self._backlog: List[_Slot] = []
+        self._seq = 0
+        # zoo-lock: guards(_seq)
+        self._seq_lock = traced_lock("MicroBatcher._seq_lock")
+        # measured per-BATCH service time: the evidence behind every
+        # "provably cannot meet its deadline" shed and the computed
+        # Retry-After handed back to the waiter
+        self.service_ema = _qos.ServiceTimeEMA()
         # every (bucket, per-record signature) that reached predict_fn: with
         # bucket_pad this stays <= len(buckets) per tensor signature, which is
         # exactly the "no mid-traffic recompile" property /metrics watches
@@ -99,10 +130,19 @@ class MicroBatcher:
         self._thread.start()
 
     # ------------------------------------------------------------------ client
-    def submit_async(self, tensors: Dict[str, np.ndarray]) -> _Slot:
+    def submit_async(self, tensors: Dict[str, np.ndarray],
+                     priority: Optional[str] = None,
+                     deadline: Optional[float] = None) -> _Slot:
         """Enqueue a record; pair with :meth:`wait`. Submitting all records of
-        a request before waiting lets them share one batch."""
-        slot = _Slot(tensors)
+        a request before waiting lets them share one batch. ``priority``
+        (critical/normal/bulk) and ``deadline`` (absolute epoch seconds)
+        order eligible work and arm deadline shedding — a record the batcher
+        provably cannot serve in time fails fast with
+        :class:`~.qos.ShedError` instead of burning batch space."""
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        slot = _Slot(tensors, priority=priority, deadline=deadline, seq=seq)
         self._q.put(slot)
         return slot
 
@@ -134,51 +174,81 @@ class MicroBatcher:
         # (e.g. sorting) would silently swap inputs
         return tuple((k, v.shape, str(v.dtype)) for k, v in tensors.items())
 
-    def _drain(self) -> List[_Slot]:
-        """One blocking get, then opportunistically fill the batch for up to
-        ``max_delay_s`` — latency cost bounded, MXU batch maximized."""
-        try:
-            first = self._q.get(timeout=0.1)
-        except queue.Empty:
-            return []
-        slots = [first]
+    def _fill_backlog(self) -> bool:
+        """Move queued submissions into the priority backlog: one blocking
+        get when the backlog is empty, a bounded straggler window while a
+        wave is still short, then everything else non-blocking — so the
+        ordering/shed pass below always sees the WHOLE queued population,
+        not a FIFO prefix of it."""
+        if not self._backlog:
+            try:
+                self._backlog.append(self._q.get(timeout=0.1))
+            except queue.Empty:
+                return False
         deadline = time.monotonic() + self.max_delay_s
-        while len(slots) < self.max_batch:
+        while len(self._backlog) < self.max_batch:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             try:
-                slots.append(self._q.get(timeout=remaining))
+                self._backlog.append(self._q.get(timeout=remaining))
             except queue.Empty:
                 break
-        return slots
+        while True:        # opportunistic: order across the full backlog
+            try:
+                self._backlog.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        return True
+
+    def _order_and_shed(self) -> None:
+        """Sort the backlog by ``(priority, deadline)``, drop cancelled
+        slots, and shed every record that provably cannot meet its deadline
+        — estimated wait is its position's wave count × the measured batch
+        service time — answering the waiter with a computed Retry-After
+        BEFORE any batch space or device time is spent on it."""
+        ema = self.service_ema.value()
+        now = time.time()
+        depth = len(self._backlog)
+        keep: List[_Slot] = []
+        for s in sorted(self._backlog, key=lambda s: s.order_key):
+            if s.cancelled:
+                self.cancelled_drops += 1
+                _B_CANCELLED.inc()
+                # error BEFORE event: a waiter racing its own timeout
+                # recheck must see a raised error, never result=None
+                s.error = TimeoutError(
+                    "record dropped: waiter timed out before the "
+                    "batcher ran it")
+                s.event.set()
+                continue
+            waves_ahead = len(keep) // self.max_batch
+            if _qos.cannot_meet(s.deadline, waves_ahead * ema, ema, now=now):
+                chaos_point("overload.shed", tag="batcher")
+                self.shed_records += 1
+                _B_SHED.labels(reason="deadline").inc()
+                s.error = _qos.ShedError(
+                    f"deadline cannot be met (est wait "
+                    f"{waves_ahead * ema + ema:.3f}s)",
+                    retry_after_s=_qos.retry_after_s(depth, ema),
+                    reason="deadline")
+                s.event.set()
+                continue
+            keep.append(s)
+        self._backlog = keep
 
     def _loop(self):
         while not self._stop.is_set():
-            slots = self._drain()
-            if not slots:
+            if not self._fill_backlog():
                 continue
-            # drop slots whose waiter already gave up (timeout leak fix):
-            # computing them would burn batch space + device time on results
-            # nobody reads
-            live = []
-            for s in slots:
-                if s.cancelled:
-                    self.cancelled_drops += 1
-                    _B_CANCELLED.inc()
-                    # error BEFORE event: a waiter racing its own timeout
-                    # recheck must see a raised error, never result=None
-                    s.error = TimeoutError(
-                        "record dropped: waiter timed out before the "
-                        "batcher ran it")
-                    s.event.set()
-                else:
-                    live.append(s)
-            if not live:
+            self._order_and_shed()
+            wave = self._backlog[:self.max_batch]
+            del self._backlog[:len(wave)]
+            if not wave:
                 continue
             # group by tensor signature — only same-shaped records stack
             groups: Dict[Tuple, List[_Slot]] = {}
-            for s in live:
+            for s in wave:
                 groups.setdefault(self._signature(s.tensors), []).append(s)
             for group in groups.values():
                 self._run_group(group)
@@ -212,7 +282,9 @@ class MicroBatcher:
                 tuple((bucket,) + a.shape[1:] + (str(a.dtype),)
                       for a in arrays))
             x = arrays[0] if len(arrays) == 1 else arrays
+            t0 = time.monotonic()
             y = self.predict_fn(x)
+            self.service_ema.observe(time.monotonic() - t0)
             # pad rows (indices >= k) are simply never fanned back out
             if isinstance(y, (list, tuple)):
                 for i, s in enumerate(group):
@@ -236,17 +308,24 @@ class MicroBatcher:
             "batches": self.batches_run,
             "mean_batch_size": (float(np.mean(sizes)) if sizes else 0.0),
             "max_batch_size": self.max_batch_seen,
-            "queue_depth": self._q.qsize(),
+            "queue_depth": self._q.qsize() + len(self._backlog),
             "padded_rows": self.padded_rows,
             "cancelled_drops": self.cancelled_drops,
+            "shed_records": self.shed_records,
+            "service_ema_s": round(self.service_ema.value(), 6),
             "distinct_batch_shapes": len(self.batch_shapes_seen),
         }
 
     def close(self):
         self._stop.set()
         self._thread.join(timeout=2.0)
-        # fail queued-but-never-run slots immediately rather than leaving
-        # their waiters blocked until timeout
+        # fail queued-but-never-run slots (incl. the ordered backlog)
+        # immediately rather than leaving their waiters blocked until timeout
+        backlog, self._backlog = self._backlog, []
+        for slot in backlog:
+            slot.error = RuntimeError("MicroBatcher closed before this "
+                                      "record was served")
+            slot.event.set()
         while True:
             try:
                 slot = self._q.get_nowait()

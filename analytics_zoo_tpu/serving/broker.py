@@ -47,12 +47,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..common import telemetry as _tm
 from ..common.locks import traced_lock
-from .schema import (MODEL_VERSION_KEY, json_default, json_revive,
-                     payload_trace)
+from .schema import (DEADLINE_KEY, MODEL_VERSION_KEY, PRIORITY_KEY,
+                     json_default, json_revive, payload_trace)
 # wire-protocol primitives live in wire.py; re-exported here because the
 # historical import surface for the framing helpers is this module
 from .wire import (MAX_MSG, VERSION as WIRE_VERSION,  # noqa: F401
-                   _recv_exact, received_model_version,
+                   _recv_exact, received_model_version, received_qos,
                    received_trace_context, recv_msg, send_msg,
                    set_wire_model_version, wire_stats)
 
@@ -596,6 +596,25 @@ _SHMOPEN = object()
 _SHUTDOWN = object()
 
 
+def _stamp_qos(payload: Any) -> Any:
+    """Fold frame-header overload-QoS fields ("p"/"dl") into an XADD payload
+    that does not already carry the durable twins: a sender that tags only
+    the wire header still yields a priority/deadline-attributed record in
+    the stream (and through AOF replay / XTRANSFER requeue — the payload is
+    the copy that survives)."""
+    pri, dl = received_qos()
+    if (pri is None and dl is None) or not isinstance(payload, dict):
+        return payload
+    stamped = None
+    if pri is not None and PRIORITY_KEY not in payload:
+        stamped = dict(payload)
+        stamped[PRIORITY_KEY] = pri
+    if dl is not None and DEADLINE_KEY not in payload:
+        stamped = dict(payload) if stamped is None else stamped
+        stamped[DEADLINE_KEY] = dl
+    return payload if stamped is None else stamped
+
+
 def _stamp_version(payload: Any) -> Any:
     """Fold a frame-header model version ("v") into a hash write whose
     payload does not already carry one: an engine that tags only the wire
@@ -694,7 +713,7 @@ class _Handler(socketserver.BaseRequestHandler):
         """Store-level command handling; connection-scoped commands (SHMOPEN,
         SHUTDOWN) return sentinels for :meth:`handle` to act on."""
         if cmd == "XADD":
-            return store.xadd(req[1], req[2])
+            return store.xadd(req[1], _stamp_qos(req[2]))
         if cmd == "XGROUPCREATE":
             store.xgroupcreate(req[1], req[2],
                                req[3] if len(req) > 3 else "$")

@@ -18,9 +18,13 @@ from ..common import telemetry as _tm
 from ..common.chaos import chaos_point
 from ..common.locks import traced_lock
 from ..common.resilience import RetryPolicy
+from .qos import (ShedError, deadline_from_ms, normalize_deadline,
+                  normalize_priority, shed_error_from_payload)
 from .shm import MIN_SHM_BUFFER_BYTES, ShmChannel, shm_enabled
-from .wire import WireError, received_model_version, recv_msg, send_msg
-from .schema import TRACE_KEY, decode_payload, payload_model_version
+from .wire import (WireError, received_model_version, recv_msg, send_msg,
+                   set_wire_qos)
+from .schema import (DEADLINE_KEY, PRIORITY_KEY, TRACE_KEY, decode_payload,
+                     payload_model_version)
 
 INPUT_STREAM = "serving_stream"
 RESULT_PREFIX = "result:"
@@ -207,9 +211,20 @@ class InputQueue:
         self._conn = _Conn(host, port, policy=policy or default_conn_policy(),
                            tag="client.input")
 
-    def enqueue(self, uri: Optional[str] = None, **data) -> str:
+    def enqueue(self, uri: Optional[str] = None,
+                priority: Optional[str] = None,
+                deadline_ms: Optional[float] = None,
+                deadline: Optional[float] = None, **data) -> str:
         """Enqueue one record. ``data``: name → ndarray (or scalars/str).
         Returns the record uri (auto-generated when not given).
+
+        Overload QoS: ``priority`` is one of ``critical``/``normal``/
+        ``bulk`` (default normal), ``deadline_ms`` a relative latency budget
+        from now (``deadline`` takes an absolute epoch-seconds value
+        instead). Both ride the payload (durable — surviving the broker
+        stream, AOF replay, and failover requeue) AND the binary frame
+        header; every serving tier sheds the record instead of serving it
+        once the deadline provably cannot be met.
 
         Tensors ride the binary zero-copy frame protocol raw — no npy/base64/
         JSON encode step; large batches transfer through the same-host shm
@@ -217,6 +232,9 @@ class InputQueue:
         if not data:
             raise ValueError("enqueue needs at least one named tensor")
         uri = uri or uuid.uuid4().hex
+        dl = normalize_deadline(deadline)
+        if dl is None:
+            dl = deadline_from_ms(deadline_ms)
         # the send span parents the whole request's trace: its context rides
         # BOTH the binary frame header (ambient, via send_msg) and the payload
         # (durable — it survives the broker stream/AOF to the engine hops)
@@ -224,7 +242,15 @@ class InputQueue:
             payload = {"uri": uri, TRACE_KEY: sp.wire_context(), "data":
                        {k: np.asarray(v) if not isinstance(v, (str, bytes))
                         else v for k, v in data.items()}}
-            self._conn.call("XADD", self.stream, payload)
+            if priority is not None:
+                payload[PRIORITY_KEY] = normalize_priority(priority)
+            if dl is not None:
+                payload[DEADLINE_KEY] = dl
+            set_wire_qos(payload.get(PRIORITY_KEY), dl)
+            try:
+                self._conn.call("XADD", self.stream, payload)
+            finally:
+                set_wire_qos(None, None)
         return uri
 
     def __len__(self) -> int:
@@ -261,6 +287,12 @@ class OutputQueue:
                                        or received_model_version())
             self._conn.call("HDEL", RESULT_PREFIX + uri)
         decoded = decode_payload(resp)
+        shed = shed_error_from_payload(decoded, uri)
+        if shed is not None:
+            # an overloaded tier answered instead of serving: surface the
+            # computed Retry-After so the caller (and any RetryPolicy around
+            # this call) backs off proportionally to real drain time
+            raise shed
         if "error" in decoded:
             raise RuntimeError(f"serving error for {uri!r}: {decoded['error']}")
         return decoded["value"]

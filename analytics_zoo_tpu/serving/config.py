@@ -93,6 +93,36 @@ class ServingConfig:
                                          # stable cohort's by at most this
     rollout_max_latency_ratio: float = 3.0  # canary latency vs stable-cohort
                                          # median; above => rollback
+    # --- overload QoS (serving/qos.py; YAML `overload:` section) ---
+    default_priority: str = "normal"     # class assumed for requests that
+                                         # carry no priority (old clients):
+                                         # critical | normal | bulk
+    bulk_inflight_fraction: float = 0.5  # frontend watermark: bulk-class
+                                         # requests admit only while
+                                         # inflight < fraction*max_inflight,
+                                         # keeping headroom for critical/
+                                         # normal under sustained overload
+    # --- queue-driven autoscaling (serving/fleet.py; YAML `autoscale:`) ---
+    autoscale: bool = False              # FleetSupervisor grows/shrinks the
+                                         # replica set on sustained queue
+                                         # pressure / idleness; every scale
+                                         # event rides the graceful drain +
+                                         # requeue machinery (zero-loss)
+    min_replicas: int = 1                # never drain below this
+    max_replicas: int = 4                # never spawn above this
+    autoscale_up_depth: float = 8.0      # sustained owed-work-per-eligible-
+                                         # replica (zoo_fleet_queue_depth)
+                                         # above this => scale up; router
+                                         # deadline sheds count double (shed
+                                         # traffic is demand the fleet
+                                         # failed to serve)
+    autoscale_sustain_s: float = 1.0     # pressure must persist this long
+                                         # (one slow batch must not spawn)
+    autoscale_idle_s: float = 3.0        # zero queued work + no dispatch
+                                         # activity for this long => drain
+                                         # one replica down
+    autoscale_cooldown_s: float = 2.0    # min gap between scale events so
+                                         # the signal can react to the last
     # --- resilience (common.resilience wiring) ---
     infer_workers: int = 1               # model-worker threads; dead ones are
                                          # respawned by the engine supervisor
@@ -201,6 +231,46 @@ class ServingConfig:
         if frac is not None and not (0.0 < frac <= 1.0):
             raise ValueError(f"rollout canary_fraction must be in (0, 1], "
                              f"got {frac!r}")
+        overload = raw.get("overload") or {}
+        for key, alias in (("default_priority", "priority"),
+                           ("bulk_inflight_fraction",
+                            "bulk_inflight_fraction")):
+            if key in raw:
+                flat[key] = type(getattr(cls, key))(raw[key])
+            elif alias in overload:
+                flat[key] = type(getattr(cls, key))(overload[alias])
+        pri = flat.get("default_priority")
+        if pri is not None and pri not in ("critical", "normal", "bulk"):
+            raise ValueError(f"overload priority must be 'critical'/"
+                             f"'normal'/'bulk', got {pri!r}")
+        frac = flat.get("bulk_inflight_fraction")
+        if frac is not None and not (0.0 < frac <= 1.0):
+            raise ValueError(f"overload bulk_inflight_fraction must be in "
+                             f"(0, 1], got {frac!r}")
+        auto = raw.get("autoscale") or {}
+        for key, alias in (("autoscale", "enabled"),
+                           ("min_replicas", "min_replicas"),
+                           ("max_replicas", "max_replicas"),
+                           ("autoscale_up_depth", "up_depth"),
+                           ("autoscale_sustain_s", "sustain_s"),
+                           ("autoscale_idle_s", "idle_s"),
+                           ("autoscale_cooldown_s", "cooldown_s")):
+            # the flat `autoscale:` key COLLIDES with the section name: when
+            # the value is the nested mapping itself, bool(dict) would read
+            # any non-empty section — `enabled: false` included — as True
+            if key in raw and not isinstance(raw[key], dict):
+                flat[key] = type(getattr(cls, key))(raw[key])
+            elif alias in auto:
+                flat[key] = type(getattr(cls, key))(auto[alias])
+        lo = flat.get("min_replicas")
+        hi = flat.get("max_replicas")
+        if lo is not None and lo < 1:
+            raise ValueError(f"autoscale min_replicas must be >= 1, "
+                             f"got {lo!r}")
+        if (hi is not None and hi < (lo if lo is not None
+                                     else cls.min_replicas)):
+            raise ValueError(f"autoscale max_replicas ({hi!r}) must be >= "
+                             f"min_replicas")
         for key in ("infer_workers", "heartbeat_timeout_s",
                     "http_max_inflight", "breaker_failure_threshold",
                     "breaker_reset_timeout_s"):

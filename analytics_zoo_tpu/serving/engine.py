@@ -29,10 +29,12 @@ from ..common.locks import traced_lock
 from ..common.resilience import (HealthRegistry, RetryAbortedError,
                                  RetryPolicy)
 from ..inference import InferenceModel, InferenceSummary
+from . import qos as _qos
 from .client import INPUT_STREAM, RESULT_PREFIX, _Conn
 from .config import ServingConfig
 from .hotswap import MODEL_STREAM, ModelSwapper, SwapRejected
-from .schema import MODEL_VERSION_KEY, decode_payload, payload_trace
+from .schema import (MODEL_VERSION_KEY, decode_payload, payload_deadline,
+                     payload_trace)
 from .wire import set_wire_model_version
 
 logger = logging.getLogger("analytics_zoo_tpu.serving")
@@ -46,6 +48,11 @@ _DUP_RESULTS = _tm.counter(
     "zoo_fleet_duplicate_results_dropped_total",
     "Result writes a dedup-mode sink dropped because another replica "
     "already answered the uri (HSETNX returned 0)")
+_ENGINE_SHED = _tm.counter(
+    "zoo_serving_shed_total",
+    "Requests the engine shed instead of served, by overload class "
+    "(deadline = expired in flight — incl. AOF-replayed / failover-"
+    "requeued records)", labels=("reason",))
 
 # fleet coordination keys on the broker (written by replica engines, read by
 # the ReplicaRouter/FleetSupervisor in serving/fleet.py)
@@ -119,6 +126,10 @@ class ClusterServing:
         self.errors = 0                 # records answered with an error —
                                         # the canary-validation signal
         self._lat_ema_s = 0.0           # EMA of receipt->computed latency
+        # per-RECORD compute time (pickup->computed / batch size) — the
+        # router's shed-proof evidence; unlike lat it excludes queue wait,
+        # so depth x svc doesn't double-count
+        self._svc_ema = _qos.ServiceTimeEMA()
         # model hot-swap (serving/hotswap.py): staging + the atomic flip.
         # Commands arrive via the fleet control hash (replica mode) or the
         # publisher stream directly (single-engine mode, config.hot_swap)
@@ -176,6 +187,25 @@ class ClusterServing:
                     # through the stream (and AOF replay); absent from old
                     # clients — every consumer below tolerates ctx=None
                     ctx = payload_trace(payload)
+                    # deadline gate BEFORE the model sees the record: a
+                    # request whose deadline expired in flight (deep queue,
+                    # AOF-replayed after a broker restart, requeued off a
+                    # dead replica) is answered with a shed record — serving
+                    # it would burn device time on a result the client
+                    # already gave up on. The deadline is the ORIGINAL one:
+                    # it rides the payload through every requeue.
+                    dl = payload_deadline(payload)
+                    if dl is not None and time.time() > dl:
+                        chaos_point("overload.shed", tag="engine")
+                        _ENGINE_SHED.labels(reason="deadline").inc()
+                        bad.append((_id, payload.get("uri"),
+                                    _qos.shed_payload(
+                                        "deadline expired before service",
+                                        _qos.retry_after_s(
+                                            self._infer_q.qsize() + 1,
+                                            self._svc_ema.value()),
+                                        reason="deadline"), ctx))
+                        continue
                     try:
                         batch.append((_id, payload["uri"],
                                       decode_payload(payload["data"]),
@@ -246,6 +276,8 @@ class ClusterServing:
                     lat = t_done - min(rec[4] for rec in batch)
                     self._lat_ema_s = (lat if self._lat_ema_s == 0.0
                                        else 0.8 * self._lat_ema_s + 0.2 * lat)
+                    self._svc_ema.observe((t_done - t_pick)
+                                          / max(1, len(batch)))
                     for ctx in ctxs:
                         if ctx is not None:
                             _tm.record_span("serving.engine.dispatch", t_pick,
@@ -335,10 +367,16 @@ class ClusterServing:
                                     self._write_result(conn, uri, value)
                             else:
                                 self._write_result(conn, uri, value)
-                        is_err = isinstance(value, dict) and "error" in value
+                        is_shed = isinstance(value, dict) and value.get("shed")
+                        is_err = (not is_shed and isinstance(value, dict)
+                                  and "error" in value)
                         _RECORDS.labels(
-                            outcome="error" if is_err else "ok").inc()
+                            outcome="shed" if is_shed
+                            else "error" if is_err else "ok").inc()
                         if is_err:
+                            # sheds are deliberate load management, not model
+                            # failures — they must not poison the canary-
+                            # validation error-rate signal
                             self.errors += 1
                         self.served += 1
                         done_ids.append(entry_id)
@@ -655,6 +693,8 @@ class ClusterServing:
                                "inflight": self._infer_q.qsize(),
                                "errors": self.errors,
                                "lat_ms": round(self._lat_ema_s * 1e3, 3),
+                               "svc_ms": round(self._svc_ema.value() * 1e3,
+                                               3),
                                "model_version": self.model_version,
                                "swap_state": self._swap_state,
                                "swap_error": self._swap_error,

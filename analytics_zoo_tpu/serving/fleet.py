@@ -65,9 +65,11 @@ from ..common.chaos import chaos_point
 from ..common.locks import traced_lock
 from ..common.resilience import (CircuitBreaker, HealthRegistry,
                                  RetryAbortedError, RetryPolicy)
-from .client import INPUT_STREAM, _Conn
+from . import qos as _qos
+from .client import INPUT_STREAM, RESULT_PREFIX, _Conn
 from .config import ServingConfig
 from .engine import FLEET_CTL_PREFIX, FLEET_HB_PREFIX, ClusterServing
+from .schema import payload_deadline, payload_priority
 
 logger = logging.getLogger("analytics_zoo_tpu.serving.fleet")
 
@@ -98,6 +100,16 @@ _FAILOVER = _tm.histogram(
 _NO_REPLICA = _tm.counter(
     "zoo_fleet_route_stalls_total",
     "Router iterations that held traffic because no replica was eligible")
+_ROUTER_SHED = _tm.counter(
+    "zoo_fleet_shed_total",
+    "Requests the router shed (answered + acked, never dispatched) because "
+    "their deadline provably cannot be met, by overload class",
+    labels=("reason",))
+_AUTOSCALE = _tm.counter(
+    "zoo_autoscale_events_total",
+    "Autoscaler scale events, by direction (up = replica spawned on "
+    "sustained queue pressure, down = replica drained away when idle)",
+    labels=("direction",))
 
 # scrape-time gauges walk the live routers (weakset, the resilience.py
 # pattern): eligible-replica count + per-replica queue depth — the numbers
@@ -160,6 +172,8 @@ class _ReplicaSlot:
                                       # command — scopes swap_error to it
         self.errors = 0             # cumulative error-result counter
         self.lat_ms = 0.0           # receipt->computed latency EMA
+        self.svc_ms = 0.0           # per-record COMPUTE time EMA (no queue
+                                    # wait) — the deadline-shed evidence
         # canary traffic weight: 1.0 = full member of the rotation; a
         # fraction f < 1 admits this replica on ~every (1/f)th pick only
         self.weight = 1.0
@@ -208,6 +222,9 @@ class ReplicaRouter:
         self._thread: Optional[threading.Thread] = None
         self._depths_refreshed = 0.0
         self.routed = 0
+        self.shed = 0           # monotonic: deadline sheds at this tier —
+                                # with queue depth, the autoscaler's
+                                # pressure signal
         _LIVE_ROUTERS.add(self)
 
     # -- membership / liveness (supervisor-fed) ------------------------------
@@ -258,6 +275,7 @@ class ReplicaRouter:
                      model_version: Optional[str] = None,
                      errors: Optional[int] = None,
                      lat_ms: Optional[float] = None,
+                     svc_ms: Optional[float] = None,
                      swap_state: Optional[str] = None,
                      swap_error: Optional[str] = None,
                      swap_nonce: Any = None) -> None:
@@ -286,6 +304,8 @@ class ReplicaRouter:
                 slot.errors = errors
             if lat_ms is not None:
                 slot.lat_ms = lat_ms
+            if svc_ms is not None:
+                slot.svc_ms = svc_ms
             if swap_state is not None:
                 slot.swap_state = swap_state
             slot.swap_error = swap_error
@@ -340,7 +360,8 @@ class ReplicaRouter:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             slots = list(self._slots.values())
-        return {"routed": self.routed, "policy": self.policy,
+        return {"routed": self.routed, "shed": self.shed,
+                "policy": self.policy,
                 "replicas": {
                     s.rid: {"dispatched": s.dispatched, "depth": s.depth,
                             "alive": s.alive, "state": s.state,
@@ -348,6 +369,7 @@ class ReplicaRouter:
                             "model_version": s.model_version,
                             "swap_state": s.swap_state,
                             "weight": s.weight, "lat_ms": s.lat_ms,
+                            "svc_ms": s.svc_ms,
                             "breaker": s.breaker.state} for s in slots}}
 
     # -- routing -------------------------------------------------------------
@@ -422,6 +444,59 @@ class ReplicaRouter:
                     return slot.rid
         return None
 
+    def _wait_estimate(self) -> Tuple[float, float, int, int]:
+        """(best-replica est wait s, per-record service estimate s,
+        total owed, eligible count) from the heartbeat-fed slots. The
+        service estimate is the per-RECORD compute-time EMA the engines
+        publish (``svc_ms``) — deliberately NOT the receipt→computed
+        latency, which includes replica-side queue wait and would double-
+        count it against the depth (over-shedding healthy traffic)."""
+        with self._lock:
+            live = [s for s in self._slots.values()
+                    if s.alive and s.state == "up"
+                    and s.breaker.state != CircuitBreaker.OPEN]
+            depths = [s.depth for s in live]
+            svcs = [s.svc_ms for s in live if s.svc_ms > 0]
+        if not live:
+            return 0.0, 0.0, 0, 0
+        svc = (min(svcs) / 1e3) if svcs else 0.0
+        return min(depths) * svc, svc, sum(depths), len(live)
+
+    @staticmethod
+    def _hold_key(item) -> Tuple:
+        """(priority, deadline, arrival) ordering for held entries — the
+        entry id's monotonic sequence keeps FIFO fairness inside a class."""
+        entry_id, payload = item
+        try:
+            seq = int(str(entry_id).split("-")[0])
+        except (TypeError, ValueError):
+            seq = 0
+        return _qos.order_key(payload_priority(payload),
+                              payload_deadline(payload), seq)
+
+    def _maybe_shed(self, conn: _Conn, payload: Any) -> bool:
+        """Shed one held entry whose deadline provably cannot be met —
+        BEFORE spending a dispatch on it. The shed answer (first-write-wins,
+        like any replica result) carries the computed Retry-After so the
+        waiting client backs off proportionally to real drain time."""
+        dl = payload_deadline(payload)
+        if dl is None:
+            return False
+        est, svc, total, eligible = self._wait_estimate()
+        if not _qos.cannot_meet(dl, est, svc):
+            return False
+        chaos_point("overload.shed", tag="router")
+        uri = payload.get("uri") if isinstance(payload, dict) else None
+        if uri:
+            conn.call("HSETNX", RESULT_PREFIX + uri, _qos.shed_payload(
+                "deadline cannot be met at the routing tier "
+                f"(est wait {est + svc:.3f}s)",
+                _qos.retry_after_s(total, svc, max(1, eligible)),
+                reason="deadline"))
+        self.shed += 1
+        _ROUTER_SHED.labels(reason="deadline").inc()
+        return True
+
     def _note_dispatched(self, rid: str) -> None:
         with self._lock:
             slot = self._slots.get(rid)
@@ -448,7 +523,14 @@ class ReplicaRouter:
                                             self.group, 64, 100)
                     except RetryAbortedError:
                         break
-                    hold.extend(entries or ())
+                    if entries:
+                        hold.extend(entries)
+                        # (priority, deadline) ordering: eligible work is
+                        # dispatched critical-first, earliest-deadline-first
+                        # within a class, FIFO within ties — stable across
+                        # re-sorts because the entry id is the tiebreak
+                        hold = collections.deque(
+                            sorted(hold, key=self._hold_key))
                     if not hold:
                         continue
                 try:
@@ -457,6 +539,12 @@ class ReplicaRouter:
                     stalled = False
                     while hold:
                         entry_id, payload = hold[0]
+                        if self._maybe_shed(conn, payload):
+                            # answered with a shed record: ack the origin
+                            # entry, never dispatch it
+                            hold.popleft()
+                            done.append(entry_id)
+                            continue
                         rid = self._pick()
                         if rid is None:
                             stalled = True
@@ -580,8 +668,14 @@ class FleetSupervisor:
         # defaults would silently drop batch/int8/heartbeat tuning
         self.config_path = config_path
         self.platform = platform
+        n0 = max(1, config.replicas)
+        if getattr(config, "autoscale", False):
+            # start inside the autoscaler's band: at least min_replicas, at
+            # most max_replicas — the loop adjusts from there
+            n0 = min(max(n0, max(1, config.min_replicas)),
+                     max(1, config.max_replicas))
         ids = list(replica_ids) if replica_ids else \
-            [f"r{i}" for i in range(max(1, config.replicas))]
+            [f"r{i}" for i in range(n0)]
         self.router = router or ReplicaRouter(config, tuple(ids))
         # the fleet registry holds one component per replica; death/revival
         # TRANSITIONS drive eviction + requeue + respawn via the listener
@@ -602,6 +696,21 @@ class FleetSupervisor:
         self.requeued = 0
         self.respawns = 0
         self.failovers: List[float] = []
+        # queue-driven autoscaling (ROADMAP "adaptive serving under
+        # overload"): the monitor loop watches owed work per eligible
+        # replica (the zoo_fleet_queue_depth signal) plus the router's
+        # deadline-shed rate, spawns replicas on sustained pressure up to
+        # max_replicas, and drains them away (graceful drain + straggler
+        # XTRANSFER — zero-loss by construction) when idle down to
+        # min_replicas
+        self.autoscale_enabled = bool(getattr(config, "autoscale", False))
+        self._as_pressure_since: Optional[float] = None
+        self._as_idle_since: Optional[float] = None
+        self._as_last_event_t = 0.0
+        self._as_last_routed = 0
+        self._as_last_shed = 0
+        self._as_busy = False          # a scale-down drain is in flight
+        self.scale_events: List[Tuple[str, int]] = []
         # canary rollout controller (serving/hotswap.py): consumes the
         # trainer's publish stream and drives per-replica swap commands
         self.rollout = None
@@ -749,6 +858,7 @@ class FleetSupervisor:
                     model_version=hb.get("model_version"),
                     errors=int(hb.get("errors", 0)),
                     lat_ms=float(hb.get("lat_ms", 0.0)),
+                    svc_ms=float(hb.get("svc_ms", 0.0)),
                     swap_state=hb.get("swap_state"),
                     swap_error=hb.get("swap_error"),
                     swap_nonce=hb.get("swap_nonce"))
@@ -759,6 +869,7 @@ class FleetSupervisor:
                 self.registry.register(f"replica.{rid}", timeout_s=0.0)
         self.registry.check_transitions()
         self._check_rolling()
+        self._autoscale_check()
 
     def _on_transition(self, component: str, alive: bool) -> None:
         if not component.startswith("replica."):
@@ -816,6 +927,146 @@ class FleetSupervisor:
         dt = time.perf_counter() - t0
         self.failovers.append(dt)
         _FAILOVER.observe(dt)
+
+    # -- autoscaling ---------------------------------------------------------
+
+    def _fresh_rid(self) -> str:
+        i = 0
+        while f"r{i}" in self._handles:
+            i += 1
+        return f"r{i}"
+
+    def _owed_work(self) -> Optional[int]:
+        """Total work the fleet still owes, measured at the BROKER (the
+        router's cached per-replica depths only refresh while it is
+        actively routing, so they can hold a stale nonzero value across an
+        idle gap): un-routed entries on the shared stream plus everything
+        owed on every replica dispatch stream. ``None`` = broker
+        unreachable this poll (treated as not-idle)."""
+        try:
+            total = int(self._conn.call("LEN", self.router.stream,
+                                        self.router.group))
+            for rid in self.router.replica_ids():
+                total += int(self._conn.call(
+                    "LEN", self.router.prefix + rid,
+                    self.router.group_fmt.format(rid=rid)))
+        except RetryAbortedError:
+            raise
+        except Exception:
+            return None
+        return total
+
+    def _autoscale_check(self) -> None:
+        """One autoscaler evaluation (runs on the monitor thread, every
+        poll). The pressure signal is owed work per ELIGIBLE replica —
+        exactly what ``zoo_fleet_queue_depth`` publishes — plus the router's
+        deadline-shed rate (shed traffic is demand the current fleet failed
+        to serve, so it counts double). Both directions are debounced
+        (sustain/idle windows) and rate-limited (cooldown) so one slow
+        batch never spawns a replica and a gap between bursts never drains
+        one."""
+        if not self.autoscale_enabled or self._as_busy \
+                or self._stop.is_set():
+            return
+        cfg = self.config
+        now = time.monotonic()
+        n = len(self._handles)
+        eligible = len(self.router.eligible_ids())
+        owed = self._owed_work()
+        if owed is None:
+            self._as_idle_since = None
+            return
+        total_owed = owed
+        shed_delta = self.router.shed - self._as_last_shed
+        self._as_last_shed = self.router.shed
+        routed_delta = self.router.routed - self._as_last_routed
+        self._as_last_routed = self.router.routed
+        load = (total_owed + 2.0 * shed_delta) / max(1, eligible)
+        if load > cfg.autoscale_up_depth:
+            if self._as_pressure_since is None:
+                self._as_pressure_since = now
+        else:
+            self._as_pressure_since = None
+        if total_owed == 0 and routed_delta == 0 and shed_delta == 0:
+            if self._as_idle_since is None:
+                self._as_idle_since = now
+        else:
+            self._as_idle_since = None
+        if now - self._as_last_event_t < cfg.autoscale_cooldown_s:
+            return
+        if (self._as_pressure_since is not None
+                and now - self._as_pressure_since >= cfg.autoscale_sustain_s
+                and n < cfg.max_replicas):
+            self._scale_up()
+        elif (self._as_idle_since is not None
+                and now - self._as_idle_since >= cfg.autoscale_idle_s
+                and n > cfg.min_replicas):
+            self._scale_down()
+
+    def _scale_up(self) -> None:
+        rid = self._fresh_rid()
+        # deterministic fault site: a "fail" rule aborts THIS spawn attempt
+        # (the monitor retries next poll while pressure persists) — the
+        # kill-during-scale-up drill targets the spawned replica instead
+        chaos_point("autoscale.scale", tag="up")
+        self._spawn_replica(rid)
+        self._as_last_event_t = time.monotonic()
+        self._as_pressure_since = None
+        self.scale_events.append(("up", len(self._handles)))
+        _AUTOSCALE.labels(direction="up").inc()
+        logger.info("autoscale: spawned replica %s (%d total) on sustained "
+                    "queue pressure", rid, len(self._handles))
+
+    def _scale_down(self) -> None:
+        """Drain one replica away, zero-loss: stop routing to it (drain),
+        let it finish + ack everything it claimed, then claim-transfer any
+        stragglers back to the dispatch pool before deregistering. Runs on
+        a side thread — the monitor must keep polling heartbeats during the
+        drain."""
+        victims = [rid for rid, h in self._handles.items()
+                   if not h.drain_requested and not h.restarting]
+        if len(victims) <= max(1, self.config.min_replicas):
+            return
+        rid = victims[-1]        # newest first: r0 stays the stable core
+        handle = self._handles[rid]
+        handle.restarting = True     # monitor hands off this lifecycle
+        self._as_busy = True
+        self._as_last_event_t = time.monotonic()
+        self._as_idle_since = None
+        chaos_point("autoscale.scale", tag="down")
+
+        def run():
+            try:
+                self.drain(rid)
+                self.wait_state(rid, "drained",
+                                timeout_s=max(5.0, self.config
+                                              .fleet_failover_timeout_s * 4))
+                handle.stop(drain_s=2.0)
+                try:
+                    res = self._conn.call("XTRANSFER",
+                                          self.router.prefix + rid,
+                                          f"fleet-{rid}", self.router.stream)
+                    moved = (int(res.get("moved", 0))
+                             if isinstance(res, dict) else 0)
+                    if moved:
+                        _REQUEUED.inc(moved)
+                        self.requeued += moved
+                except Exception:
+                    logger.exception("autoscale: straggler requeue for %s "
+                                     "failed", rid)
+                self._handles.pop(rid, None)
+                self._hb_seen.pop(rid, None)
+                self.router.remove_replica(rid)
+                self.registry.deregister(f"replica.{rid}")
+                self.scale_events.append(("down", len(self._handles)))
+                _AUTOSCALE.labels(direction="down").inc()
+                logger.info("autoscale: drained replica %s away (%d left)",
+                            rid, len(self._handles))
+            finally:
+                self._as_busy = False
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"zoo-autoscale-drain-{rid}").start()
 
     # -- drain / rolling restart --------------------------------------------
 
@@ -918,6 +1169,12 @@ class FleetSupervisor:
             "replicas": self.router.replica_ids(),
             "requeued": self.requeued, "respawns": self.respawns,
             "model_versions": self.model_versions()}
+        if self.autoscale_enabled:
+            detail["autoscale"] = {
+                "replicas": len(self._handles),
+                "min": self.config.min_replicas,
+                "max": self.config.max_replicas,
+                "events": len(self.scale_events)}
         if self.rollout is not None:
             detail["rollout"] = self.rollout.state()
         return len(eligible) >= 1, detail
@@ -933,6 +1190,9 @@ class FleetSupervisor:
                                "requeued": self.requeued,
                                "respawns": self.respawns,
                                "served": 0}
+        if self.autoscale_enabled:
+            out["autoscale"] = {"replicas": len(self._handles),
+                                "events": list(self.scale_events)}
         if self.rollout is not None:
             out["rollout"] = self.rollout.state()
         slots = router_stats.get("replicas", {})

@@ -45,9 +45,11 @@ from ..common.chaos import WorkerKilled, chaos_point
 from ..common.locks import traced_lock
 from ..common.resilience import HealthRegistry, RetryAbortedError, RetryPolicy
 from ..ops.kv_cache import OutOfPages, PagePool, SCRATCH_PAGE
+from . import qos as _qos
 from .client import _Conn
 from .config import ServingConfig
-from .schema import TRACE_KEY, payload_trace
+from .schema import (DEADLINE_KEY, PRIORITY_KEY, TRACE_KEY, payload_deadline,
+                     payload_priority, payload_trace)
 
 logger = logging.getLogger("analytics_zoo_tpu.serving.generation")
 
@@ -67,6 +69,14 @@ _GEN_ITL = _tm.histogram("zoo_gen_inter_token_seconds",
                          "Per-stream time between consecutive emitted tokens",
                          buckets=(.001, .0025, .005, .01, .025, .05, .1,
                                   .25, .5, 1.0, 2.5))
+_GEN_SHED = _tm.counter("zoo_gen_shed_total",
+                        "Generation requests shed by the continuous batcher "
+                        "instead of decoded, by overload class",
+                        labels=("reason",))
+_GEN_PREEMPT = _tm.counter(
+    "zoo_gen_preemptions_total",
+    "Bulk decode slots preempted for latency-critical requests (the "
+    "preempted stream keeps its KV pages and resumes in a later slot)")
 _LIVE_GENERATORS: "weakref.WeakSet[ContinuousBatcher]" = weakref.WeakSet()
 _tm.collector("zoo_gen_active_slots",
               "Occupied decode slots summed over live continuous batchers",
@@ -90,10 +100,11 @@ class _Request:
 
     __slots__ = ("uri", "prompt", "max_new_tokens", "temperature", "seed",
                  "eos_id", "on_chunk", "ctx", "submitted_t", "cancelled",
-                 "last_emit_t")
+                 "last_emit_t", "priority", "deadline", "seq")
 
     def __init__(self, uri, prompt, max_new_tokens, temperature, seed,
-                 eos_id, on_chunk, ctx):
+                 eos_id, on_chunk, ctx, priority=None, deadline=None,
+                 seq=0):
         self.uri = uri
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -105,6 +116,15 @@ class _Request:
         self.submitted_t = time.perf_counter()
         self.cancelled = False
         self.last_emit_t: Optional[float] = None
+        # overload QoS (serving/qos.py): admission runs in (priority,
+        # deadline) order; critical requests may preempt bulk decode slots
+        self.priority = _qos.normalize_priority(priority)
+        self.deadline = _qos.normalize_deadline(deadline)
+        self.seq = seq
+
+    @property
+    def order_key(self) -> Tuple:
+        return _qos.order_key(self.priority, self.deadline, self.seq)
 
 
 class StreamHandle:
@@ -146,6 +166,12 @@ class StreamHandle:
         for tokens, final, meta in self.frames(timeout_s=timeout_s):
             if tokens:
                 yield tokens
+            if final and meta.get("outcome") == "shed":
+                raise _qos.ShedError(
+                    f"generation request {self.uri!r} shed: "
+                    f"{meta.get('error', 'overloaded')}",
+                    retry_after_s=float(meta.get("retry_after_s", 1.0)),
+                    reason="deadline")
             if final and meta.get("error"):
                 raise RuntimeError(
                     f"generation failed for {self.uri!r}: {meta['error']}")
@@ -229,6 +255,16 @@ class ContinuousBatcher:
                               SCRATCH_PAGE, np.int32)
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
         self._pending: "queue.Queue[_Request]" = queue.Queue()
+        # (priority, deadline)-ordered staging area between the submit queue
+        # and slot admission; owned by the loop thread. Preempted bulk slots
+        # park here-adjacent with their KV pages INTACT until a slot frees
+        self._backlog: List[_Request] = []
+        self._preempted: List[_Slot] = []
+        self._seq = 0
+        # measured per-decode-step service time: the shed proof for queued
+        # generation requests (a request whose deadline cannot even absorb
+        # one step is hopeless) and the computed Retry-After
+        self.step_ema = _qos.ServiceTimeEMA()
         # uris cancelled while still queued (bounded: unknown uris age out)
         import collections
 
@@ -238,7 +274,7 @@ class ContinuousBatcher:
         self._stop = threading.Event()
         # slots/table vs stats readers; final-frame callbacks run OUTSIDE it
         # (the PR-8 fix) — the hold-hazard rule keeps that true
-        # zoo-lock: guards(_slots, _table)
+        # zoo-lock: guards(_slots, _table, _seq, _preempted)
         self._lock = traced_lock("ContinuousBatcher._lock")
         # accounting
         self.steps = 0
@@ -322,6 +358,15 @@ class ContinuousBatcher:
                 break
             self._finish_cb(req, [], "error",
                             error="generator closed before admission")
+        backlog, self._backlog = self._backlog, []
+        for req in backlog:
+            self._finish_cb(req, [], "error",
+                            error="generator closed before admission")
+        parked, self._preempted = self._preempted, []
+        for slot in parked:
+            self._finish_cb(slot.request, [], "error",
+                            error="generator closed mid-stream",
+                            n_tokens=slot.generated)
         self._fail_all_active("generator closed mid-stream")
 
     # ------------------------------------------------------------------- client
@@ -330,10 +375,14 @@ class ContinuousBatcher:
                temperature: float = 0.0, seed: int = 0,
                eos_id: Optional[int] = None, uri: Optional[str] = None,
                on_chunk: Optional[Callable] = None,
-               ctx=None) -> StreamHandle:
+               ctx=None, priority: Optional[str] = None,
+               deadline: Optional[float] = None) -> StreamHandle:
         """Enqueue one generation request; returns a :class:`StreamHandle`.
         ``on_chunk(tokens, final, meta)`` additionally mirrors every frame
-        (the broker engine rides this)."""
+        (the broker engine rides this). ``priority`` (critical/normal/bulk)
+        and ``deadline`` (absolute epoch seconds) order admission; a
+        critical request may preempt a bulk slot, and a request whose
+        deadline provably cannot be met finishes with outcome ``shed``."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -343,8 +392,12 @@ class ContinuousBatcher:
         if prompt.size >= limit:
             raise ValueError(f"prompt of {prompt.size} tokens exceeds the "
                              f"cache's max_seq_len {limit}")
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
         req = _Request(uri or uuid.uuid4().hex, prompt, max_new_tokens,
-                       temperature, seed, eos_id, on_chunk, ctx)
+                       temperature, seed, eos_id, on_chunk, ctx,
+                       priority=priority, deadline=deadline, seq=seq)
         handle = StreamHandle(req)
 
         def fanout(tokens, final, meta, _h=handle, _cb=on_chunk):
@@ -353,7 +406,7 @@ class ContinuousBatcher:
                 _cb(tokens, final, meta)
 
         req.on_chunk = fanout
-        if self._pending.empty():
+        if self._pending.empty() and not self._backlog:
             self._pending_since = time.monotonic()
         self._pending.put(req)
         self._wake.set()
@@ -374,6 +427,10 @@ class ContinuousBatcher:
                 if slot is not None and slot.request.uri == uri:
                     slot.request.cancelled = True
                     return
+            for slot in self._preempted:
+                if slot.request.uri == uri:
+                    slot.request.cancelled = True
+                    return
             self._cancelled_uris.append(uri)
 
     # ------------------------------------------------------------------- loop
@@ -391,7 +448,8 @@ class ContinuousBatcher:
                 try:
                     self._admit()
                     if self.active_slots() == 0:
-                        if self._pending.empty():
+                        if (self._pending.empty() and not self._backlog
+                                and not self._preempted):
                             self._wake.wait(timeout=0.05)
                             self._wake.clear()
                         continue
@@ -419,31 +477,130 @@ class ContinuousBatcher:
 
     # admission ---------------------------------------------------------------
 
+    def _drain_pending(self) -> None:
+        """Move submitted requests into the (priority, deadline)-ordered
+        backlog, dropping cancelled ones and SHEDDING every request whose
+        deadline provably cannot be met — the measured per-decode-step time
+        is the proof — before any slot or KV page is spent on it."""
+        while True:
+            try:
+                self._backlog.append(self._pending.get_nowait())
+            except queue.Empty:
+                break
+        if not self._backlog:
+            return
+        ema = self.step_ema.value()
+        now = time.time()
+        keep: List[_Request] = []
+        for req in sorted(self._backlog, key=lambda r: r.order_key):
+            if req.uri in self._cancelled_uris:
+                self._cancelled_uris.remove(req.uri)
+                req.cancelled = True
+            if req.cancelled:
+                self._finish_cb(req, [], "cancelled")
+                continue
+            if _qos.cannot_meet(req.deadline, 0.0, ema, now=now):
+                chaos_point("overload.shed", tag="generation")
+                _GEN_SHED.labels(reason="deadline").inc()
+                self._finish_cb(
+                    req, [], "shed",
+                    error="deadline cannot be met by the decode loop",
+                    retry_after_s=_qos.retry_after_s(
+                        len(self._backlog), ema, self.n_slots))
+                continue
+            keep.append(req)
+        self._backlog = keep
+
     def _admission_open(self) -> bool:
         if self.admit_policy == "continuous":
-            return any(s is None for s in self._slots)
+            return any(s is None for s in self._slots) or bool(
+                self._backlog and self._backlog[0].priority == "critical")
         # run-to-completion: only between waves, and only once a FULL wave is
         # pending (or the batching window expired) — partial waves would
         # understate the baseline this mode exists to represent
         if any(s is not None for s in self._slots):
             return False
-        if self._pending.qsize() >= self.n_slots:
+        if len(self._backlog) >= self.n_slots:
             return True
         since = self._pending_since
         return since is not None and \
             time.monotonic() - since >= self.batch_window_s
 
+    def _preempt_for(self, req: _Request) -> bool:
+        """Make room for a critical request by preempting a BULK slot: the
+        victim's host state (pages included — its KV cache contents stay
+        exactly where they are) parks on the preempted list and resumes in
+        a later free slot with nothing recomputed. Returns True when a slot
+        was freed."""
+        if req.priority != "critical":
+            return False
+        with self._lock:
+            victims = [(s.request.order_key, i) for i, s in
+                       enumerate(self._slots)
+                       if s is not None and s.request.priority == "bulk"]
+            if not victims:
+                return False
+            # preempt the LEAST urgent bulk stream (max order key)
+            _, idx = max(victims)
+            slot = self._slots[idx]
+            self._slots[idx] = None
+            self._table[idx, :] = SCRATCH_PAGE
+            self._preempted.append(slot)
+        _GEN_PREEMPT.inc()
+        logger.info("generation: preempted bulk stream %s for critical %s",
+                    slot.request.uri, req.uri)
+        return True
+
+    def _resume_slot(self, parked: _Slot) -> None:
+        """Re-install a preempted stream into a free slot: restore its page
+        table row from the pages it kept and continue decoding — no
+        prefill, no token loss."""
+        if parked.request.cancelled:
+            with self._lock:
+                self.pool.release(parked.pages)
+                parked.pages = []
+            self._finish_cb(parked.request, [], "cancelled")
+            return
+        with self._lock:
+            idx = self._slots.index(None)
+            self._table[idx, :] = SCRATCH_PAGE
+            self._table[idx, :len(parked.pages)] = parked.pages
+            self._slots[idx] = parked
+
     def _admit(self):
+        self._drain_pending()
         # the policy gate opens ONCE per loop pass; a wave then fills every
         # free slot (checking the gate per-request would seal a batch-mode
         # wave after its first admission)
         if not self._admission_open():
             return
-        while any(s is None for s in self._slots) and not self._stop.is_set():
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
+        while not self._stop.is_set():
+            # next admission candidate: preempted streams compete with the
+            # backlog under the same (priority, deadline) order — a parked
+            # bulk stream does not jump a queued critical request
+            with self._lock:
+                cand_resume = min(self._preempted,
+                                  key=lambda s: s.request.order_key,
+                                  default=None)
+            cand_new: Optional[_Request] = \
+                self._backlog[0] if self._backlog else None
+            if cand_resume is not None and (
+                    cand_new is None
+                    or cand_resume.request.order_key <= cand_new.order_key):
+                if not any(s is None for s in self._slots):
+                    return
+                with self._lock:
+                    self._preempted.remove(cand_resume)
+                self._resume_slot(cand_resume)
+                continue
+            if cand_new is None:
                 return
+            if not any(s is None for s in self._slots):
+                # full house: a critical head may evict a bulk slot (pages
+                # intact); anything else waits for a retirement
+                if not self._preempt_for(cand_new):
+                    return
+            req = self._backlog.pop(0)
             if req.uri in self._cancelled_uris:
                 self._cancelled_uris.remove(req.uri)
                 req.cancelled = True
@@ -460,8 +617,19 @@ class ContinuousBatcher:
                                           f"pool capacity "
                                           f"{self.pool.capacity}")
                     continue
-                # pool temporarily dry: requeue and wait for retirements
-                self._pending.put(req)
+                # pool temporarily dry: park at the backlog head (ordered
+                # admission keeps it first in class) and wait for retirements
+                self._backlog.insert(0, req)
+                if self.active_slots() == 0 and self._preempted:
+                    # every page is held by PARKED streams (preempt took the
+                    # victims' slots but not their pages): resume one so the
+                    # pool can ever drain — otherwise the critical head and
+                    # the parked bulk would deadlock each other
+                    with self._lock:
+                        parked = min(self._preempted,
+                                     key=lambda s: s.request.order_key)
+                        self._preempted.remove(parked)
+                    self._resume_slot(parked)
                 return
             except Exception as e:   # a bad request must not kill the loop
                 logger.exception("prefill failed for %s", req.uri)
@@ -548,10 +716,12 @@ class ContinuousBatcher:
         if not active:
             return
         self.decode_shapes.add((b, cfg.pages_per_slot, cfg.page_size))
+        t0 = time.monotonic()
         next_ids, _logits, self.cache = self._decode(
             self.params, self.cache, ids, lengths, table, seeds, tok_idx,
             temps)
         next_ids = np.asarray(next_ids)
+        self.step_ema.observe(time.monotonic() - t0)
         self.steps += 1
         _GEN_STEPS.inc()
         _mw.sample("serving.decode")
@@ -619,13 +789,18 @@ class ContinuousBatcher:
         return (slot.request, [], outcome, error, slot.generated)
 
     def _finish_cb(self, req: _Request, tokens: List[int], outcome: str,
-                   error: Optional[str] = None, n_tokens: int = 0):
+                   error: Optional[str] = None, n_tokens: int = 0,
+                   retry_after_s: Optional[float] = None):
         self.requests_finished[outcome] = \
             self.requests_finished.get(outcome, 0) + 1
         _GEN_REQS.labels(outcome=outcome).inc()
         meta = {"uri": req.uri, "outcome": outcome, "n_tokens": n_tokens}
         if error:
             meta["error"] = error
+        if retry_after_s is not None:
+            # shed outcomes: the computed backoff rides the final frame so
+            # HTTP/broker consumers can relay an honest Retry-After
+            meta["retry_after_s"] = round(retry_after_s, 4)
         if req.on_chunk is not None:
             try:
                 req.on_chunk(tokens, True, meta)
@@ -705,9 +880,13 @@ class ContinuousBatcher:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             active = sum(s is not None for s in self._slots)
+            preempted = len(self._preempted)
         return {
             "slots": self.n_slots,
             "active_slots": active,
+            "preempted_parked": preempted,
+            "backlog": len(self._backlog),
+            "step_ema_s": round(self.step_ema.value(), 6),
             "free_pages": self.pool.free_count(),
             "page_capacity": self.pool.capacity,
             "steps": self.steps,
@@ -857,7 +1036,11 @@ class GenerationEngine:
                 temperature=float(payload.get("temperature", 0.0)),
                 seed=int(payload.get("seed", 0)),
                 eos_id=(int(payload["eos_id"])
-                        if payload.get("eos_id") is not None else None))
+                        if payload.get("eos_id") is not None else None),
+                # overload QoS rides the payload (durable across AOF replay
+                # and failover requeue); absent from old clients
+                priority=payload_priority(payload),
+                deadline=payload_deadline(payload))
         except Exception as e:
             logger.exception("malformed generation request %s", entry_id)
             self._sink_q.put(("chunk", entry_id, uri, 0, [],
@@ -911,7 +1094,8 @@ class GenerationEngine:
                     if final:
                         frame.update({k: v for k, v in meta.items()
                                       if k in ("outcome", "error",
-                                               "n_tokens")})
+                                               "n_tokens",
+                                               "retry_after_s")})
                     if ctx is not None:
                         frame[TRACE_KEY] = ctx
                     conn.call("XADD", GEN_OUT_PREFIX + uri, frame)
@@ -962,15 +1146,29 @@ class GenerationClient:
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, seed: int = 0,
                eos_id: Optional[int] = None,
-               uri: Optional[str] = None) -> str:
-        """Enqueue one generation request; returns its stream id."""
+               uri: Optional[str] = None,
+               priority: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               deadline: Optional[float] = None) -> str:
+        """Enqueue one generation request; returns its stream id.
+        ``priority``/``deadline_ms`` (or absolute ``deadline``) arm
+        (priority, deadline)-ordered admission and deadline shedding at the
+        decode tier — a shed stream's final frame reports outcome ``shed``
+        with a computed ``retry_after_s``."""
         uri = uri or uuid.uuid4().hex
+        dl = _qos.normalize_deadline(deadline)
+        if dl is None:
+            dl = _qos.deadline_from_ms(deadline_ms)
         with _tm.span("serving.gen.send", uri=uri) as sp:
             payload = {"uri": uri, TRACE_KEY: sp.wire_context(),
                        "prompt": np.asarray(prompt, np.int32).reshape(-1),
                        "max_new_tokens": int(max_new_tokens),
                        "temperature": float(temperature), "seed": int(seed),
                        "eos_id": int(eos_id) if eos_id is not None else None}
+            if priority is not None:
+                payload[PRIORITY_KEY] = _qos.normalize_priority(priority)
+            if dl is not None:
+                payload[DEADLINE_KEY] = dl
             self._conn.call("XADD", GEN_STREAM, payload)
         return uri
 
@@ -1003,6 +1201,13 @@ class GenerationClient:
                         self._conn.call("XDELSTREAM", stream_key)
                     except Exception:   # cleanup is best-effort
                         pass
+                    if frame.get("outcome") == "shed":
+                        raise _qos.ShedError(
+                            f"generation request {uri!r} shed: "
+                            f"{frame.get('error', 'overloaded')}",
+                            retry_after_s=float(
+                                frame.get("retry_after_s", 1.0)),
+                            reason="deadline")
                     if frame.get("error") or frame.get("outcome") == "error":
                         raise RuntimeError(
                             f"generation failed for {uri!r}: "

@@ -49,10 +49,12 @@ from typing import Optional
 import numpy as np
 
 from ..common import telemetry as _tm
+from ..common.chaos import chaos_point
 from ..common.locks import traced_lock
 from ..common.resilience import (CircuitBreaker, CircuitOpenError,
                                  HealthRegistry, ResilienceError)
 from ..inference.summary import timing, timing_stats
+from . import qos as _qos
 from .client import InputQueue, OutputQueue
 from .config import ServingConfig
 from .wire import wire_stats
@@ -61,7 +63,17 @@ _HTTP_REQS = _tm.counter("zoo_http_requests_total",
                          "HTTP /predict requests by final status code",
                          labels=("code",))
 _HTTP_SHED = _tm.counter("zoo_http_shed_total",
-                         "Requests shed with 503 (admission or breaker)")
+                         "Requests shed with 503, by overload class "
+                         "(admission = bounded-queue full, breaker = "
+                         "circuit open, deadline = provably unmeetable, "
+                         "backend = downstream tier shed it)",
+                         labels=("reason",))
+
+# HTTP header twins of the payload/wire QoS fields (serving/qos.py):
+# X-Zoo-Priority: critical|normal|bulk; X-Zoo-Deadline-Ms: relative latency
+# budget in milliseconds (converted to an absolute deadline at receipt)
+PRIORITY_HEADER = "X-Zoo-Priority"
+DEADLINE_HEADER = "X-Zoo-Deadline-Ms"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -87,14 +99,36 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _respond_shed(self, retry_after_s: float, reason: str) -> None:
-        data = json.dumps({"error": reason}).encode("utf-8")
+    def _respond_shed(self, retry_after_s: float, reason: str,
+                      shed_reason: str = "admission") -> None:
+        """503 + computed Retry-After. The header is integer seconds
+        (RFC 9110, rounded UP so clients never retry early); the JSON body
+        carries the precise float and the overload class."""
+        retry_after_s = max(_qos.MIN_RETRY_AFTER_S, float(retry_after_s))
+        data = json.dumps({"error": reason,
+                           "retry_after_s": round(retry_after_s, 4),
+                           "shed_reason": shed_reason}).encode("utf-8")
         self.send_response(503)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
-        self.send_header("Retry-After", str(max(1, int(retry_after_s + 0.5))))
+        self.send_header("Retry-After",
+                         str(max(1, int(-(-retry_after_s // 1)))))
         self.end_headers()
         self.wfile.write(data)
+
+    def _request_qos(self):
+        """(priority, absolute deadline) from the request headers — absent
+        headers (old clients) behave exactly as before."""
+        pri = self.headers.get(PRIORITY_HEADER)
+        dl_ms = self.headers.get(DEADLINE_HEADER)
+        deadline = None
+        if dl_ms is not None:
+            try:
+                deadline = _qos.deadline_from_ms(float(dl_ms))
+            except (TypeError, ValueError):
+                deadline = None
+        return (_qos.normalize_priority(pri) if pri is not None else None,
+                deadline)
 
     def do_GET(self):
         app: "FrontEndApp" = self.server.app  # type: ignore[attr-defined]
@@ -157,14 +191,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(404, {"error": f"no route {self.path}"})
             return
         app: "FrontEndApp" = self.server.app  # type: ignore[attr-defined]
-        if not app._admit():
-            # bounded queue full: shed instead of queueing unbounded work
+        priority, deadline = self._request_qos()
+        admitted, retry_after, reason = app._admit(priority, deadline)
+        if not admitted:
+            # bounded queue full / provably unmeetable deadline: shed with
+            # an HONEST Retry-After (queue depth × measured service time)
+            # instead of queueing work that will only time out
             app.shed_requests += 1
-            _HTTP_SHED.inc()
+            _HTTP_SHED.labels(reason=reason).inc()
             _HTTP_REQS.labels(code="503").inc()
-            self._respond_shed(1.0, "server overloaded, request shed")
+            self._respond_shed(retry_after,
+                               "server overloaded, request shed",
+                               shed_reason=reason)
             return
         code = "500"
+        t_start = time.monotonic()
+        n_served = 0
         try:
             n = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(n) or b"{}")
@@ -176,7 +218,9 @@ class _Handler(BaseHTTPRequestHandler):
             with timing("http.predict"), \
                     _tm.span("serving.http.predict", n=len(instances)):
                 preds, versions = app.predict_instances(
-                    instances, timeout_s=app.timeout_s)
+                    instances, timeout_s=app.timeout_s,
+                    priority=priority, deadline=deadline)
+            n_served = len(instances)
             code = "200"
             body = {"predictions": preds}
             # hot-swap attribution: which model version(s) served this
@@ -190,20 +234,35 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             code = "400"
             self._respond(400, {"error": str(e)})
+        except _qos.ShedError as e:
+            # a downstream tier (router, micro-batcher, engine) shed this
+            # request; relay ITS computed Retry-After to the client
+            code = "503"
+            app.shed_requests += 1
+            _HTTP_SHED.labels(reason=e.reason).inc()
+            self._respond_shed(e.retry_after_s, str(e),
+                               shed_reason=e.reason)
         except CircuitOpenError as e:
             code = "503"
-            _HTTP_SHED.inc()
-            self._respond_shed(e.retry_after_s, str(e))
+            _HTTP_SHED.labels(reason="breaker").inc()
+            self._respond_shed(e.retry_after_s, str(e),
+                               shed_reason="breaker")
         except TimeoutError as e:
             code = "504"
             self._respond(504, {"error": str(e)})
         except ResilienceError as e:   # broker unreachable after retries
             code = "503"
-            _HTTP_SHED.inc()
-            self._respond_shed(1.0, str(e))
+            _HTTP_SHED.labels(reason="breaker").inc()
+            self._respond_shed(app.retry_after_s(), str(e),
+                               shed_reason="breaker")
         except Exception as e:  # pragma: no cover
             self._respond(500, {"error": str(e)})
         finally:
+            if n_served:
+                # measured per-record service time: the evidence behind the
+                # admission tier's shed decisions and computed Retry-After
+                app.service_ema.observe(
+                    (time.monotonic() - t_start) / n_served)
             _HTTP_REQS.labels(code=code).inc()
             app._release()
 
@@ -238,11 +297,15 @@ class _Handler(BaseHTTPRequestHandler):
         inter-token latency instead of request latency. ``stream: false``
         accumulates and answers one JSON object (old one-shot shape)."""
         app: "FrontEndApp" = self.server.app  # type: ignore[attr-defined]
-        if not app._admit():
+        priority, deadline = self._request_qos()
+        admitted, retry_after, reason = app._admit(priority, deadline)
+        if not admitted:
             app.shed_requests += 1
-            _HTTP_SHED.inc()
+            _HTTP_SHED.labels(reason=reason).inc()
             _HTTP_REQS.labels(code="503").inc()
-            self._respond_shed(1.0, "server overloaded, request shed")
+            self._respond_shed(retry_after,
+                               "server overloaded, request shed",
+                               shed_reason=reason)
             return
         code = "500"
         headers_sent = False
@@ -261,13 +324,20 @@ class _Handler(BaseHTTPRequestHandler):
                               if body.get("eos_id") is not None else None))
             with _tm.span("serving.http.generate", n=len(prompt)):
                 frames = app.generate_frames(prompt, timeout_s=app.timeout_s,
-                                             **kw)
+                                             priority=priority,
+                                             deadline=deadline, **kw)
                 if not stream:
                     tokens, meta = [], {}
                     for toks, final, m in frames:
                         tokens.extend(toks)
                         if final:
                             meta = m
+                    if meta.get("outcome") == "shed":
+                        raise _qos.ShedError(
+                            meta.get("error", "generation request shed"),
+                            retry_after_s=float(
+                                meta.get("retry_after_s", 1.0)),
+                            reason="deadline")
                     if meta.get("error"):
                         raise RuntimeError(meta["error"])
                     code = "200"
@@ -284,7 +354,8 @@ class _Handler(BaseHTTPRequestHandler):
                     line = {"tokens": list(toks), "final": bool(final)}
                     if final:
                         line.update({k: meta[k] for k in
-                                     ("outcome", "error", "n_tokens")
+                                     ("outcome", "error", "n_tokens",
+                                      "retry_after_s")
                                      if k in meta})
                     self._write_chunk(json.dumps(line).encode("utf-8")
                                       + b"\n")
@@ -301,6 +372,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._abort_stream(str(e))
             else:
                 self._respond(400, {"error": str(e)})
+        except _qos.ShedError as e:
+            code = "503"
+            app.shed_requests += 1
+            _HTTP_SHED.labels(reason=e.reason).inc()
+            if headers_sent:
+                self._abort_stream(str(e))
+            else:
+                self._respond_shed(e.retry_after_s, str(e),
+                                   shed_reason=e.reason)
         except TimeoutError as e:
             code = "504"
             if headers_sent:
@@ -353,10 +433,19 @@ class FrontEndApp:
         self._engine_stats = engine_stats
         # load shedding: at most max_inflight concurrently admitted /predict
         # requests; excess answers 503 + Retry-After immediately
-        self._admission = threading.Semaphore(
-            max_inflight if max_inflight is not None
-            else self.config.http_max_inflight)
+        self.max_inflight = (max_inflight if max_inflight is not None
+                             else self.config.http_max_inflight)
+        self._admission = threading.Semaphore(self.max_inflight)
         self.shed_requests = 0
+        # overload QoS: measured per-record service time feeds the computed
+        # Retry-After and the deadline-admission proof; bulk traffic admits
+        # only up to a fraction of the inflight budget so critical requests
+        # always find headroom under sustained overload
+        self.service_ema = _qos.ServiceTimeEMA()
+        self.default_priority = _qos.normalize_priority(
+            getattr(self.config, "default_priority", None))
+        frac = float(getattr(self.config, "bulk_inflight_fraction", 0.5))
+        self._bulk_max = max(1, int(self.max_inflight * min(1.0, frac)))
         # broker-path breaker: consecutive failures (timeouts, dead broker)
         # open it and /predict fails fast until a half-open probe succeeds
         self.breaker = breaker if breaker is not None else CircuitBreaker(
@@ -403,14 +492,55 @@ class FrontEndApp:
         return {}
 
     # -- load shedding / readiness -------------------------------------------
-    def _admit(self) -> bool:
+    def retry_after_s(self) -> float:
+        """Honest backoff hint: the current admitted backlog's drain
+        estimate — what the fixed ``Retry-After: 1`` used to fake.
+        ``service_ema`` is whole-request WALL time and admitted requests
+        run concurrently (up to ``max_inflight``), so the estimate divides
+        by that concurrency — multiplying depth by wall time would double-
+        count the parallelism and inflate the hint."""
+        with self._inflight_lock:
+            inflight = self._inflight
+        return _qos.retry_after_s(inflight, self.service_ema.value(),
+                                  self.max_inflight)
+
+    def _admit(self, priority: Optional[str] = None,
+               deadline: Optional[float] = None) -> tuple:
+        """Admission decision: ``(admitted, retry_after_s, reason)``.
+
+        Sheds BEFORE any work is done when (a) draining, (b) the request's
+        deadline provably cannot be met (estimated wait = inflight ×
+        measured service time already overruns it), (c) a bulk-class
+        request would push past the bulk watermark (critical/normal keep
+        the remaining headroom), or (d) the inflight budget is exhausted."""
+        priority = (priority if priority is not None
+                    else self.default_priority)
         if self._draining:
-            return False         # draining: shed before any work is accepted
+            return False, self.retry_after_s(), "admission"
+        ema = self.service_ema.value()
+        with self._inflight_lock:
+            inflight = self._inflight
+        # service_ema is whole-request WALL time (it already contains the
+        # downstream batcher/broker queueing) and admitted requests run
+        # CONCURRENTLY — the wait estimate must divide by that concurrency,
+        # or steady parallel traffic would look like a serial backlog and
+        # shed requests that would comfortably meet their deadline
+        est = _qos.estimated_wait_s(inflight, ema, self.max_inflight)
+        if _qos.cannot_meet(deadline, est, ema):
+            chaos_point("overload.shed", tag="frontend")
+            return False, _qos.retry_after_s(inflight, ema,
+                                             self.max_inflight), "deadline"
+        if (_qos.priority_rank(priority) == _qos.PRIORITY_RANK["bulk"]
+                and inflight >= self._bulk_max):
+            chaos_point("overload.shed", tag="frontend")
+            return False, _qos.retry_after_s(inflight, ema,
+                                             self.max_inflight), "admission"
         if not self._admission.acquire(blocking=False):
-            return False
+            return False, _qos.retry_after_s(inflight, ema,
+                                             self.max_inflight), "admission"
         with self._inflight_lock:
             self._inflight += 1
-        return True
+        return True, 0.0, ""
 
     def _release(self) -> None:
         with self._inflight_lock:
@@ -469,11 +599,16 @@ class FrontEndApp:
         else:
             self._oq_pool.put(oq)
 
-    def predict_instances(self, instances, timeout_s: float = 30.0):
+    def predict_instances(self, instances, timeout_s: float = 30.0,
+                          priority: Optional[str] = None,
+                          deadline: Optional[float] = None):
         """Returns ``(predictions, versions)`` where ``versions`` is the
         deduped (order-preserving) list of serving model versions that
         produced them — normally one entry; two legitimately appear when a
-        hot-swap lands between instances of one request."""
+        hot-swap lands between instances of one request. ``priority`` /
+        ``deadline`` ride to the micro-batcher (direct mode) or the queue
+        payload (broker mode) so every downstream tier orders and sheds on
+        them."""
         parsed = []
         for inst in instances:
             if not isinstance(inst, dict) or not inst:
@@ -481,7 +616,9 @@ class FrontEndApp:
             parsed.append({k: np.asarray(v) for k, v in inst.items()})
         if self._batcher is not None:
             # submit every instance first so one request's records share a batch
-            slots = [self._batcher.submit_async(t) for t in parsed]
+            slots = [self._batcher.submit_async(t, priority=priority,
+                                                deadline=deadline)
+                     for t in parsed]
             out = []
             for slot in slots:
                 val = self._batcher.wait(slot, timeout_s=timeout_s)
@@ -497,7 +634,9 @@ class FrontEndApp:
                                    self.breaker.retry_after_s())
         versions: list = []
         try:
-            uris = [self._input.enqueue(None, **tensors) for tensors in parsed]
+            uris = [self._input.enqueue(None, priority=priority,
+                                        deadline=deadline, **tensors)
+                    for tensors in parsed]
             out = []
             with self._output() as oq:
                 for uri in uris:
@@ -541,13 +680,17 @@ class FrontEndApp:
         else:
             self._gc_pool.put(gc)
 
-    def generate_frames(self, prompt, timeout_s: float = 30.0, **kw):
+    def generate_frames(self, prompt, timeout_s: float = 30.0,
+                        priority: Optional[str] = None,
+                        deadline: Optional[float] = None, **kw):
         """Yield ``(tokens, final, meta)`` frames for one generation request
         — in-process when a generator (ContinuousBatcher) was attached,
         otherwise through the broker's generation engine. An abandoned
         consumer (client disconnect mid-stream, timeout) CANCELS the
         underlying request — otherwise the decode loop would keep burning a
         slot + KV pages to max_new_tokens for output nobody reads."""
+        if priority is not None or deadline is not None:
+            kw.update(priority=priority, deadline=deadline)
         if self._generator is not None:
             handle = self._generator.submit(prompt, **kw)
             try:
@@ -564,6 +707,11 @@ class FrontEndApp:
                     for chunk in gc.stream(uri, timeout_s=timeout_s):
                         n += len(chunk)
                         yield chunk.tolist(), False, {}
+                except _qos.ShedError as e:
+                    finished = True      # terminal shed frame consumed
+                    yield [], True, {"outcome": "shed", "error": str(e),
+                                     "retry_after_s": e.retry_after_s}
+                    return
                 except RuntimeError as e:
                     finished = True      # terminal frame consumed (error)
                     yield [], True, {"outcome": "error", "error": str(e)}

@@ -1,0 +1,193 @@
+"""Serving quality-of-service primitives: priorities, deadlines, shedding.
+
+The reference platform's cluster serving is built for sustained heavy
+traffic, but under overload a FIFO queue is the worst possible policy: every
+request — latency-critical and bulk alike — waits behind the whole backlog
+until it times out, so at 2× capacity NOTHING meets its SLO. This module is
+the shared vocabulary the whole serving data plane (frontend admission,
+:class:`~.fleet.ReplicaRouter`, :class:`~.batching.MicroBatcher`,
+:class:`~.generation.ContinuousBatcher`) uses to do better:
+
+* **Priorities** — ``critical`` / ``normal`` / ``bulk``, ordered. Eligible
+  work is served in ``(priority, deadline)`` order; latency-critical traffic
+  may preempt bulk generation slots.
+* **Deadlines** — absolute wall-clock (``time.time()`` epoch seconds, so
+  they survive process boundaries, broker streams, AOF replay and
+  ``XTRANSFER`` requeues). Every tier sheds a request that *provably cannot
+  meet its deadline* BEFORE doing its work — estimated wait (measured
+  service time × queue depth) is the proof — and answers with an honest
+  computed ``Retry-After`` instead of the constant ``1`` the frontend used
+  to send.
+* **Shedding** — :class:`ShedError` carries ``retry_after_s`` end to end:
+  raised by :meth:`~.client.OutputQueue.query` on a shed result payload,
+  mapped to HTTP 503 + ``Retry-After`` by the frontend, and honored as the
+  backoff floor by :class:`~..common.resilience.RetryPolicy`.
+
+Everything here is deliberately dependency-free host code — the decisions
+run per-request on the hot path and must cost microseconds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+# ordered: lower rank = served first. Unknown strings normalize to "normal"
+# (an old or foreign client must never be rejected over a QoS label).
+PRIORITIES: Tuple[str, ...] = ("critical", "normal", "bulk")
+PRIORITY_RANK: Dict[str, int] = {p: i for i, p in enumerate(PRIORITIES)}
+DEFAULT_PRIORITY = "normal"
+
+# a shed answer must never tell the client "retry immediately": even an
+# empty queue costs one service time to drain the request that triggered
+# the shed decision
+MIN_RETRY_AFTER_S = 0.05
+
+
+def normalize_priority(priority: Any) -> str:
+    """Tolerant read of a priority label: unknown/absent → ``normal``."""
+    if isinstance(priority, str):
+        p = priority.strip().lower()
+        if p in PRIORITY_RANK:
+            return p
+    return DEFAULT_PRIORITY
+
+
+def priority_rank(priority: Any) -> int:
+    return PRIORITY_RANK[normalize_priority(priority)]
+
+
+def normalize_deadline(deadline: Any) -> Optional[float]:
+    """Tolerant read of an absolute wall-clock deadline (epoch seconds).
+    Anything non-numeric or non-positive → ``None`` (no deadline)."""
+    if isinstance(deadline, bool):
+        return None
+    if isinstance(deadline, (int, float)) and deadline > 0:
+        return float(deadline)
+    return None
+
+
+def deadline_from_ms(deadline_ms: Optional[float],
+                     now: Optional[float] = None) -> Optional[float]:
+    """Relative budget (ms from now — the client/HTTP-header shape) →
+    absolute epoch-seconds deadline (the wire/payload shape)."""
+    if deadline_ms is None:
+        return None
+    return (time.time() if now is None else now) + float(deadline_ms) / 1e3
+
+
+def order_key(priority: Any, deadline: Any, seq: Any = 0) -> Tuple:
+    """Sort key for eligible work: ``(priority rank, deadline, FIFO seq)``.
+    Deadline-less requests sort after dated ones within a priority class
+    (they declared no urgency); ``seq`` keeps the order total and FIFO-fair
+    within a class."""
+    dl = normalize_deadline(deadline)
+    return (priority_rank(priority),
+            dl if dl is not None else float("inf"), seq)
+
+
+class ShedError(RuntimeError):
+    """A request was shed by an overloaded tier instead of being served.
+
+    ``retry_after_s`` is the server's honest drain estimate (queue depth ×
+    measured service time) — the client should back off at least this long.
+    Subclasses :class:`RuntimeError` so pre-QoS handlers that catch generic
+    serving errors keep working.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 reason: str = "admission"):
+        super().__init__(message)
+        self.retry_after_s = max(MIN_RETRY_AFTER_S, float(retry_after_s))
+        self.reason = reason
+
+
+def shed_payload(message: str, retry_after_s: float,
+                 reason: str = "admission") -> Dict[str, Any]:
+    """The result-hash payload a shedding tier writes for a queued request:
+    the client's :meth:`OutputQueue.query` turns it back into a
+    :class:`ShedError` carrying the same ``retry_after_s``."""
+    return {"error": message, "shed": True,
+            "retry_after_s": round(max(MIN_RETRY_AFTER_S,
+                                       float(retry_after_s)), 4),
+            "shed_reason": reason}
+
+
+def shed_error_from_payload(payload: Dict[str, Any],
+                            uri: str) -> Optional[ShedError]:
+    """Rebuild the :class:`ShedError` a shed result payload encodes (or
+    ``None`` for ordinary results/errors)."""
+    if isinstance(payload, dict) and payload.get("shed"):
+        return ShedError(
+            f"request {uri!r} shed: {payload.get('error', 'overloaded')}",
+            retry_after_s=float(payload.get("retry_after_s", 1.0)),
+            reason=str(payload.get("shed_reason", "admission")))
+    return None
+
+
+class ServiceTimeEMA:
+    """Thread-safe EMA of observed service seconds — the measured half of
+    every tier's ``estimated wait = service time × queue depth`` shed proof.
+    ``value()`` is 0.0 until the first observation (no evidence → no
+    evidence-based shedding; expired deadlines still shed)."""
+
+    def __init__(self, alpha: float = 0.2):
+        self.alpha = float(alpha)
+        self._value = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        seconds = max(0.0, float(seconds))
+        with self._lock:
+            self._n += 1
+            self._value = (seconds if self._n == 1
+                           else (1 - self.alpha) * self._value
+                           + self.alpha * seconds)
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def observations(self) -> int:
+        with self._lock:
+            return self._n
+
+
+def estimated_wait_s(queue_depth: int, service_ema_s: float,
+                     concurrency: int = 1) -> float:
+    """Expected time for ``queue_depth`` queued records to drain through
+    ``concurrency`` parallel servers of measured ``service_ema_s`` each —
+    the wait a newly admitted request would sit through before service."""
+    if service_ema_s <= 0.0:
+        return 0.0
+    return (max(0, int(queue_depth)) * float(service_ema_s)
+            / max(1, int(concurrency)))
+
+
+def cannot_meet(deadline: Any, est_wait_s: float, service_ema_s: float = 0.0,
+                now: Optional[float] = None) -> bool:
+    """True when a request with ``deadline`` provably cannot be served in
+    time: already expired, or the estimated queue wait plus one service time
+    overruns it. Deadline-less requests always pass."""
+    dl = normalize_deadline(deadline)
+    if dl is None:
+        return False
+    t = time.time() if now is None else now
+    return t + max(0.0, est_wait_s) + max(0.0, service_ema_s) > dl
+
+
+def retry_after_s(queue_depth: int, service_ema_s: float,
+                  concurrency: int = 1) -> float:
+    """Honest ``Retry-After``: the current backlog's drain estimate, floored
+    so a client never hammers an overloaded server at 0s intervals."""
+    return max(MIN_RETRY_AFTER_S,
+               estimated_wait_s(queue_depth, service_ema_s, concurrency))
+
+
+__all__ = ["DEFAULT_PRIORITY", "MIN_RETRY_AFTER_S", "PRIORITIES",
+           "PRIORITY_RANK", "ServiceTimeEMA", "ShedError", "cannot_meet",
+           "deadline_from_ms", "estimated_wait_s", "normalize_deadline",
+           "normalize_priority", "order_key", "priority_rank",
+           "retry_after_s", "shed_error_from_payload", "shed_payload"]

@@ -38,6 +38,36 @@ TRACE_KEY = "trace"
 # client. Absent from pre-hot-swap engines — consumers must tolerate that.
 MODEL_VERSION_KEY = "model_version"
 
+# Overload QoS fields carried inside REQUEST payload dicts (the durable
+# twins of the binary frame header's "p"/"dl" fields — serving/qos.py):
+# ``priority`` is one of critical/normal/bulk, ``deadline`` an absolute
+# wall-clock epoch-seconds float. Both survive the broker stream, AOF
+# replay, and XTRANSFER failover requeues — a requeued request keeps its
+# ORIGINAL deadline (and is shed, not served, if it expired in flight).
+# Old clients omit them; every consumer tolerates absence.
+PRIORITY_KEY = "priority"
+DEADLINE_KEY = "deadline"
+
+
+def payload_priority(payload: Any) -> str:
+    """Tolerant read of a request payload's priority class (``normal``
+    when absent/malformed — old-client records stay first-class)."""
+    from .qos import normalize_priority
+
+    if isinstance(payload, dict):
+        return normalize_priority(payload.get(PRIORITY_KEY))
+    return normalize_priority(None)
+
+
+def payload_deadline(payload: Any) -> Optional[float]:
+    """Tolerant read of a request payload's absolute deadline (epoch
+    seconds; ``None`` when absent/malformed)."""
+    from .qos import normalize_deadline
+
+    if isinstance(payload, dict):
+        return normalize_deadline(payload.get(DEADLINE_KEY))
+    return None
+
 
 def payload_model_version(payload: Any) -> Optional[str]:
     """Tolerant read of a result payload's serving model version."""

@@ -74,6 +74,15 @@ def main(argv=None) -> int:
                          "config `fleet: replicas`, else 1 = classic single "
                          "engine). >1 enables health-routed dispatch, "
                          "failover requeue, and rolling `cli drain`/restart")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="enable queue-driven autoscaling (fleet mode even "
+                         "at 1 replica): the supervisor spawns replicas on "
+                         "sustained zoo_fleet_queue_depth pressure up to "
+                         "--max-replicas and drains them back down to "
+                         "--min-replicas when idle, zero-loss (YAML "
+                         "`autoscale:` section sets the thresholds)")
+    ap.add_argument("--min-replicas", type=int, default=None)
+    ap.add_argument("--max-replicas", type=int, default=None)
     ap.add_argument("--int8", action="store_true")
     ap.add_argument("--no-hot-swap", action="store_true",
                     help="ignore the trainer's model_updates publish stream "
@@ -116,6 +125,12 @@ def main(argv=None) -> int:
 
     if args.replicas is not None:
         cfg.replicas = args.replicas
+    if args.autoscale:
+        cfg.autoscale = True
+    if args.min_replicas is not None:
+        cfg.min_replicas = args.min_replicas
+    if args.max_replicas is not None:
+        cfg.max_replicas = args.max_replicas
     if args.no_hot_swap:
         cfg.hot_swap = False
 
@@ -124,9 +139,11 @@ def main(argv=None) -> int:
     # frontend's /healthz, so an orchestrator probes the whole pipeline
     registry = HealthRegistry(default_timeout_s=cfg.heartbeat_timeout_s)
     ready_fn = None
-    if cfg.replicas > 1:
+    if cfg.replicas > 1 or cfg.autoscale:
         # fleet mode: router + N supervised replicas; /readyz reflects the
-        # eligible-replica count, `cli drain`/`rolling-restart` work
+        # eligible-replica count, `cli drain`/`rolling-restart` work.
+        # Autoscaling implies fleet mode even at 1 replica — the supervisor
+        # owns the spawn/drain lifecycle the autoscaler drives
         demo_module = (_demo_model() if args.demo and not cfg.model_path
                        else None)
         if cfg.fleet_spawn == "process" and demo_module is not None:

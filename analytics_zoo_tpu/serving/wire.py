@@ -134,6 +134,32 @@ def received_model_version() -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
+# overload-QoS propagation: binary frames carry optional header fields "p"
+# (priority class: critical/normal/bulk) and "dl" (absolute wall-clock
+# deadline, epoch seconds) — the wire twins of the payload's
+# priority/deadline fields (schema.py). Same compat pattern as the PR-3
+# trace field and PR-10 version field: old decoders ignore unknown header
+# keys, old senders omit them, both directions tolerate absence. Set
+# ambiently per thread around a send; read after recv.
+# ---------------------------------------------------------------------------
+
+def set_wire_qos(priority: Optional[str] = None,
+                 deadline: Optional[float] = None) -> None:
+    """Tag binary frames SENT from this thread with an overload-QoS pair
+    (header fields "p"/"dl"); ``(None, None)`` clears the tag."""
+    _TLS.send_priority = priority
+    _TLS.send_deadline = deadline
+
+
+def received_qos() -> Tuple[Optional[str], Optional[float]]:
+    """``(priority, deadline)`` carried by the last frame ``recv_msg``
+    returned on THIS thread — ``(None, None)`` for JSON frames, old
+    senders, or untagged frames."""
+    return (getattr(_TLS, "recv_priority", None),
+            getattr(_TLS, "recv_deadline", None))
+
+
+# ---------------------------------------------------------------------------
 # msgpack subset (nil/bool/int/float64/str/bin/array/map — standard format
 # codes, interoperable with any msgpack reader)
 # ---------------------------------------------------------------------------
@@ -470,6 +496,12 @@ def send_msg(sock: socket.socket, obj: Any, shm=None) -> None:
     ver = getattr(_TLS, "send_version", None)
     if ver is not None:
         meta["v"] = str(ver)
+    pri = getattr(_TLS, "send_priority", None)
+    if pri is not None:
+        meta["p"] = str(pri)
+    dl = getattr(_TLS, "send_deadline", None)
+    if dl is not None:
+        meta["dl"] = float(dl)
     header = pack(meta)
     inline_bytes = sum(len(m) for m in inline)
     total = _PRE.size + len(header) + inline_bytes
@@ -513,6 +545,8 @@ def recv_msg(sock: socket.socket, shm=None) -> Any:
         _account(bytes_received=4 + n, frames_json=1)
         _TLS.ctx = None       # JSON control frames carry context in-payload
         _TLS.recv_version = None
+        _TLS.recv_priority = None
+        _TLS.recv_deadline = None
         return json.loads(bytes(body))
     pre = bytearray(_PRE.size)
     pre[0] = first[0]
@@ -535,6 +569,13 @@ def recv_msg(sock: socket.socket, shm=None) -> Any:
     _TLS.ctx = ctx if _tm.TraceContext.from_wire(ctx) is not None else None
     ver = meta.get("v")
     _TLS.recv_version = str(ver) if isinstance(ver, str) and ver else None
+    # optional overload-QoS pair ("p"/"dl"): absent from old senders
+    pri = meta.get("p")
+    _TLS.recv_priority = pri if isinstance(pri, str) and pri else None
+    dl = meta.get("dl")
+    _TLS.recv_deadline = (float(dl)
+                          if isinstance(dl, (int, float))
+                          and not isinstance(dl, bool) and dl > 0 else None)
     expect = _PRE.size + header_len + sum(
         d["n"] for d in meta["b"] if "o" not in d)
     if expect != n:

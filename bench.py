@@ -1400,6 +1400,315 @@ def run_fleet_bench(quick: bool = False) -> dict:
 
 
 # --------------------------------------------------------------------------
+# adaptive-serving-under-overload bench (ISSUE 13): bimodal traffic at 2x
+# capacity (high-priority p99 holds its SLO while bulk sheds with computed
+# Retry-After) + the autoscale 1->4->1 zero-loss drill
+# --------------------------------------------------------------------------
+
+OVERLOAD_SERVICE_MS = float(os.environ.get("ZOO_OVERLOAD_BENCH_SERVICE_MS",
+                                           "80"))
+
+
+def _overload_bimodal_phase(broker_port: int, *, n_replicas: int,
+                            service_s: float, duration_s: float,
+                            crit_deadline_ms: float,
+                            bulk_deadline_ms: float) -> dict:
+    """Bimodal traffic against a fixed fleet: a few CLOSED-loop critical
+    clients (per-request latency measured end to end, tight deadline) ride
+    alongside an OPEN-loop bulk flood offered at ~2x the fleet's nominal
+    capacity. Without QoS this queues everything to timeout; with it the
+    critical class holds its SLO while bulk degrades to shed-with-honest-
+    Retry-After."""
+    import threading
+
+    import numpy as np
+
+    from analytics_zoo_tpu.serving import (FleetSupervisor, InputQueue,
+                                           OutputQueue, ServingConfig,
+                                           ShedError)
+
+    capacity = n_replicas * FLEET_BATCH / service_s      # req/s, nominal
+    bulk_rate = 2.2 * capacity      # the overload (margin over the 2x
+                                    # gate: sleep jitter on a loaded 1-core
+                                    # host only ever LOWERS the real rate)
+    cfg = ServingConfig(queue_port=broker_port, batch_size=FLEET_BATCH,
+                        batch_timeout_ms=2, replicas=n_replicas,
+                        fleet_heartbeat_s=0.1, fleet_failover_timeout_s=1.5,
+                        fleet_spawn_grace_s=10.0)
+    fleet = FleetSupervisor(
+        cfg, model_factory=lambda: _fleet_stub_model(service_s))
+    fleet.start()
+    stop = threading.Event()
+    crit_lat: list = []
+    crit_fail: list = []
+    crit_shed = [0]
+    bulk_uris: list = []
+    bulk_lock = threading.Lock()
+    try:
+        assert fleet.wait_eligible(n_replicas, timeout_s=15), \
+            fleet.router.stats()
+
+        def critical_client(idx: int):
+            iq = InputQueue(port=broker_port)
+            oq = OutputQueue(port=broker_port)
+            i = 0
+            try:
+                while not stop.is_set():
+                    i += 1
+                    t0 = time.perf_counter()
+                    try:
+                        u = iq.enqueue(None, priority="critical",
+                                       deadline_ms=crit_deadline_ms,
+                                       input=np.full((4,), float(i),
+                                                     np.float32))
+                        v = oq.query(u, timeout_s=30)
+                        if abs(float(np.asarray(v).ravel()[0])
+                               - 4.0 * i) > 1e-5:
+                            crit_fail.append((u, "wrong value"))
+                        else:
+                            crit_lat.append(time.perf_counter() - t0)
+                    except ShedError:
+                        crit_shed[0] += 1
+                    except Exception as e:
+                        crit_fail.append((f"c{idx}-{i}", repr(e)))
+            finally:
+                iq.close()
+                oq.close()
+
+        def bulk_flood(idx: int, n_threads: int):
+            iq = InputQueue(port=broker_port)
+            interval = n_threads / bulk_rate
+            # schedule-based pacing: sleep overshoot (rampant on a loaded
+            # 1-core host) must not accumulate into a lower offered rate —
+            # a thread that fell behind its schedule catches up
+            next_t = time.monotonic() + idx * interval / n_threads
+            try:
+                while not stop.is_set():
+                    now = time.monotonic()
+                    if now < next_t:
+                        time.sleep(min(0.005, next_t - now))
+                        continue
+                    next_t += interval
+                    u = iq.enqueue(None, priority="bulk",
+                                   deadline_ms=bulk_deadline_ms,
+                                   input=np.full((4,), 1.0, np.float32))
+                    with bulk_lock:
+                        bulk_uris.append(u)
+            finally:
+                iq.close()
+
+        n_bulk_threads = 4
+        threads = [threading.Thread(target=critical_client, args=(i,),
+                                    daemon=True) for i in range(3)]
+        threads += [threading.Thread(target=bulk_flood,
+                                     args=(i, n_bulk_threads), daemon=True)
+                    for i in range(n_bulk_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        wall = time.perf_counter() - t0
+        # every bulk uri must be ANSWERED — served or shed with a computed
+        # Retry-After — never silently queued to timeout
+        served = shed = timeout = 0
+        retry_afters: list = []
+        oq = OutputQueue(port=broker_port)
+        try:
+            for u in bulk_uris:
+                try:
+                    oq.query(u, timeout_s=30)
+                    served += 1
+                except ShedError as e:
+                    shed += 1
+                    retry_afters.append(e.retry_after_s)
+                except Exception:
+                    timeout += 1
+        finally:
+            oq.close()
+        lat = sorted(crit_lat)
+
+        def pct(q):
+            return (round(lat[min(len(lat) - 1,
+                                  int(q * len(lat)))] * 1e3, 1)
+                    if lat else None)
+
+        offered = (len(bulk_uris) + len(crit_lat) + crit_shed[0]
+                   + len(crit_fail)) / wall
+        return {
+            "replicas": n_replicas,
+            "capacity_req_per_s": round(capacity, 1),
+            "offered_req_per_s": round(offered, 1),
+            "offered_over_capacity": round(offered / capacity, 2),
+            "duration_s": round(wall, 2),
+            "critical": {
+                "served": len(lat), "shed": crit_shed[0],
+                "failed": len(crit_fail),
+                "first_failure": crit_fail[0] if crit_fail else None,
+                "deadline_ms": crit_deadline_ms,
+                "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+            },
+            "bulk": {
+                "offered": len(bulk_uris), "served": served, "shed": shed,
+                "unanswered": timeout,
+                "shed_fraction": round(shed / max(1, len(bulk_uris)), 3),
+                "deadline_ms": bulk_deadline_ms,
+                "retry_after_s": {
+                    "min": round(min(retry_afters), 4) if retry_afters
+                    else None,
+                    "max": round(max(retry_afters), 4) if retry_afters
+                    else None,
+                    "mean": round(sum(retry_afters) / len(retry_afters), 4)
+                    if retry_afters else None,
+                },
+            },
+            "router_shed": fleet.router.shed,
+        }
+    finally:
+        stop.set()
+        fleet.stop(drain_s=2.0)
+
+
+def _overload_autoscale_phase(broker_port: int, *, service_s: float,
+                              max_replicas: int, duration_s: float) -> dict:
+    """The 1->max->1 drill: sustained load makes the supervisor spawn up to
+    ``max_replicas`` on queue pressure; when the load stops it drains back
+    down to 1 — and every submitted request is answered exactly once
+    (graceful drain + straggler XTRANSFER make scale events zero-loss by
+    construction; HSETNX dedup makes duplicates impossible to miss)."""
+    import threading
+
+    import numpy as np
+
+    from analytics_zoo_tpu.serving import (FleetSupervisor, InputQueue,
+                                           OutputQueue, ServingConfig)
+    from analytics_zoo_tpu.serving.broker import _DUP_DROPPED
+
+    cfg = ServingConfig(queue_port=broker_port, batch_size=FLEET_BATCH,
+                        batch_timeout_ms=2, replicas=1,
+                        autoscale=True, min_replicas=1,
+                        max_replicas=max_replicas,
+                        autoscale_up_depth=4.0, autoscale_sustain_s=0.25,
+                        autoscale_idle_s=0.8, autoscale_cooldown_s=0.2,
+                        fleet_heartbeat_s=0.1, fleet_failover_timeout_s=1.5,
+                        fleet_spawn_grace_s=10.0)
+    fleet = FleetSupervisor(
+        cfg, model_factory=lambda: _fleet_stub_model(service_s))
+    fleet.start()
+    dups0 = _DUP_DROPPED.value()
+    uris: list = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    replica_peak = [1]
+    try:
+        assert fleet.wait_eligible(1, timeout_s=15)
+        rate = 1.6 * max_replicas * FLEET_BATCH / service_s / 2  # ~1.6x of
+        # half the max fleet: enough pressure to scale, drainable by max
+
+        def flood(idx: int, n_threads: int):
+            iq = InputQueue(port=broker_port)
+            interval = n_threads / rate
+            i = idx
+            try:
+                while not stop.is_set():
+                    u = iq.enqueue(None, input=np.full((4,), float(i),
+                                                       np.float32))
+                    with lock:
+                        uris.append((i, u))
+                    i += n_threads
+                    time.sleep(interval)
+            finally:
+                iq.close()
+
+        threads = [threading.Thread(target=flood, args=(i, 3), daemon=True)
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        # flood for duration_s; then keep the pressure on (up to 25s more)
+        # until the fleet actually reaches max_replicas
+        t_min = time.monotonic() + duration_s
+        t_max = t_min + 25.0
+        while time.monotonic() < t_max:
+            replica_peak[0] = max(replica_peak[0],
+                                  len(fleet.router.replica_ids()))
+            if time.monotonic() >= t_min and replica_peak[0] >= max_replicas:
+                break
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        scaled_up = replica_peak[0] >= max_replicas
+        # fetch every uri exactly once, value-checked
+        failed: list = []
+        oq = OutputQueue(port=broker_port)
+        try:
+            for i, u in sorted(uris):
+                try:
+                    v = oq.query(u, timeout_s=60)
+                    if abs(float(np.asarray(v).ravel()[0]) - 4.0 * i) > 1e-5:
+                        failed.append((u, "wrong value"))
+                except Exception as e:
+                    failed.append((u, repr(e)))
+        finally:
+            oq.close()
+        # idle: the fleet must drain back down to min_replicas
+        shrink_deadline = time.monotonic() + 40
+        while time.monotonic() < shrink_deadline and \
+                len(fleet.router.replica_ids()) > 1:
+            time.sleep(0.1)
+        return {
+            "requests": len(uris),
+            "failed_requests": len(failed),
+            "first_failure": failed[0] if failed else None,
+            "duplicates_dropped": int(_DUP_DROPPED.value() - dups0),
+            "replica_peak": replica_peak[0],
+            "scaled_up_to_max": scaled_up,
+            "scaled_back_to_min": len(fleet.router.replica_ids()) == 1,
+            "scale_events": list(fleet.scale_events),
+            "requeued": fleet.requeued,
+        }
+    finally:
+        stop.set()
+        fleet.stop(drain_s=2.0)
+
+
+def run_overload_bench(quick: bool = False) -> dict:
+    """Adaptive-serving-under-overload artifact (OVERLOAD_BENCH.json)."""
+    from analytics_zoo_tpu.serving import start_broker
+
+    service_s = OVERLOAD_SERVICE_MS / 1e3
+    out: dict = {
+        "metric": "bimodal overload QoS (critical SLO at 2x capacity) + "
+                  "autoscale 1->4->1 zero-loss drill",
+        "service_time_ms": OVERLOAD_SERVICE_MS,
+        "batch_size": FLEET_BATCH,
+        "model": "device-bound stub (sleep(service_time) per micro-batch; "
+                 "measures the QoS/routing tier, not XLA)",
+        "slo_ms": 1500.0,
+    }
+    broker = start_broker()
+    try:
+        out["bimodal"] = _overload_bimodal_phase(
+            broker.port, n_replicas=2, service_s=service_s,
+            duration_s=2.5 if quick else 6.0,
+            crit_deadline_ms=out["slo_ms"], bulk_deadline_ms=600.0)
+    finally:
+        broker.shutdown()
+    broker = start_broker()
+    try:
+        out["autoscale"] = _overload_autoscale_phase(
+            broker.port, service_s=0.05, max_replicas=4,
+            duration_s=3.0 if quick else 5.0)
+    finally:
+        broker.shutdown()
+    out["value"] = out["bimodal"]["critical"]["p99_ms"]
+    out["unit"] = "ms (critical p99 at 2x capacity)"
+    return out
+
+
+# --------------------------------------------------------------------------
 # model hot-swap bench (ISSUE 10): trainer→fleet checkpoint streaming with
 # canary rollout, sustained load through consecutive swaps + chaos
 # --------------------------------------------------------------------------
@@ -1823,6 +2132,67 @@ if __name__ == "__main__":
               f"{drill['requeued']}, dups_dropped="
               f"{drill['duplicates_dropped']}, failover="
               f"{drill['failover_s']})", file=sys.stderr)
+        sys.exit(0)
+    if "--overload" in sys.argv:
+        # adaptive serving under overload (ISSUE 13): bimodal traffic at 2x
+        # capacity — the critical class must hold its SLO while bulk sheds
+        # with a COMPUTED Retry-After (not queued to timeout) — plus the
+        # autoscale 1->4->1 zero-loss drill. Host-side by construction
+        # (stub device-bound model); pin CPU so a wedged TPU tunnel can
+        # never hang the gate.
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        quick = "--quick" in sys.argv
+        ob = run_overload_bench(quick=quick)
+        if not quick:
+            # quick is the CI gate and never touches the committed artifact
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "OVERLOAD_BENCH.json"), "w") as f:
+                json.dump(ob, f, indent=1)
+        print(json.dumps(ob))
+        # gates (quick AND full): the acceptance criteria of the drill
+        bi = ob["bimodal"]
+        assert bi["offered_over_capacity"] >= 1.8, (
+            f"offered load only {bi['offered_over_capacity']}x capacity — "
+            f"the overload condition was not reached")
+        crit = bi["critical"]
+        assert crit["failed"] == 0, (
+            f"critical requests failed: {crit['first_failure']}")
+        # the critical class must be SERVED under overload; a stray shed
+        # (scheduler stall past the whole 1.5s budget on the shared 1-core
+        # host) is tolerated at <=2%, never more
+        assert crit["served"] > 0 and \
+            crit["shed"] <= 0.02 * (crit["served"] + crit["shed"]), crit
+        assert crit["p99_ms"] is not None and \
+            crit["p99_ms"] <= ob["slo_ms"], (
+            f"critical p99 {crit['p99_ms']}ms blew the {ob['slo_ms']}ms "
+            f"SLO at {bi['offered_over_capacity']}x capacity")
+        bulk = bi["bulk"]
+        assert bulk["unanswered"] == 0, (
+            f"{bulk['unanswered']} bulk requests were queued to timeout "
+            f"instead of served-or-shed")
+        assert bulk["shed"] > 0, (
+            "no bulk traffic was shed at 2x capacity — deadline shedding "
+            "never engaged")
+        assert bulk["retry_after_s"]["max"] > 0.05, (
+            f"shed Retry-After never exceeded the floor — not computed "
+            f"from queue state: {bulk['retry_after_s']}")
+        asc = ob["autoscale"]
+        assert asc["failed_requests"] == 0, (
+            f"autoscale drill lost requests: {asc['first_failure']}")
+        assert asc["duplicates_dropped"] == 0, asc
+        assert asc["scaled_up_to_max"], (
+            f"fleet never reached max replicas: {asc['scale_events']}")
+        assert asc["scaled_back_to_min"], (
+            f"fleet never drained back to 1: {asc['scale_events']}")
+        print(f"[bench] overload gate OK: critical p99 "
+              f"{crit['p99_ms']}ms (SLO {ob['slo_ms']}ms) at "
+              f"{bi['offered_over_capacity']}x capacity, bulk shed "
+              f"{bulk['shed_fraction'] * 100:.0f}% with Retry-After up to "
+              f"{bulk['retry_after_s']['max']}s; autoscale 1->"
+              f"{asc['replica_peak']}->1 over {asc['requests']} requests, "
+              f"0 lost, 0 duplicated", file=sys.stderr)
         sys.exit(0)
     if "--hotswap" in sys.argv:
         # model hot-swap drill (ISSUE 10): sustained load through >=3

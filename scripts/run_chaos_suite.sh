@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
 # Run the deterministic fault-injection suite (tests marked `chaos`, plus the
-# replica-fleet failover drills marked `fleet` and the model hot-swap /
+# replica-fleet failover drills marked `fleet`, the model hot-swap /
 # canary-rollout drills marked `hotswap` — kill-the-canary-mid-rollout,
-# kill-the-engine-mid-swap, NaN-poisoned publish) on the CPU backend with a
+# kill-the-engine-mid-swap, NaN-poisoned publish — and the overload/QoS
+# drills marked `overload` — per-tier deadline shedding, bulk-slot
+# preemption, kill-during-autoscale-scale-up) on the CPU backend with a
 # hard wall-clock cap, independently of tier-1.
 #
 #   scripts/run_chaos_suite.sh            # chaos + fleet + hotswap markers
@@ -40,7 +42,7 @@ echo "[chaos-suite] memory witness: $MEM_WITNESS" >&2
 timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
     ZOO_TPU_TRACE_LOCKS=1 ZOO_TPU_LOCK_WITNESS="$WITNESS" \
     ZOO_TPU_MEM_WITNESS="$MEM_WITNESS" \
-    python -m pytest tests -q -m "chaos or fleet or hotswap" \
+    python -m pytest tests -q -m "chaos or fleet or hotswap or overload" \
     -p no:cacheprovider "$@"
 
 # gates: witnessed ∪ static lock-order graph must be cycle-free (and leaf

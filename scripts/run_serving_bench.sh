@@ -71,6 +71,14 @@ if [[ "${1:-}" == "--quick" ]]; then
     # and routed throughput scales >= 2.5x from 1 to 4 replicas
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
         python bench.py --fleet --quick
+    # overload gate (ISSUE 13): bimodal traffic at 2x capacity — the
+    # critical class holds its SLO (p99 <= deadline) while bulk traffic is
+    # shed with a COMPUTED Retry-After (never queued to timeout) — plus
+    # the autoscale 1->4->1 drill: sustained queue pressure spawns
+    # replicas to max, idleness drains them back, with zero lost and zero
+    # duplicated requests across every scale event
+    timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+        python bench.py --overload --quick
     # hot-swap gate: sustained load through >= 3 consecutive canary-rolled
     # version swaps on a 4-replica fleet, one canary chaos-killed mid-
     # rollout, one NaN-poisoned publish — zero failed client requests,
