@@ -123,17 +123,24 @@ def _aval_nbytes(aval) -> Optional[int]:
 
 
 def lint_decode_stability(model, params, cache_cfg, cache, *,
-                          top_k: int = 0,
+                          top_k: int = 0, spec_k: int = 0,
                           where: str = "serving.generation",
                           ctx: Optional[RuleContext] = None,
                           donate_cache: Optional[bool] = None,
                           hbm_budget_bytes: Optional[int] = None,
                           note_static_site: Optional[str] = None
                           ) -> List[Finding]:
-    """Trace ``model.decode_step`` at the cache's fixed shapes (abstract —
-    no compile, no execution) and run the stability rule. This is the
-    warmup entry point (``ContinuousBatcher.check_decode_stability``) and
-    the bench's decode-lint gate.
+    """Trace the decode-path executable at the cache's fixed shapes
+    (abstract — no compile, no execution) and run the stability rule. This
+    is the warmup entry point (``ContinuousBatcher.check_decode_stability``)
+    and the bench's decode-lint gate.
+
+    ``spec_k >= 2`` lints the SPECULATIVE verify executable
+    (``model.verify_step`` at query length k) instead of the single-token
+    ``decode_step`` — the same invariants hold: every cache leaf threads
+    through with identical (shape, dtype), no intermediate outgrows the
+    cache, no host transfers, and exactly one compiled executable per
+    (k, slot-count) since ids (B, k) is the only aval that varies with k.
 
     ``donate_cache`` states whether the dispatch donates the cache argument;
     when given, the memory tier runs too — ``cache-alias`` (un-donated pool
@@ -148,11 +155,17 @@ def lint_decode_stability(model, params, cache_cfg, cache, *,
 
     b = cache_cfg.n_slots
     i32 = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32)
+    if spec_k >= 2:
+        step = model.verify_step
+        ids_aval = i32((b, spec_k))
+    else:
+        step = model.decode_step
+        ids_aval = i32((b,))
     closed = jax.make_jaxpr(
-        lambda p, c, ids, ln, tb, sd, ti, tp: model.decode_step(
+        lambda p, c, ids, ln, tb, sd, ti, tp: step(
             p, c, ids, ln, tb, sd, ti, tp, page_size=cache_cfg.page_size,
             top_k=top_k))(
-        params, cache, i32((b,)), i32((b,)),
+        params, cache, ids_aval, i32((b,)),
         i32((b, cache_cfg.pages_per_slot)),
         jax.ShapeDtypeStruct((b,), jnp.uint32),
         jax.ShapeDtypeStruct((b,), jnp.uint32),

@@ -245,6 +245,46 @@ class TransformerLM(Layer, KerasNet):
                                  top_k=top_k)
         return next_ids, logits, {"k": k_cache, "v": v_cache}
 
+    def verify_step(self, params, cache, ids, lengths, table, seeds,
+                    token_idx, temperature, *, page_size: int,
+                    top_k: int = 0):
+        """One fixed-shape speculative VERIFY step: score ``k`` tokens per
+        slot in one dispatch (the multi-token twin of :meth:`decode_step`).
+
+        ``ids``: (B, k) int32 — column 0 is the previous step's sampled
+        token (certain), columns 1..k-1 the drafted continuation; they
+        occupy positions ``lengths .. lengths + k - 1`` (the caller has
+        pages allocated through position ``lengths + k - 1``).
+        ``token_idx``: (B,) — ordinal of the FIRST token this step emits.
+        Returns ``(accepted (B,) int32, tokens (B, k) int32, draft_probs
+        (B, k-1) f32, cache)`` — ``tokens[:, :accepted+1]`` are the emitted
+        tokens (see :func:`analytics_zoo_tpu.ops.speculative.
+        verify_draft_tokens`); cache shapes identical in and out, same as
+        the decode step (ONE compiled executable per (k, slot-count)).
+        """
+        from ..ops.speculative import verify_draft_tokens
+
+        ids = jnp.asarray(ids, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        k = ids.shape[1]
+        positions = lengths[:, None] + jnp.arange(k, dtype=jnp.int32)[None]
+        h = jnp.take(params["token_embeddings"], ids, axis=0)
+        h = h + jnp.take(params["pos_embeddings"], positions, axis=0)
+        h = as_compute(h)
+        k_cache, v_cache = cache["k"], cache["v"]
+        for i, blk in enumerate(self.blocks):
+            h, kp, vp = blk.verify_step(
+                params[f"block{i}"], h, k_cache[i], v_cache[i], table,
+                lengths, page_size=page_size)
+            k_cache = k_cache.at[i].set(kp)
+            v_cache = v_cache.at[i].set(vp)
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h)
+        logits = (h @ jnp.asarray(params["logits_kernel"], h.dtype)
+                  ).astype(jnp.float32)                       # (B, k, V)
+        accepted, tokens, draft_probs = verify_draft_tokens(
+            logits, ids[:, 1:], seeds, token_idx, temperature, top_k=top_k)
+        return accepted, tokens, draft_probs, {"k": k_cache, "v": v_cache}
+
     def compute_output_shape(self, input_shape):
         return tuple(input_shape) + (self.vocab,)
 

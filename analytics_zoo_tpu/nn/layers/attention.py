@@ -226,26 +226,58 @@ class TransformerLayer(Layer):
         ``v_pages``: (P, page_size, H, D) — this LAYER's page pool;
         ``table``: (B, pages_per_slot) int32; ``pos``: (B,) int32 — the
         position being decoded (== tokens already cached). The new K/V are
-        written at ``pos`` BEFORE attending, so the token sees itself; the
-        single-query attention is plain dot against the gathered cache,
-        masked to ``pos + 1`` valid positions. Returns
+        written at ``pos`` BEFORE attending, so the token sees itself;
+        attention is masked to ``pos + 1`` valid positions — the fused
+        paged-attention kernel when routed on (``ops.paged_attention.
+        use_kernel``), else plain dot against the gathered cache. Returns
         ``(x_out, k_pages, v_pages)`` — fixed shapes throughout (the
         ``decode-shape-stability`` lint invariant).
         """
-        from ...ops.kv_cache import decode_attention, paged_read, paged_write
+        return self._cached_step(params, x, k_pages, v_pages, table, pos,
+                                 page_size=page_size)
+
+    def verify_step(self, params, x, k_pages, v_pages, table, pos, *,
+                    page_size: int):
+        """The speculative-decode twin of :meth:`decode_step`: ``k`` tokens
+        per slot (1 certain + k-1 drafted) written and attended in one pass.
+        ``x``: (B, k, hidden); ``pos``: (B,) — the FIRST position written
+        (== tokens already cached); token i lands at ``pos + i`` and attends
+        causally (itself + earlier drafts + the whole prefix)."""
+        return self._cached_step(params, x, k_pages, v_pages, table, pos,
+                                 page_size=page_size)
+
+    def _cached_step(self, params, x, k_pages, v_pages, table, pos, *,
+                     page_size: int):
+        """Shared decode/verify body: write the q_len new tokens' K/V into
+        the paged pool, attend against it, finish with the block tail."""
+        from ...ops.kv_cache import (decode_attention, decode_attention_multi,
+                                     paged_read, paged_write_multi)
+        from ...ops.paged_attention import paged_attention, use_kernel
 
         x = as_compute(x)
+        q_len = x.shape[1]
         h, _ = self.ln1.apply(params["ln1"], {}, x)
-        q, k, v = self.attn.qkv_proj(params["attn"], h)      # (B, 1, H, D)
-        k_pages = paged_write(k_pages, table, pos, k[:, 0],
-                              page_size=page_size)
-        v_pages = paged_write(v_pages, table, pos, v[:, 0],
-                              page_size=page_size)
-        ks = paged_read(k_pages, table)                      # (B, T_max, H, D)
-        vs = paged_read(v_pages, table)
-        o = decode_attention(q[:, 0], ks.astype(q.dtype),
-                             vs.astype(q.dtype), pos + 1)    # (B, H, D)
-        x = x + self.attn.out_proj(params["attn"], o[:, None], x.dtype)
+        q, k, v = self.attn.qkv_proj(params["attn"], h)   # (B, q_len, H, D)
+        k_pages = paged_write_multi(k_pages, table, pos, k,
+                                    page_size=page_size)
+        v_pages = paged_write_multi(v_pages, table, pos, v,
+                                    page_size=page_size)
+        if use_kernel():
+            # fused path: page gather + QK + softmax + PV entirely in VMEM —
+            # the (B, T_max, H, D) contiguous copy below never exists
+            o = paged_attention(q, k_pages.astype(q.dtype),
+                                v_pages.astype(q.dtype), table,
+                                pos + q_len, page_size=page_size)
+        else:
+            ks = paged_read(k_pages, table)               # (B, T_max, H, D)
+            vs = paged_read(v_pages, table)
+            if q_len == 1:
+                o = decode_attention(q[:, 0], ks.astype(q.dtype),
+                                     vs.astype(q.dtype), pos + 1)[:, None]
+            else:
+                o = decode_attention_multi(q, ks.astype(q.dtype),
+                                           vs.astype(q.dtype), pos + q_len)
+        x = x + self.attn.out_proj(params["attn"], o, x.dtype)
         return self._mlp(params, x), k_pages, v_pages
 
     def compute_output_shape(self, input_shape):

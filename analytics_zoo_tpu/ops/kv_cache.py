@@ -184,6 +184,26 @@ def prefill_write(pages: jax.Array, table: jax.Array, kv: jax.Array,
     return pages.at[table[:, :n_pages]].set(tiles)
 
 
+def paged_write_multi(pages: jax.Array, table: jax.Array, pos: jax.Array,
+                      new: jax.Array, *, page_size: int) -> jax.Array:
+    """Write ``T`` consecutive tokens' K or V per slot (the speculative
+    verify step's batched twin of :func:`paged_write`).
+
+    ``pages``: (P, page_size, H, D); ``table``: (B, pages_per_slot) int32;
+    ``pos``: (B,) int32 — the FIRST position written per slot; ``new``:
+    (B, T, H, D) — tokens land at positions ``pos .. pos+T-1``. The caller
+    guarantees ``pos + T <= pages_per_slot * page_size`` (the batcher
+    retires a slot before its tail can spill past the table). Masked slots
+    carry scratch-only table rows, so their writes land in scratch.
+    """
+    t = new.shape[1]
+    positions = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]  # (B,T)
+    page_idx = positions // page_size
+    offsets = positions % page_size
+    page_ids = jnp.take_along_axis(table, page_idx, axis=1)          # (B,T)
+    return pages.at[page_ids, offsets].set(new.astype(pages.dtype))
+
+
 def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      lengths: jax.Array) -> jax.Array:
     """Single-query attention against a cached prefix, masked to each row's
@@ -205,13 +225,40 @@ def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bht,bthd->bhd", probs.astype(v.dtype), v)
 
 
+def decode_attention_multi(q: jax.Array, k: jax.Array, v: jax.Array,
+                           lengths: jax.Array) -> jax.Array:
+    """Multi-query decode attention: ``T`` new tokens per slot against the
+    cached prefix — the reference/fallback path for the speculative verify
+    step (the fused twin is :func:`~analytics_zoo_tpu.ops.paged_attention.
+    paged_attention` at q_len>1).
+
+    ``q``: (B, T, H, D); ``k``/``v``: (B, T_max, H, D); ``lengths``: (B,) —
+    VALID cache positions *including* the T new tokens (their K/V already
+    written). Query ``i`` attends to positions ``<= lengths - T + i``:
+    causal among the new tokens, full prefix before them. At T=1 this is
+    exactly :func:`decode_attention` (bound = lengths - 1).
+    """
+    t_new = q.shape[1]
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bthd->bhqt", q, k).astype(jnp.float32)
+    scores = scores / np.sqrt(d).astype(np.float32)
+    t = k.shape[1]
+    kv_pos = jnp.arange(t, dtype=jnp.int32)[None, None, None, :]
+    q_idx = jnp.arange(t_new, dtype=jnp.int32)[None, None, :, None]
+    bound = lengths[:, None, None, None] - t_new + q_idx
+    scores = jnp.where(kv_pos <= bound, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqt,bthd->bqhd", probs.astype(v.dtype), v)
+
+
 # ---------------------------------------------------------------------------
 # sampling — per-request keys so continuous-batch scheduling never changes a
 # stream's tokens (determinism gate in tests/test_generation.py)
 # ---------------------------------------------------------------------------
 
 def sample_tokens(logits: jax.Array, seeds: jax.Array, token_idx: jax.Array,
-                  temperature: jax.Array, *, top_k: int = 0) -> jax.Array:
+                  temperature: jax.Array, *, top_k: int = 0,
+                  return_probs: bool = False):
     """Sample one token per row under an explicit per-request PRNG key.
 
     ``logits``: (B, V) — any float dtype, upcast to f32 for the softmax.
@@ -223,6 +270,13 @@ def sample_tokens(logits: jax.Array, seeds: jax.Array, token_idx: jax.Array,
     ``temperature``: (B,) f32; rows at <= 0 take argmax (greedy).
     ``top_k`` (static): 0 = full distribution, else restrict to the k
     highest-logit tokens.
+
+    ``return_probs`` (static): additionally return the (B, V) f32
+    post-temperature/top_k distribution each row sampled from — the
+    per-token probabilities the speculative accept/reject rule consumes
+    (:mod:`analytics_zoo_tpu.ops.speculative`). The token path is
+    UNCHANGED either way (existing streams stay bit-identical; greedy rows'
+    probs are the temperature-floored softmax, ≈ one-hot on the argmax).
     """
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -238,11 +292,14 @@ def sample_tokens(logits: jax.Array, seeds: jax.Array, token_idx: jax.Array,
 
     sampled = jax.vmap(one)(scaled, seeds.astype(jnp.uint32),
                             token_idx.astype(jnp.uint32)).astype(jnp.int32)
-    return jnp.where(temperature <= 0, greedy, sampled)
+    tokens = jnp.where(temperature <= 0, greedy, sampled)
+    if not return_probs:
+        return tokens
+    return tokens, jax.nn.softmax(scaled, axis=-1)
 
 
 __all__ = [
     "KVCacheConfig", "OutOfPages", "PagePool", "SCRATCH_PAGE",
-    "decode_attention", "init_cache", "paged_read", "paged_write",
-    "prefill_write", "sample_tokens",
+    "decode_attention", "decode_attention_multi", "init_cache", "paged_read",
+    "paged_write", "paged_write_multi", "prefill_write", "sample_tokens",
 ]

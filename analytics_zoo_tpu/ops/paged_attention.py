@@ -1,0 +1,278 @@
+"""Fused paged-attention pallas kernel (ISSUE 14).
+
+``decode_attention`` (kv_cache.py) is a plain masked dot over a
+*host-gathered contiguous view*: ``paged_read`` materializes the full
+``(B, pages_per_slot * page_size, H, D)`` cache per layer per step in HBM,
+so decode is bandwidth-bound on data it mostly re-reads — the gathered copy
+is written once and read once, doubling cache traffic for zero FLOPs.
+
+This kernel fuses the page-table gather INTO the attention loop: the grid
+walks ``(slot, head-block, page)`` and each program's K/V tile is fetched
+straight from the page pool by indexing the scalar-prefetched page table in
+the BlockSpec index map (``pltpu.PrefetchScalarGridSpec`` — the table is in
+SMEM before the first tile DMA issues, so the gather costs nothing extra).
+QK dot, online-softmax statistics and the PV accumulate all live in VMEM;
+nothing page-sized ever round-trips HBM. Supports query length 1 (the
+classic decode step) AND ``q_len = k > 1`` — the speculative-decode verify
+step that scores k draft tokens against the same paged cache in one pass
+(:mod:`analytics_zoo_tpu.ops.speculative`).
+
+Block schedule: ``block_h`` (heads per program) is the tunable knob —
+resolved via env ``ZOO_PAGED_BLOCK_H``, then the on-disk autotuner cache
+(:mod:`analytics_zoo_tpu.ops.tuning` ``PAGED`` op table, exactly like
+matmul/flash), then all-heads. Routing: :func:`use_kernel` — ``auto``
+(kernel on TPU, reference path elsewhere: interpret-mode pallas is a
+correctness tool, not a fast path), forced ``on`` (interpret on CPU — the
+parity gates), or ``off`` via ``ZOO_PAGED_ATTENTION``.
+
+Semantics match :func:`~analytics_zoo_tpu.ops.kv_cache.decode_attention_multi`:
+``lengths[b]`` counts VALID cache positions *including* the q_len new tokens
+(already written by ``paged_write_multi``), and query ``i`` attends to
+positions ``<= lengths[b] - q_len + i`` — causal within the step, full
+prefix before it. Pages holding no valid position are skipped entirely
+(``pl.when`` on the scalar-prefetched length), so cost tracks each slot's
+true length, not the table capacity.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+try:  # pallas optional, same pattern as flash_attention/int8_fused
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover - environment without pallas
+    _HAS_PALLAS = False
+
+
+def has_pallas() -> bool:
+    return _HAS_PALLAS
+
+
+def paged_mode() -> str:
+    """``ZOO_PAGED_ATTENTION``: ``auto`` (default — kernel on TPU only),
+    ``on`` (force the kernel; interpret mode off-TPU — parity testing),
+    ``off`` (always the gather + plain-dot reference path)."""
+    mode = os.environ.get("ZOO_PAGED_ATTENTION", "auto").lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"ZOO_PAGED_ATTENTION must be auto/on/off, "
+                         f"got {mode!r}")
+    return mode
+
+
+def use_kernel() -> bool:
+    """Resolve routing at trace time (a jitted decode step bakes the answer,
+    like ``flash_attention.default_blocks``)."""
+    if not _HAS_PALLAS:
+        return False
+    mode = paged_mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def default_block_h(h: int, *, q_len: int = 1,
+                    pages_per_slot: Optional[int] = None,
+                    page_size: Optional[int] = None,
+                    d: Optional[int] = None, dtype=None) -> int:
+    """Heads per kernel program. Resolution order mirrors
+    ``flash_attention.default_blocks``: ``ZOO_PAGED_BLOCK_H`` env, then the
+    tuning cache's ``paged`` table, then all heads in one program (the small
+    working sets of decode rarely pressure VMEM, and fewer grid steps win
+    when they fit)."""
+    env = os.environ.get("ZOO_PAGED_BLOCK_H")
+    if env:
+        bh = int(env)
+        return bh if h % bh == 0 else h
+    if pages_per_slot and page_size and d:
+        try:
+            from .tuning import paged_lookup
+
+            tuned = paged_lookup(q_len, pages_per_slot, page_size, h, d,
+                                 dtype if dtype is not None
+                                 else np.dtype("float32"))
+        except Exception:   # cache layer must never break a decode trace
+            tuned = None
+        if tuned is not None and h % tuned == 0:
+            return tuned
+    return h
+
+
+def _paged_kernel(table_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale: float, page_size: int,
+                  q_len: int, block_h: int, d: int):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    rows = block_h * q_len
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[b]
+
+    def body():
+        # operands stay in storage dtype (bf16 MXU full-rate), statistics
+        # accumulate in f32 — same discipline as the flash kernel
+        q = q_ref[0].transpose(1, 0, 2)             # (block_h, q_len, D)
+        k = k_ref[0].transpose(1, 0, 2)             # (block_h, page, D)
+        v = v_ref[0].transpose(1, 0, 2)
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32) * scale
+        kv_pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (block_h, q_len, page_size), 2)
+        q_idx = jax.lax.broadcasted_iota(
+            jnp.int32, (block_h, q_len, page_size), 1)
+        # query i sits at absolute position length - q_len + i: it sees the
+        # whole prefix AND itself/earlier drafts, never later drafts
+        bound = length - q_len + q_idx
+        s = jnp.where(kv_pos <= bound, s, NEG_INF)
+        m_prev = m_scr[:rows, 0:1].reshape(block_h, q_len, 1)
+        l_prev = l_scr[:rows, 0:1].reshape(block_h, q_len, 1)
+        m_new = jnp.maximum(m_prev, s.max(axis=2, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * corr + p.sum(axis=2, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((2,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:rows, :d] = (acc_scr[:rows, :d] * corr.reshape(rows, 1)
+                              + pv.reshape(rows, d))
+        m_scr[:rows, :] = jnp.broadcast_to(m_new.reshape(rows, 1),
+                                           (rows, m_scr.shape[1]))
+        l_scr[:rows, :] = jnp.broadcast_to(l_new.reshape(rows, 1),
+                                           (rows, l_scr.shape[1]))
+
+    # skip pages holding no valid position (table entries there are scratch)
+    @pl.when(j * page_size < length)
+    def _():
+        body()
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_scr[:rows, 0:1]
+        safe_l = jnp.where(l == 0, 1.0, l)   # masked-out rows emit zeros
+        o = (acc_scr[:rows, :d] / safe_l).reshape(block_h, q_len, d)
+        o_ref[0] = o.transpose(1, 0, 2).astype(o_ref.dtype)
+
+
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    table: jax.Array, lengths: jax.Array, *,
+                    page_size: int, block_h: Optional[int] = None,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Fused page-gather attention.
+
+    ``q``: (B, q_len, H, D); ``k_pages``/``v_pages``: (P, page_size, H, D)
+    — ONE layer's pool; ``table``: (B, pages_per_slot) int32; ``lengths``:
+    (B,) int32 valid positions INCLUDING the q_len new tokens. Returns
+    (B, q_len, H, D). Falls back to the reference gather + masked-dot path
+    when pallas is unavailable."""
+    from .kv_cache import decode_attention_multi, paged_read
+
+    b, q_len, h, d = q.shape
+    pps = table.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_h is None:
+        block_h = default_block_h(h, q_len=q_len, pages_per_slot=pps,
+                                  page_size=page_size, d=d, dtype=q.dtype)
+    if not _HAS_PALLAS or h % block_h:
+        ks = paged_read(k_pages, table)
+        vs = paged_read(v_pages, table)
+        return decode_attention_multi(q, ks.astype(q.dtype),
+                                      vs.astype(q.dtype), lengths)
+    scale = 1.0 / float(np.sqrt(d))
+    rows = max(8, block_h * q_len)
+    kern = functools.partial(_paged_kernel, scale=scale, page_size=page_size,
+                             q_len=q_len, block_h=block_h, d=d)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h // block_h, pps),
+        in_specs=[
+            pl.BlockSpec((1, q_len, block_h, d),
+                         lambda b, hb, j, tbl, ln: (b, 0, hb, 0)),
+            # THE fusion: the K/V tile for grid step (b, ·, j) is page
+            # table[b, j] of the pool, resolved in the index map from the
+            # scalar-prefetched table — no contiguous copy ever exists
+            pl.BlockSpec((1, page_size, block_h, d),
+                         lambda b, hb, j, tbl, ln: (tbl[b, j], 0, hb, 0)),
+            pl.BlockSpec((1, page_size, block_h, d),
+                         lambda b, hb, j, tbl, ln: (tbl[b, j], 0, hb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, q_len, block_h, d),
+                               lambda b, hb, j, tbl, ln: (b, 0, hb, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, max(d, 128)), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, q_len, h, d), q.dtype),
+        # the (slot, head-block) dims each own disjoint output blocks; only
+        # the page fold must stay sequential (online-softmax carry)
+        compiler_params=None if interpret else _tpu_params(),
+        interpret=interpret,
+    )(jnp.asarray(table, jnp.int32), jnp.asarray(lengths, jnp.int32),
+      q, k_pages, v_pages)
+
+
+def _tpu_params():
+    from ..common.compat import tpu_compiler_params
+
+    return tpu_compiler_params(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def synthetic_paged_case(n_slots: int, pages_per_slot: int, page_size: int,
+                         h: int, d: int, *, q_len: int = 1,
+                         dtype=np.float32, lengths=None, rng=None):
+    """Random ``(q, k_pages, v_pages, table, lengths)`` laid out exactly
+    like the serving cache — page 0 scratch, each slot's valid prefix on
+    sequentially allocated pages, unallocated entries scratch. The ONE
+    fixture builder shared by the autotuner sweep
+    (:func:`~analytics_zoo_tpu.ops.tuning.tune_paged_attention`), the bench
+    parity gate and the kernel tests, so none can drift from the real
+    :class:`~analytics_zoo_tpu.ops.kv_cache.PagePool` layout.
+
+    ``lengths`` (optional, (n_slots,) int): valid positions per slot
+    INCLUDING the q_len newest tokens; defaults to a half-full ladder
+    (the steady serving regime). Rows at 0 get all-scratch tables
+    (masked/inactive slots)."""
+    rng = rng if rng is not None else np.random.default_rng(0)
+    n_pages = n_slots * pages_per_slot + 1
+    q = jnp.asarray(rng.normal(size=(n_slots, q_len, h, d)), dtype)
+    k_pages = jnp.asarray(rng.normal(size=(n_pages, page_size, h, d)), dtype)
+    v_pages = jnp.asarray(rng.normal(size=(n_pages, page_size, h, d)), dtype)
+    max_len = pages_per_slot * page_size
+    if lengths is None:
+        lengths = np.maximum(q_len, (np.arange(n_slots) + 1)
+                             * max_len // (2 * n_slots)).astype(np.int32)
+    lengths = np.asarray(lengths, np.int32)
+    table = np.zeros((n_slots, pages_per_slot), np.int32)
+    nxt = 1
+    for i in range(n_slots):
+        for j in range(-(-int(lengths[i]) // page_size)):
+            table[i, j] = nxt
+            nxt += 1
+    return q, k_pages, v_pages, jnp.asarray(table), jnp.asarray(lengths)
+
+
+__all__ = ["default_block_h", "has_pallas", "paged_attention", "paged_mode",
+           "synthetic_paged_case", "use_kernel"]

@@ -1,0 +1,134 @@
+"""Speculative multi-token decode: self-drafting k-gram proposals + the
+batched accept/reject verify rule (ISSUE 14).
+
+The PR-8 decode loop advances ONE token per step per stream; every step pays
+a full model dispatch for a single sampled token. Speculative decoding
+amortizes that dispatch: a cheap draft proposer guesses the next
+``k - 1`` tokens, the target model scores all ``k`` positions (the certain
+last-sampled token + the drafts) in ONE fixed-shape verify step — query
+length k against the same paged cache, the q_len>1 mode of the fused
+paged-attention kernel — and the accept/reject rule advances a *variable*
+number of tokens per slot per step.
+
+**Draft proposer: self-drafting k-gram lookup** (:func:`propose_kgram`), the
+zero-parameter flavor of the "small draft model" design point: the proposal
+for a stream is the continuation that followed the most recent earlier
+occurrence of its current suffix n-gram (prompt-lookup decoding). No second
+model to train, version, or hot-swap in lockstep — the "draft model" is the
+stream's own history — and it exploits exactly the structure real LM traffic
+has (quoting, code, templated text, repetition). Deterministic given the
+history, so preempt/park/resume replays identically.
+
+**Accept rule** (:func:`verify_draft_tokens`): for each position j the
+target's token x_j is sampled with the SAME per-(seed, ordinal) key
+discipline as :func:`~analytics_zoo_tpu.ops.kv_cache.sample_tokens` — the
+identical categorical draw the non-speculative loop would have made at that
+ordinal given the same prefix. Draft d_j is accepted iff x_j == d_j; the
+first mismatching x_j is itself the emitted correction, and a fully
+accepted run emits the bonus token x_{k-1}. For a point-mass draft
+distribution this IS the standard speculative-sampling accept/reject rule
+(accept probability π(d), rejection residual π restricted to ≠d), with a
+much stronger practical property: the emitted stream is **bit-identical to
+the non-speculative stream at every temperature**, not just greedy — same
+seeds, same ordinals, same conditional prefixes ⇒ same draws, by induction.
+Speculation changes only how many dispatches the tokens cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv_cache import sample_tokens
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecDecodeConfig:
+    """Static speculative-decode schedule (part of the compiled verify
+    executable's identity: ONE executable per (k, slot-count)).
+
+    ``k``: tokens scored per verify step = 1 certain + (k-1) drafted;
+    k=1 degenerates to the plain single-token decode step. ``max_ngram``:
+    longest suffix the k-gram proposer backs off from."""
+
+    k: int = 4
+    max_ngram: int = 3
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"spec k must be >= 1, got {self.k}")
+        if self.max_ngram < 1:
+            raise ValueError(f"max_ngram must be >= 1, got {self.max_ngram}")
+
+
+def propose_kgram(history: Sequence[int], n_draft: int,
+                  max_ngram: int = 3) -> List[int]:
+    """Draft ``n_draft`` tokens by suffix-matching the stream's own history.
+
+    Finds the most recent EARLIER occurrence of the trailing ``n``-gram
+    (n = max_ngram down to 1) and copies the tokens that followed it;
+    repeats the last token to pad when the match runs out, and as the
+    no-match fallback (repetition is the cheapest structure greedy decode
+    exhibits). Host-side, O(|history|) numpy — drafting must cost nothing
+    next to a model dispatch."""
+    hist = np.asarray(history, np.int32).reshape(-1)
+    n_hist = hist.size
+    if n_hist == 0:
+        return [0] * n_draft
+    for n in range(min(max_ngram, n_hist - 1), 0, -1):
+        suffix = hist[n_hist - n:]
+        starts = np.flatnonzero(hist[: n_hist - n] == suffix[0])
+        for s in starts[::-1]:
+            if n == 1 or np.array_equal(hist[s:s + n], suffix):
+                cont = hist[s + n: s + n + n_draft]
+                if cont.size:
+                    out = cont.tolist()
+                    while len(out) < n_draft:
+                        out.append(int(hist[-1]))
+                    return out[:n_draft]
+    return [int(hist[-1])] * n_draft
+
+
+def verify_draft_tokens(logits: jax.Array, draft_ids: jax.Array,
+                        seeds: jax.Array, token_idx: jax.Array,
+                        temperature: jax.Array, *, top_k: int = 0):
+    """Batched accept/reject over one verify step's logits.
+
+    ``logits``: (B, k, V) — position j's distribution is conditioned on the
+    certain token + drafts d_1..d_j (valid whenever all earlier drafts were
+    accepted, which is the only case it is read). ``draft_ids``: (B, k-1);
+    ``seeds``/``token_idx``/``temperature``: (B,) — ``token_idx`` is the
+    ordinal of the FIRST token this step emits; position j samples under
+    ordinal ``token_idx + j``, the exact key the plain loop would use.
+
+    Returns ``(accepted, tokens, draft_probs)``: ``accepted`` (B,) int32 in
+    [0, k-1] = leading drafts confirmed; ``tokens`` (B, k) — the target's
+    own samples, of which ``tokens[:, :accepted+1]`` are the emitted tokens
+    (confirmed drafts + the correction/bonus); ``draft_probs`` (B, k-1) f32
+    = π_j(d_j), each draft's acceptance probability under the target (the
+    ``zoo_gen_spec_accept_prob`` observability signal)."""
+    b, k, v = logits.shape
+    flat = logits.reshape(b * k, v)
+    ordinals = (token_idx.astype(jnp.uint32)[:, None]
+                + jnp.arange(k, dtype=jnp.uint32)[None]).reshape(-1)
+    tokens, probs = sample_tokens(
+        flat, jnp.repeat(seeds.astype(jnp.uint32), k), ordinals,
+        jnp.repeat(temperature, k), top_k=top_k, return_probs=True)
+    tokens = tokens.reshape(b, k)
+    if k == 1:
+        return (jnp.zeros((b,), jnp.int32), tokens,
+                jnp.zeros((b, 0), jnp.float32))
+    probs = probs.reshape(b, k, v)
+    draft_ids = jnp.asarray(draft_ids, jnp.int32)
+    match = (tokens[:, : k - 1] == draft_ids).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(match, axis=1), axis=1).astype(jnp.int32)
+    draft_probs = jnp.take_along_axis(
+        probs[:, : k - 1], draft_ids[..., None], axis=2)[..., 0]
+    return accepted, tokens, draft_probs
+
+
+__all__ = ["SpecDecodeConfig", "propose_kgram", "verify_draft_tokens"]

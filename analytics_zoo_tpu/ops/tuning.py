@@ -344,3 +344,88 @@ def tune_flash_blocks(t_q: int, t_k: int, *, batch: int = 1, heads: int = 8,
     best["with_backward"] = with_backward
     record(FLASH_OP, flash_key(t_q, t_k, dtype), best)
     return best
+
+
+# ------------------------------------------------------------------ paged op
+
+PAGED_OP = "paged"
+
+#: Heads-per-program candidates for the fused paged-attention kernel
+#: (filtered to divisors of the model's head count per sweep).
+PAGED_CANDIDATES: Sequence[int] = (1, 2, 4, 8, 16)
+
+
+def paged_key(q_len: int, pages_per_slot: int, page_size: int, h: int,
+              d: int, dtype) -> str:
+    return shape_key(q_len, pages_per_slot, page_size, h, d, dtype=dtype)
+
+
+def paged_lookup(q_len: int, pages_per_slot: int, page_size: int, h: int,
+                 d: int, dtype) -> Optional[int]:
+    """Tuned ``block_h`` for a paged-attention call at this cache geometry,
+    or None (callers keep the all-heads default). Consulted by
+    ``paged_attention.default_block_h`` after the env knob."""
+    entry = lookup(PAGED_OP, paged_key(q_len, pages_per_slot, page_size,
+                                       h, d, dtype))
+    if not entry:
+        return None
+    try:
+        return int(entry["block_h"])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def tune_paged_attention(q_len: int, pages_per_slot: int, page_size: int,
+                         h: int, d: int, dtype=np.float32, *,
+                         n_slots: int = 8,
+                         candidates: Optional[Sequence[int]] = None,
+                         interpret: Optional[bool] = None,
+                         iters: int = 3) -> Optional[dict]:
+    """Sweep ``block_h`` for the fused paged-attention kernel at one cache
+    geometry (the decode/verify serving regime: B = n_slots, half-full
+    slots), persist and return the winner — the decode twin of
+    :func:`tune_flash_blocks`."""
+    import jax
+
+    from . import paged_attention as pa
+
+    if not pa.has_pallas():
+        return None
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    q, k_pages, v_pages, table, lengths = pa.synthetic_paged_case(
+        n_slots, pages_per_slot, page_size, h, d, q_len=q_len, dtype=dtype)
+    _SWEEPS.labels(op=PAGED_OP).inc()
+    swept: List[dict] = []
+    for bh in (candidates or PAGED_CANDIDATES):
+        if bh > h or h % bh:
+            continue
+
+        def run(qq, kk, vv, bh=bh):
+            return pa.paged_attention(qq, kk, vv, table, lengths,
+                                      page_size=page_size, block_h=bh,
+                                      interpret=interpret)
+
+        entry = {"block_h": bh}
+        try:
+            jitted = jax.jit(run)
+            try:
+                entry["hbm"] = memory_fields(
+                    jitted.lower(q, k_pages, v_pages).compile())
+            except Exception:
+                entry["hbm"] = {}
+            entry["elapsed_ms"] = round(
+                _time_probe(jitted, q, k_pages, v_pages, iters=iters), 4)
+        except Exception as e:   # candidate doesn't compile/fit: skip it
+            entry["error"] = str(e)[:200]
+            swept.append(entry)
+            continue
+        swept.append(entry)
+    timed = [e for e in swept if "elapsed_ms" in e]
+    if not timed:
+        return None
+    best = dict(min(timed, key=lambda e: e["elapsed_ms"]))
+    best["swept"] = swept
+    record(PAGED_OP, paged_key(q_len, pages_per_slot, page_size, h, d,
+                               dtype), best)
+    return best

@@ -55,6 +55,13 @@ class ServingConfig:
     gen_top_k: int = 0                   # sampling top-k (0 = full dist;
                                          # static: part of the ONE compiled
                                          # decode executable)
+    gen_spec_k: int = 0                  # speculative decode: tokens per
+                                         # verify step (0/1 = classic
+                                         # single-token decode; >=2 = k-gram
+                                         # self-draft + one k-token verify
+                                         # executable per (k, slot-count))
+    gen_spec_ngram: int = 3              # longest suffix n-gram the
+                                         # self-drafting proposer matches on
     # --- replica fleet (serving/fleet.py) ---
     replicas: int = 1                    # engine replicas behind the router
                                          # (1 = classic single-engine stack)
@@ -190,7 +197,9 @@ class ServingConfig:
                            ("gen_page_size", "page_size"),
                            ("gen_max_seq_len", "max_seq_len"),
                            ("gen_pages", "pages"),
-                           ("gen_top_k", "top_k")):
+                           ("gen_top_k", "top_k"),
+                           ("gen_spec_k", "spec_k"),
+                           ("gen_spec_ngram", "spec_ngram")):
             if key in raw:
                 flat[key] = int(raw[key])
             elif alias in gen:

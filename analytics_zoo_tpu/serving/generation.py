@@ -77,6 +77,24 @@ _GEN_PREEMPT = _tm.counter(
     "zoo_gen_preemptions_total",
     "Bulk decode slots preempted for latency-critical requests (the "
     "preempted stream keeps its KV pages and resumes in a later slot)")
+_GEN_SPEC_STEPS = _tm.counter(
+    "zoo_gen_spec_steps_total",
+    "Speculative verify steps executed (each scores spec_k tokens per slot "
+    "in one dispatch)")
+_GEN_SPEC_TOKENS = _tm.counter(
+    "zoo_gen_spec_tokens_total",
+    "Speculative-decode draft accounting: drafted = k-1 proposals per slot "
+    "per verify step, accepted = drafts the target confirmed (acceptance "
+    "rate = accepted/drafted)", labels=("kind",))
+_GEN_SPEC_ACCEPT_PROB = _tm.histogram(
+    "zoo_gen_spec_accept_prob",
+    "Per-draft acceptance probability under the target distribution "
+    "(pi(draft) from the verify step — the expected-acceptance signal)",
+    buckets=(.01, .05, .1, .25, .5, .75, .9, .99))
+_GEN_SWAPS = _tm.counter(
+    "zoo_gen_swaps_total",
+    "Atomic (target params, draft schedule) hot-swap pairs applied by live "
+    "continuous batchers between decode steps")
 _LIVE_GENERATORS: "weakref.WeakSet[ContinuousBatcher]" = weakref.WeakSet()
 _tm.collector("zoo_gen_active_slots",
               "Occupied decode slots summed over live continuous batchers",
@@ -187,15 +205,23 @@ class _Slot:
     """One decode slot's host-side state (device state lives in the cache)."""
 
     __slots__ = ("request", "length", "generated", "last_token", "pages",
-                 "handle")
+                 "handle", "history", "pending_drafts")
 
     def __init__(self, request: _Request, length: int, last_token: int,
-                 pages: List[int]):
+                 pages: List[int], history: Optional[List[int]] = None):
         self.request = request
         self.length = length            # tokens already in the cache
         self.generated = 1              # prefill samples token 0
         self.last_token = last_token    # sampled, not yet cached
         self.pages = pages              # owned page ids (freed on retire)
+        # full token sequence (prompt + emitted) — the self-drafting k-gram
+        # proposer's corpus; maintained in plain mode too so a hot-swap into
+        # speculative mode can draft for in-flight streams immediately
+        self.history: List[int] = history if history is not None else []
+        # drafted-but-not-yet-verified tokens: proposed right after a step
+        # so a PREEMPTED slot parks carrying its pending draft state and
+        # resumes without re-drafting (PR-13 composition)
+        self.pending_drafts: Optional[List[int]] = None
 
 
 class ContinuousBatcher:
@@ -218,6 +244,7 @@ class ContinuousBatcher:
     def __init__(self, model, params, *, n_slots: int = 8,
                  page_size: int = 16, max_seq_len: Optional[int] = None,
                  n_pages: Optional[int] = None, top_k: int = 0,
+                 spec_k: int = 0, spec_ngram: int = 3,
                  admit_policy: str = "continuous",
                  batch_window_s: float = 0.05,
                  graph_checks: Optional[str] = None,
@@ -227,6 +254,8 @@ class ContinuousBatcher:
                  autostart: bool = True):
         if admit_policy not in ("continuous", "batch"):
             raise ValueError(f"unknown admit_policy {admit_policy!r}")
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         if page_size & (page_size - 1):
             raise ValueError(f"page_size must be a power of two, got "
                              f"{page_size} (prefill buckets are pow2 and "
@@ -276,6 +305,17 @@ class ContinuousBatcher:
         # (the PR-8 fix) — the hold-hazard rule keeps that true
         # zoo-lock: guards(_slots, _table, _seq, _preempted)
         self._lock = traced_lock("ContinuousBatcher._lock")
+        # speculative decode (ISSUE 14): spec_k >= 2 switches the loop to
+        # the k-token verify executable; 0/1 is the classic one-token step.
+        # k and the drafter schedule are swappable at runtime as one pair
+        # with the params (swap_params — the hot-swap manifest contract)
+        self.spec_k = int(spec_k)
+        self.spec_ngram = int(spec_ngram)
+        if self.spec_k == 1:
+            self.spec_k = 0             # k=1 is definitionally plain decode
+        self._pending_swap: Optional[Tuple] = None
+        self.version: Optional[str] = None
+        self.swaps = 0
         # accounting
         self.steps = 0
         self.tokens_generated = 0
@@ -283,6 +323,15 @@ class ContinuousBatcher:
         self.loop_respawns = 0
         self.prefill_buckets: set = set()
         self.decode_shapes: set = set()
+        # spec accounting (acceptance rate = accepted/drafted)
+        self.spec_steps = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        # slot-occupancy integral: sum over steps of active-slot count —
+        # occupancy = _occupied_slot_steps / (steps * n_slots), the bench's
+        # per-entry utilization field
+        self._occupied_slot_steps = 0
+        self._decode_tokens = 0          # decode-phase tokens (excl prefill)
 
         cfg = self.cfg
         # Donate the KV page pool into both dispatches (the cache-alias
@@ -303,6 +352,11 @@ class ContinuousBatcher:
             lambda p, c, ids, ln, tb: model.prefill(
                 p, c, ids, ln, tb, page_size=cfg.page_size),
             donate_argnums=donate)
+        # one compiled verify executable per k ever used (lazily jitted; a
+        # spec-schedule hot-swap to a new k compiles exactly one more — the
+        # per-(k, slot-count) executable invariant the lint gate asserts)
+        self._verify_fns: Dict[int, Any] = {}
+        self._donate = donate
         from ..ops.kv_cache import sample_tokens
 
         self._sample = jax.jit(
@@ -446,6 +500,7 @@ class ContinuousBatcher:
                 # drill severs the loop here; the supervisor respawns it
                 chaos_point("serving.generate")
                 try:
+                    self._apply_pending_swap()
                     self._admit()
                     if self.active_slots() == 0:
                         if (self._pending.empty() and not self._backlog
@@ -668,16 +723,77 @@ class ContinuousBatcher:
             raise
         self.prefill_buckets.add(bucket)
         _GEN_TOKENS.labels(phase="prefill").inc(n_prompt)
+        slot = _Slot(req, n_prompt, tok, list(pages),
+                     history=req.prompt.tolist() + [tok])
+        if self.spec_k >= 2:
+            from ..ops.speculative import propose_kgram
+
+            slot.pending_drafts = propose_kgram(
+                slot.history, self.spec_k - 1, self.spec_ngram)
         with self._lock:
             self._table[slot_idx, :] = SCRATCH_PAGE
             self._table[slot_idx, :n_pg] = pages
-            self._slots[slot_idx] = _Slot(req, n_prompt, tok, list(pages))
-        self._emit(self._slots[slot_idx], [tok])
+            self._slots[slot_idx] = slot
+        self._emit(slot, [tok])
         self._maybe_finish(slot_idx)
 
     # decode ------------------------------------------------------------------
 
+    def _verify_fn(self, k: int):
+        """The compiled k-token verify executable (lazily jitted, cached
+        per k — exactly one executable per (k, slot-count))."""
+        fn = self._verify_fns.get(k)
+        if fn is None:
+            import jax
+
+            cfg = self.cfg
+            fn = jax.jit(
+                lambda p, c, ids, ln, tb, sd, ti, tp: self.model.verify_step(
+                    p, c, ids, ln, tb, sd, ti, tp, page_size=cfg.page_size,
+                    top_k=self.top_k), donate_argnums=self._donate)
+            self._verify_fns[k] = fn
+        return fn
+
+    def _apply_pending_swap(self):
+        """Land a staged (params, spec schedule) pair between decode steps:
+        the loop thread is the only dispatcher, so no step ever sees a
+        mixed (old params, new drafter) — the atomic manifest-pair flip
+        (see :meth:`swap_params`)."""
+        pend = self._pending_swap
+        if pend is None:
+            return
+        self._pending_swap = None
+        params, version, spec = pend
+        self.params = params
+        self.version = version
+        if spec is not None:
+            self.spec_k = 0 if spec.k == 1 else int(spec.k)
+            self.spec_ngram = int(spec.max_ngram)
+        with self._lock:
+            parked = list(self._preempted)
+        for slot in list(self._slots) + parked:
+            if slot is not None:
+                # proposals drafted under the OLD target die with it; the
+                # k-gram corpus (history) is model-independent and survives
+                slot.pending_drafts = None
+        self.swaps += 1
+        _GEN_SWAPS.inc()
+        logger.info("generation batcher swapped to version=%s spec_k=%d",
+                    version, self.spec_k)
+
     def _step(self):
+        if self.spec_k >= 2:
+            return self._step_spec()
+        self._step_plain()
+
+    def _step_plain(self, rows: Optional[List[int]] = None):
+        """One single-token decode dispatch. ``rows=None`` steps every
+        occupied slot (classic mode); a row subset steps only those slots,
+        with every other row masked to scratch in the dispatched table copy
+        — speculative mode's tail regime (slots within k of the cache cap,
+        or squeezed out of the k-page lookahead by a dry pool) rides the
+        SAME single-token executable plain decode uses, so those streams
+        emit and truncate exactly as the non-speculative loop would."""
         cfg = self.cfg
         b = self.n_slots
         ids = np.zeros(b, np.int32)
@@ -686,8 +802,10 @@ class ContinuousBatcher:
         tok_idx = np.zeros(b, np.uint32)
         temps = np.zeros(b, np.float32)
         finishes = []
+        live: List[int] = []
         with self._lock:
-            for i, slot in enumerate(self._slots):
+            for i in (range(b) if rows is None else rows):
+                slot = self._slots[i]
                 if slot is None:
                     continue
                 if slot.request.cancelled:
@@ -709,11 +827,15 @@ class ContinuousBatcher:
                 seeds[i] = slot.request.seed
                 tok_idx[i] = slot.generated
                 temps[i] = slot.request.temperature
+                live.append(i)
             table = self._table.copy()
-            active = [i for i, s in enumerate(self._slots) if s is not None]
+        if rows is not None:
+            for i in range(b):
+                if i not in live:  # mask non-members (incl. spec-active)
+                    table[i, :] = SCRATCH_PAGE
         for fin in finishes:       # final-frame callbacks OUTSIDE the lock
             self._finish_cb(*fin)
-        if not active:
+        if not live:
             return
         self.decode_shapes.add((b, cfg.pages_per_slot, cfg.page_size))
         t0 = time.monotonic()
@@ -723,9 +845,10 @@ class ContinuousBatcher:
         next_ids = np.asarray(next_ids)
         self.step_ema.observe(time.monotonic() - t0)
         self.steps += 1
+        self._occupied_slot_steps += len(live)
         _GEN_STEPS.inc()
         _mw.sample("serving.decode")
-        for i in active:
+        for i in live:
             with self._lock:
                 slot = self._slots[i]
             if slot is None:
@@ -734,8 +857,149 @@ class ContinuousBatcher:
             slot.length += 1           # last_token is now cached
             slot.last_token = tok
             slot.generated += 1
+            slot.history.append(tok)
+            self._decode_tokens += 1
             self._emit(slot, [tok])
             self._maybe_finish(i)
+
+    def _step_spec(self):
+        """One speculative verify step: draft k-1 tokens per slot (k-gram
+        self-draft), score all k positions in ONE dispatch, and advance each
+        slot by its accepted run + the target's correction/bonus token —
+        1..k tokens per stream per dispatch.
+
+        Slots that cannot take a whole verify step — within k of the cache
+        cap (including in-flight streams a hot-swap just raised k under),
+        or unable to claim the k-page lookahead from a dry pool — fall
+        back to the single-token executable (:meth:`_step_plain` over just
+        those rows) for this pass, so speculation NEVER changes what a
+        stream emits: not its tokens, and not its truncation point."""
+        from ..ops.speculative import propose_kgram
+
+        cfg = self.cfg
+        b = self.n_slots
+        k = self.spec_k
+        ids = np.zeros((b, k), np.int32)
+        lengths = np.zeros(b, np.int32)
+        seeds = np.zeros(b, np.uint32)
+        tok_idx = np.zeros(b, np.uint32)
+        temps = np.zeros(b, np.float32)
+        finishes = []
+        tail: List[int] = []
+        with self._lock:
+            for i, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                if slot.request.cancelled:
+                    finishes.append(self._retire_locked(i, "cancelled"))
+                    continue
+                if slot.length + k > cfg.max_seq_len:
+                    # tail regime: fewer than k positions remain (or a swap
+                    # raised k mid-stream) — single-token path below; this
+                    # row is masked out of the verify dispatch
+                    tail.append(i)
+                    continue
+                # grow: the verify step writes positions
+                # length .. length+k-1; allocate every page they span.
+                # A dry pool mid-lookahead is NOT a truncation — plain
+                # decode would only need the first of these pages — so the
+                # slot takes the single-token path this pass instead
+                # (pages already claimed stay; they back later positions)
+                first_pg = slot.length // cfg.page_size
+                last_pg = (slot.length + k - 1) // cfg.page_size
+                dry = False
+                for p in range(first_pg, last_pg + 1):
+                    if self._table[i, p] != SCRATCH_PAGE:
+                        continue
+                    try:
+                        (pg,) = self.pool.alloc(1)
+                    except OutOfPages:
+                        tail.append(i)
+                        dry = True
+                        break
+                    self._table[i, p] = pg
+                    slot.pages.append(pg)
+                if dry:
+                    continue
+                drafts = slot.pending_drafts
+                if drafts is None or len(drafts) != k - 1:
+                    drafts = propose_kgram(slot.history, k - 1,
+                                           self.spec_ngram)
+                    slot.pending_drafts = drafts
+                ids[i, 0] = slot.last_token
+                ids[i, 1:] = drafts
+                lengths[i] = slot.length
+                seeds[i] = slot.request.seed
+                tok_idx[i] = slot.generated
+                temps[i] = slot.request.temperature
+            table = self._table.copy()
+            active = [i for i, s in enumerate(self._slots) if s is not None]
+        spec_rows = [i for i in active if i not in tail]
+        for i in tail:
+            # scratch the tail rows' tables in the COPY: their verify-step
+            # writes land in scratch, never past their table's end
+            table[i, :] = SCRATCH_PAGE
+        for fin in finishes:       # final-frame callbacks OUTSIDE the lock
+            self._finish_cb(*fin)
+        if not spec_rows:
+            if tail:
+                self._step_plain(rows=tail)
+            return
+        self.decode_shapes.add((b, cfg.pages_per_slot, cfg.page_size, k))
+        t0 = time.monotonic()
+        accepted, tokens, draft_probs, self.cache = self._verify_fn(k)(
+            self.params, self.cache, ids, lengths, table, seeds, tok_idx,
+            temps)
+        accepted = np.asarray(accepted)
+        tokens = np.asarray(tokens)
+        draft_probs = np.asarray(draft_probs)
+        self.step_ema.observe(time.monotonic() - t0)
+        self.steps += 1
+        self.spec_steps += 1
+        self._occupied_slot_steps += len(spec_rows)
+        _GEN_STEPS.inc()
+        _GEN_SPEC_STEPS.inc()
+        _mw.sample("serving.decode")
+        for i in spec_rows:
+            with self._lock:
+                slot = self._slots[i]
+            if slot is None:
+                continue
+            req = slot.request
+            a = int(accepted[i])
+            # emit the confirmed run + the correction/bonus, clipped at the
+            # request budget / eos (any clip also satisfies _maybe_finish,
+            # so a partially-consumed run always retires)
+            emit: List[int] = []
+            for tok in (int(tokens[i, j]) for j in range(a + 1)):
+                emit.append(tok)
+                if req.eos_id is not None and tok == req.eos_id:
+                    break
+                if slot.generated + len(emit) >= req.max_new_tokens:
+                    break
+            slot.length += a + 1       # certain token + accepted drafts
+            slot.last_token = emit[-1]
+            slot.generated += len(emit)
+            slot.history.extend(emit)
+            slot.pending_drafts = None
+            self._decode_tokens += len(emit)
+            self.spec_drafted += k - 1
+            self.spec_accepted += a
+            _GEN_SPEC_TOKENS.labels(kind="drafted").inc(k - 1)
+            _GEN_SPEC_TOKENS.labels(kind="accepted").inc(a)
+            for j in range(min(a + 1, k - 1)):
+                _GEN_SPEC_ACCEPT_PROB.observe(float(draft_probs[i, j]))
+            self._emit(slot, emit)
+            self._maybe_finish(i)
+            with self._lock:
+                slot = self._slots[i]
+            if slot is not None:
+                # draft the NEXT proposals now: a slot preempted before its
+                # next verify parks carrying this pending draft state
+                slot.pending_drafts = propose_kgram(
+                    slot.history, k - 1, self.spec_ngram)
+        if tail:
+            self._step_plain(rows=tail)
 
     def _emit(self, slot: _Slot, tokens: List[int]):
         now = time.perf_counter()
@@ -808,6 +1072,41 @@ class ContinuousBatcher:
                 logger.exception("final-frame callback failed for %s",
                                  req.uri)
 
+    # ------------------------------------------------------------- hot swap
+
+    def swap_params(self, params, version: Optional[str] = None,
+                    spec=None) -> None:
+        """Stage an atomic (target params, draft schedule) flip — the
+        generation side of the PR-10 hot-swap contract: a publish carrying
+        both new weights AND a new speculative schedule (``spec`` — a
+        :class:`~analytics_zoo_tpu.ops.speculative.SpecDecodeConfig` or its
+        dict form, e.g. the manifest's ``spec`` field) lands as ONE pair
+        between decode steps; no step ever verifies new-model drafts with
+        old weights or vice versa. In-flight streams continue (their
+        pending proposals are re-drafted; the k-gram corpus survives). A
+        spec flip to a new ``k`` lazily compiles exactly one more verify
+        executable — the per-(k, slot-count) invariant holds."""
+        import jax
+
+        if spec is not None:
+            from ..ops.speculative import SpecDecodeConfig
+
+            if isinstance(spec, dict):
+                spec = SpecDecodeConfig(**spec)
+            elif not isinstance(spec, SpecDecodeConfig):
+                raise TypeError(f"spec must be a SpecDecodeConfig or dict, "
+                                f"got {type(spec).__name__}")
+        self._pending_swap = (jax.device_put(params), version, spec)
+        self._wake.set()
+
+    def host_params(self):
+        """Current target params as host arrays — the retention hook
+        :class:`~.hotswap.ModelSwapper` snapshots before a swap so
+        ``rollback()`` can restore the pre-swap pair."""
+        import jax
+
+        return jax.device_get(self.params)
+
     # ------------------------------------------------------------- diagnostics
 
     def check_decode_stability(self, mode: str = "warn",
@@ -831,7 +1130,8 @@ class ContinuousBatcher:
                   else self.hbm_budget_bytes)
         findings = lint_decode_stability(
             self.model, self.params, self.cfg, self.cache,
-            top_k=self.top_k, where="serving.generation",
+            top_k=self.top_k, spec_k=self.spec_k,
+            where="serving.generation",
             donate_cache=self.donate_cache, hbm_budget_bytes=budget,
             note_static_site="serving.decode")
         return enforce(findings, mode,
@@ -852,14 +1152,19 @@ class ContinuousBatcher:
 
         cfg = self.cfg
         b = self.n_slots
+        spec = self.spec_k >= 2
         sds = jax.ShapeDtypeStruct
-        args = (self.params, self.cache, sds((b,), jnp.int32),
+        ids_aval = (sds((b, self.spec_k), jnp.int32) if spec
+                    else sds((b,), jnp.int32))
+        args = (self.params, self.cache, ids_aval,
                 sds((b,), jnp.int32), sds((b, cfg.pages_per_slot), jnp.int32),
                 sds((b,), jnp.uint32), sds((b,), jnp.uint32),
                 sds((b,), jnp.float32))
-        fields = memory_fields(self._decode.lower(*args).compile())
+        dispatch = self._verify_fn(self.spec_k) if spec else self._decode
+        fields = memory_fields(dispatch.lower(*args).compile())
+        step = (self.model.verify_step if spec else self.model.decode_step)
         closed = jax.make_jaxpr(
-            lambda p, c, ids, ln, tb, sd, ti, tp: self.model.decode_step(
+            lambda p, c, ids, ln, tb, sd, ti, tp: step(
                 p, c, ids, ln, tb, sd, ti, tp, page_size=cfg.page_size,
                 top_k=self.top_k))(*args)
         n_params = len(jtu.tree_leaves(self.params))
@@ -881,7 +1186,7 @@ class ContinuousBatcher:
         with self._lock:
             active = sum(s is not None for s in self._slots)
             preempted = len(self._preempted)
-        return {
+        out = {
             "slots": self.n_slots,
             "active_slots": active,
             "preempted_parked": preempted,
@@ -894,9 +1199,39 @@ class ContinuousBatcher:
             "requests": dict(self.requests_finished),
             "loop_respawns": self.loop_respawns,
             "prefill_buckets": sorted(self.prefill_buckets),
-            # bucket invariant: ONE decode shape ever traced
+            # bucket invariant: ONE decode shape ever traced (per spec k —
+            # a schedule hot-swap legitimately adds its own entry)
             "distinct_decode_shapes": len(self.decode_shapes),
+            # slot-occupancy: mean fraction of slots active per decode step
+            # (the queue-wait-vs-decode-rate disambiguator in the bench)
+            "slot_occupancy": round(
+                self._occupied_slot_steps / (self.steps * self.n_slots), 4)
+            if self.steps else 0.0,
+            # decode tokens advanced per OCCUPIED slot-step: the dispatch-
+            # amortization factor speculative decode multiplies (1.0 for
+            # plain decode by construction; ~1 + acceptance*(k-1) in spec
+            # mode), independent of host speed and stream-tail scheduling
+            "tokens_per_slot_step": round(
+                self._decode_tokens / max(self._occupied_slot_steps, 1), 4)
+            if self._occupied_slot_steps else 0.0,
+            "model_version": self.version,
+            "swaps": self.swaps,
         }
+        if self.spec_k >= 2 or self.spec_steps:
+            out["spec"] = {
+                "k": self.spec_k,
+                "ngram": self.spec_ngram,
+                "steps": self.spec_steps,
+                "drafted": self.spec_drafted,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": round(
+                    self.spec_accepted / self.spec_drafted, 4)
+                if self.spec_drafted else 0.0,
+                "tokens_per_step": round(
+                    self.tokens_generated / self.steps, 3)
+                if self.steps else 0.0,
+            }
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -942,6 +1277,8 @@ class GenerationEngine:
                 model, params, n_slots=cfg.gen_slots,
                 page_size=cfg.gen_page_size, max_seq_len=cfg.gen_max_seq_len,
                 n_pages=cfg.gen_pages or None, top_k=cfg.gen_top_k,
+                spec_k=getattr(cfg, "gen_spec_k", 0),
+                spec_ngram=getattr(cfg, "gen_spec_ngram", 3),
                 hbm_budget_bytes=int(budget_mb * 2 ** 20) if budget_mb
                 else None,
                 graph_checks=None, autostart=False)

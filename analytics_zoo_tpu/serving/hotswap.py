@@ -362,10 +362,26 @@ class ModelSwapper:
 
     def swap(self, params: Any, record: Dict) -> str:
         """Atomic reference flip (plus rollback retention). Returns the new
-        version id."""
+        version id.
+
+        A record carrying a ``spec`` field (a speculative-decode schedule —
+        see :class:`~analytics_zoo_tpu.ops.speculative.SpecDecodeConfig`)
+        hands it to the model IN THE SAME ``swap_params`` call when the
+        target supports it (``ContinuousBatcher.swap_params``): target
+        weights and draft schedule flip as one manifest pair, never
+        observable half-applied. Models without a ``spec`` parameter
+        (the one-shot :class:`~..inference.InferenceModel`) ignore it."""
         prev_version = getattr(self.model, "version", None)
         prev_params = self.model.host_params()
-        self.model.swap_params(params, version=record["version"])
+        kw = {}
+        spec = record.get("spec")
+        if spec is not None:
+            import inspect
+
+            sig = inspect.signature(self.model.swap_params)
+            if "spec" in sig.parameters:
+                kw["spec"] = spec
+        self.model.swap_params(params, version=record["version"], **kw)
         self.prev = (prev_version, prev_params)
         self.current_step = int(record.get("step", 0))
         return record["version"]

@@ -1074,8 +1074,11 @@ def run_generation_bench(quick: bool = False) -> dict:
 
     def drive(b, n_streams, max_new, prompt_lens, repeat=1):
         """N concurrent client threads, each consuming its stream chunk by
-        chunk; returns (wall_s, tokens, itl_ms list, failures)."""
-        itls, fails = [], []
+        chunk; returns (wall_s, tokens, itl_ms list, failures, records) —
+        ``records`` carries per-stream (submit, first-frame, end) stamps so
+        queue wait and admitted-time decode rate report SEPARATELY (at
+        N >> slots, wall-clock per-stream tokens/s conflates the two)."""
+        itls, fails, records = [], [], []
         lock = _threading.Lock()
         total = [0]
 
@@ -1083,20 +1086,27 @@ def run_generation_bench(quick: bool = False) -> dict:
             for r in range(repeat):
                 try:
                     n_p = prompt_lens[(i + r) % len(prompt_lens)]
+                    t_sub = time.perf_counter()
                     h = b.submit(rng.integers(1, vocab, size=n_p).tolist(),
                                  max_new_tokens=max_new[(i + r)
                                                         % len(max_new)],
                                  temperature=0.7, seed=i * 97 + r)
                     last = time.perf_counter()
                     got = 0
+                    t_first = None
                     for chunk in h.tokens(timeout_s=300):
                         now = time.perf_counter()
+                        if t_first is None:
+                            t_first = now
                         with lock:
                             if got:     # first token latency != ITL
                                 itls.append((now - last) * 1e3)
                             total[0] += len(chunk)
                         got += len(chunk)
                         last = now
+                    with lock:
+                        records.append({"submit": t_sub, "first": t_first,
+                                        "end": last, "tokens": got})
                 except Exception as e:
                     with lock:
                         fails.append(repr(e))
@@ -1108,7 +1118,7 @@ def run_generation_bench(quick: bool = False) -> dict:
             t.start()
         for t in threads:
             t.join()
-        return time.perf_counter() - t0, total[0], itls, fails
+        return time.perf_counter() - t0, total[0], itls, fails, records
 
     out: dict = {"metric": "generation serving (continuous batching)",
                  "unit": "tokens/sec",
@@ -1121,18 +1131,32 @@ def run_generation_bench(quick: bool = False) -> dict:
     for n in stream_counts:
         b = make()
         try:
-            wall, tokens, itls, fails = drive(
+            wall, tokens, itls, fails, recs = drive(
                 b, n, max_new=[tokens_per_stream], prompt_lens=[7, 11, 15],
                 repeat=2 if n == 1 else 1)
+            # admitted-time accounting (ISSUE 14): at N streams over S < N
+            # slots, tokens/(wall*N) mixes queue wait into the decode rate
+            # (the 517-vs-627 per-stream artifact at N=32 vs N=8). Report
+            # the two separately: queue_wait = submit -> first frame
+            # (admission + prefill), admitted rate = tokens over the
+            # stream's OWN decode window only.
+            qw = [(r["first"] - r["submit"]) * 1e3 for r in recs]
+            adm = [(r["tokens"] - 1) / max(r["end"] - r["first"], 1e-9)
+                   for r in recs if r["tokens"] > 1]
             streams_out[str(n)] = {
                 "tokens_per_s": round(tokens / wall, 1),
                 "tokens": tokens, "wall_s": round(wall, 3),
                 "p50_itl_ms": round(float(np.percentile(itls, 50)), 3),
                 "p95_itl_ms": round(float(np.percentile(itls, 95)), 3),
+                "queue_wait_ms_p50": round(float(np.percentile(qw, 50)), 3),
+                "queue_wait_ms_p95": round(float(np.percentile(qw, 95)), 3),
+                "admitted_tokens_per_s_per_stream_p50": round(
+                    float(np.percentile(adm, 50)), 1),
                 "failed_streams": len(fails),
                 "first_failure": fails[0] if fails else None,
             }
             stats = b.stats()
+            streams_out[str(n)]["slot_occupancy"] = stats["slot_occupancy"]
             streams_out[str(n)]["distinct_decode_shapes"] = \
                 stats["distinct_decode_shapes"]
             streams_out[str(n)]["prefill_buckets"] = stats["prefill_buckets"]
@@ -1154,7 +1178,7 @@ def run_generation_bench(quick: bool = False) -> dict:
                 # bursty mix, longs interleaved 1-in-4 (chat-traffic shape):
                 # RTC waves are each gated by their slowest member;
                 # continuous admission backfills retired slots immediately
-                wall, tokens, _itls, fails = drive(
+                wall, tokens, _itls, fails, _recs = drive(
                     b, n_reqs, max_new=[long_tok, short_tok, short_tok,
                                         short_tok],
                     prompt_lens=[7])
@@ -1219,6 +1243,158 @@ def run_generation_bench(quick: bool = False) -> dict:
         decode_site = _mw.witness_samples().get("serving.decode")
         if decode_site:
             out["memory"]["witness"] = decode_site
+    out["platform"] = str(jax.devices()[0].platform)
+    return out
+
+
+def run_spec_generation_bench(quick: bool = False) -> dict:
+    """Speculative decode + fused paged-attention bench (ISSUE 14) — the
+    ``--generation --spec`` arm, merged into GENERATION_BENCH.json as the
+    ``speculative`` section.
+
+    * ``kernel_parity``: the fused paged-attention pallas kernel (interpret
+      mode on CPU) vs the gather + masked-dot reference at q_len ∈ {1, k},
+      f32 and bf16;
+    * ``baseline`` / ``speculative``: N=8 greedy streams, identical
+      prompts/seeds, plain decode vs k-gram self-draft + k-token verify —
+      tokens/sec, acceptance rate, tokens/step, and the token-identity
+      check (speculation must change COST, never CONTENT);
+    * ``lint_findings``: decode-shape-stability + cache-alias over the
+      VERIFY executable (must be empty), and the per-(k, slot-count)
+      one-executable invariant.
+
+    CPU quick gates: parity (f32 1e-4 / bf16 2e-2), greedy acceptance ≥
+    0.10, advance-per-dispatch ≥ 1.3 (the host-speed-independent proxy —
+    tokens advanced per occupied slot-dispatch; plain decode is 1.0 by
+    construction), token identity, one executable, findings empty. The
+    wall-clock ≥2× tokens/sec gate applies on TPU-platform runs only —
+    interpret-mode kernels and a 1-core host can't represent the
+    dispatch/HBM-bandwidth economics the speedup comes from.
+    """
+    import threading as _threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.models.transformer import TransformerLM
+    from analytics_zoo_tpu.ops.kv_cache import (decode_attention_multi,
+                                                paged_read)
+    from analytics_zoo_tpu.ops.paged_attention import (has_pallas,
+                                                       paged_attention,
+                                                       synthetic_paged_case)
+    from analytics_zoo_tpu.serving.generation import ContinuousBatcher
+
+    if quick:
+        vocab, hidden, n_block, n_head = 128, 64, 2, 2
+        max_seq, slots, n_streams, max_new = 128, 8, 8, 24
+    else:
+        vocab, hidden, n_block, n_head = 512, 256, 4, 4
+        max_seq, slots, n_streams, max_new = 256, 8, 8, 48
+    spec_k, page_size = 4, 16
+    out: dict = {"metric": "speculative decode + fused paged attention",
+                 "spec_k": spec_k, "slots": slots,
+                 "model": f"transformer_lm(vocab={vocab},hidden={hidden},"
+                          f"n_block={n_block},seq={max_seq})"}
+
+    # --- fused kernel vs reference numerics (interpret mode on CPU) -------
+    parity: dict = {"has_pallas": has_pallas()}
+    if has_pallas():
+        prng = np.random.default_rng(7)
+        h_, d_, pps_, ps_ = 4, 32, 6, 8
+        for dtype, label in ((np.float32, "float32"),
+                             (jnp.bfloat16, "bfloat16")):
+            entry = {}
+            for q_len in (1, spec_k):
+                q, kp, vp, table, lengths = synthetic_paged_case(
+                    4, pps_, ps_, h_, d_, q_len=q_len, dtype=dtype,
+                    lengths=np.maximum(q_len,
+                                       np.array([5, 17, 30, q_len])),
+                    rng=prng)
+                got = paged_attention(q, kp, vp, table, lengths,
+                                      page_size=ps_, interpret=True)
+                ref = decode_attention_multi(
+                    q, paged_read(kp, table).astype(q.dtype),
+                    paged_read(vp, table).astype(q.dtype), lengths)
+                entry[f"q{q_len}_max_err"] = float(
+                    np.max(np.abs(np.asarray(got, np.float32)
+                                  - np.asarray(ref, np.float32))))
+            parity[label] = entry
+    out["kernel_parity"] = parity
+
+    # --- spec vs plain decode arms (greedy, identical traffic) ------------
+    model = TransformerLM(vocab=vocab, hidden_size=hidden, n_block=n_block,
+                          n_head=n_head, seq_len=max_seq)
+    params, _ = model.build(jax.random.PRNGKey(0))
+
+    def arm(k: int) -> dict:
+        b = ContinuousBatcher(model, params, n_slots=slots,
+                              page_size=page_size, max_seq_len=max_seq,
+                              spec_k=k)
+        try:
+            rng = np.random.default_rng(0)
+            # warm the prefill bucket + the decode/verify executable
+            b.generate(rng.integers(1, vocab, size=7).tolist(),
+                       max_new_tokens=2)
+            streams: list = [None] * n_streams
+            fails: list = []
+            lock = _threading.Lock()
+
+            def client(i):
+                r = np.random.default_rng(100 + i)
+                try:
+                    toks = b.generate(
+                        r.integers(1, vocab, size=7).tolist(),
+                        max_new_tokens=max_new, temperature=0.0,
+                        seed=i * 13, timeout_s=300)
+                    with lock:
+                        streams[i] = toks
+                except Exception as e:
+                    with lock:
+                        fails.append(repr(e))
+
+            threads = [_threading.Thread(target=client, args=(i,))
+                       for i in range(n_streams)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            stats = b.stats()
+            findings = [f.as_dict()
+                        for f in b.check_decode_stability("warn")]
+            total = sum(len(s) for s in streams if s)
+            entry = {
+                "tokens_per_s": round(total / wall, 1),
+                "tokens": total, "wall_s": round(wall, 3),
+                "steps": stats["steps"],
+                "tokens_per_step": round(total / max(stats["steps"], 1), 3),
+                "tokens_per_slot_step": stats["tokens_per_slot_step"],
+                "failed_streams": len(fails),
+                "first_failure": fails[0] if fails else None,
+                "distinct_decode_shapes": stats["distinct_decode_shapes"],
+                "findings": findings,
+            }
+            if k >= 2:
+                entry["acceptance_rate"] = stats["spec"]["acceptance_rate"]
+            return entry, streams
+        finally:
+            b.close()
+
+    base, base_streams = arm(0)
+    spec, spec_streams = arm(spec_k)
+    out["baseline"] = base
+    out["speculative"] = spec
+    out["speedup"] = round(spec["tokens_per_s"]
+                           / max(base["tokens_per_s"], 1e-9), 2)
+    # the host-speed-independent win: decode tokens advanced per occupied
+    # slot-dispatch (1.0 for single-token decode by construction) — what a
+    # dispatch/HBM-bound backend converts into the wall-clock speedup
+    out["advance_per_dispatch"] = round(
+        spec["tokens_per_slot_step"]
+        / max(base["tokens_per_slot_step"], 1e-9), 2)
+    out["greedy_token_identical"] = bool(
+        all(a == b_ for a, b_ in zip(base_streams, spec_streams)))
     out["platform"] = str(jax.devices()[0].platform)
     return out
 
@@ -2257,6 +2433,8 @@ if __name__ == "__main__":
 
             _jax.config.update("jax_platforms", "cpu")
         gb = run_generation_bench(quick=quick)
+        if "--spec" in sys.argv:
+            gb["speculative_decode"] = run_spec_generation_bench(quick=quick)
         if not quick:
             # like the other quick gates: a CPU smoke run must never clobber
             # the committed (possibly TPU-measured) artifact
@@ -2320,6 +2498,59 @@ if __name__ == "__main__":
                   f"(pool {mem['cache_bytes']}B), witness="
                   f"{'on' if mem.get('witness') else 'off'}",
                   file=sys.stderr)
+            sg = gb.get("speculative_decode")
+            if sg is not None:
+                # --spec quick gates (ISSUE 14)
+                kp = sg["kernel_parity"]
+                if kp.get("has_pallas"):
+                    for lbl, atol in (("float32", 1e-4), ("bfloat16", 2e-2)):
+                        for key, err in kp[lbl].items():
+                            assert err <= atol, (
+                                f"paged-attention kernel {lbl} {key} "
+                                f"diverges from the plain-dot reference: "
+                                f"{err} > {atol}")
+                assert sg["greedy_token_identical"], (
+                    "speculative greedy streams diverged from the "
+                    "single-token baseline — the accept/reject rule is "
+                    "changing CONTENT, not just cost")
+                for arm_name in ("baseline", "speculative"):
+                    a = sg[arm_name]
+                    assert a["failed_streams"] == 0, (
+                        f"{arm_name} arm failed streams: "
+                        f"{a['first_failure']}")
+                    assert a["distinct_decode_shapes"] == 1, (
+                        f"{arm_name} arm compiled "
+                        f"{a['distinct_decode_shapes']} decode shapes — "
+                        f"the one-executable-per-(k, slot-count) "
+                        f"invariant broke")
+                    assert not a["findings"], (
+                        f"{arm_name} decode lint findings:\n" + "\n".join(
+                            f"  {f['location']}: {f['message']}"
+                            for f in a["findings"]))
+                acc = sg["speculative"]["acceptance_rate"]
+                assert acc >= 0.10, (
+                    f"greedy self-draft acceptance {acc} < 0.10 floor — "
+                    f"the k-gram proposer is not tracking the target")
+                # speedup gate, split by platform (ISSUE 14 acceptance
+                # criteria): TPU gates the wall-clock >=2x claim; on CPU —
+                # where the verify step's k-fold FLOPs are NOT hidden
+                # behind dispatch/HBM latency — gate the host-speed-
+                # independent advance-per-dispatch factor instead (what a
+                # dispatch-bound backend converts into wall clock)
+                if sg["platform"] == "tpu":
+                    assert sg["speedup"] >= 2.0, (
+                        f"speculative decode speedup {sg['speedup']}x < "
+                        f"2.0x over single-token decode at N=8 greedy "
+                        f"streams (TPU threshold)")
+                adv = sg["advance_per_dispatch"]
+                assert adv >= 1.3, (
+                    f"speculative decode advances only {adv}x tokens per "
+                    f"occupied slot-dispatch (need >=1.3x; plain decode "
+                    f"is 1.0 by construction)")
+                print(f"[bench] spec quick gate OK: "
+                      f"{adv}x tokens/dispatch (wall {sg['speedup']}x on "
+                      f"{sg['platform']}), acceptance {acc}, "
+                      f"parity+identity+lint green", file=sys.stderr)
         sys.exit(0)
     if "--data-pipeline" in sys.argv:
         # standalone input-pipeline micro-bench, ALWAYS on the CPU backend:

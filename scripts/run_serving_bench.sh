@@ -60,10 +60,16 @@ if [[ "${1:-}" == "--quick" ]]; then
     # bytes, the bench gates flatness + KV-pool donation (static peak drops
     # by one pool; the compiled executable aliases it input->output), and
     # the dump is re-checked offline below
+    # --spec (ISSUE 14): speculative-decode + fused paged-attention gates —
+    # kernel-vs-plain-dot parity on CPU interpret mode, greedy self-draft
+    # acceptance >= floor, >=1.3x tokens advanced per decode dispatch
+    # (the TPU wall-clock >=2x gate's host-independent proxy), greedy
+    # streams token-identical to the single-token baseline, ONE verify
+    # executable per (k, slot-count), decode+cache-alias lints empty
     MEM_WITNESS="$(mktemp -t zoo_mem_witness.XXXXXX.jsonl)"
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
         ZOO_TPU_MEM_WITNESS="$MEM_WITNESS" \
-        python bench.py --generation --quick
+        python bench.py --generation --spec --quick
     timeout -k 10 120 env JAX_PLATFORMS=cpu \
         python -m analytics_zoo_tpu.analysis --mem-witness "$MEM_WITNESS"
     # replica-fleet gate: zero lost requests with one of 4 replicas chaos-
