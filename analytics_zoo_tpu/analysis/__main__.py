@@ -150,6 +150,11 @@ def main(argv=None) -> int:
                              "budget in MiB for sites without a recorded "
                              "budget (default: env ZOO_TPU_HBM_BUDGET_MB, "
                              "else off)")
+    parser.add_argument("--metrics-doc", action="store_true",
+                        help="print regenerated docs/observability.md "
+                             "metric-table rows for every registered zoo_* "
+                             "family and exit (the metric-doc-drift repair "
+                             "helper)")
     args = parser.parse_args(argv)
     if args.max_hold_s is None:
         args.max_hold_s = _env_max_hold_s()
@@ -168,6 +173,12 @@ def main(argv=None) -> int:
     # default target: the analytics_zoo_tpu package this module lives in
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     paths = args.paths or [pkg_root]
+
+    if args.metrics_doc:
+        from .rules.docs import render_metric_table
+
+        print(render_metric_table(paths))
+        return 0
 
     if args.witness is not None or args.mem_witness is not None:
         findings, extra, detail = [], {}, []
@@ -207,6 +218,19 @@ def main(argv=None) -> int:
             fs, ns = lint_file(path, rules=rules)
         findings.extend(fs)
         suppressed += ns
+
+    # metric-doc-drift runs on whole-package lints only (explicit PATHS lint
+    # a slice, where "registered but undocumented" would false-positive the
+    # other direction); the doc lives beside the package checkout
+    if not args.paths and args.rules is None:
+        doc_path = os.path.join(os.path.dirname(pkg_root), "docs",
+                                "observability.md")
+        if os.path.exists(doc_path):
+            from .core import report
+            from .rules.docs import check_metric_doc_drift
+
+            findings.extend(report(
+                check_metric_doc_drift(paths, doc_path)))
 
     errors = [f for f in findings if f.severity == "error"]
     if args.json:

@@ -11,21 +11,26 @@ their traversal machinery; the concurrency tier (guarded-by, lock-order
 cycles, hold hazards, leaf/unused/reach-in checks) lives in
 :mod:`concurrency` over the lock models of :mod:`analysis.concurrency`; the
 memory tier's repo-wide ``donation-missed`` rebind check lives in
-:mod:`memory` too. All are registered by this import.
+:mod:`memory` too. Docs layer: :mod:`docs` (``metric-doc-drift`` — the
+registered ``zoo_*`` metric set vs. the docs/observability.md tables,
+driven by ``__main__`` on whole-package lints). All are registered by this
+import.
 """
 
-from . import (collectives, concurrency, decode, fused_int8,  # noqa: F401
-               graph_hygiene, memory)
+from . import (collectives, concurrency, decode, docs,  # noqa: F401
+               fused_int8, graph_hygiene, memory)
 from .. import astlint  # noqa: F401  (registers the AST rules)
 
 from .collectives import collective_counts, jaxpr_collective_counts
 from .decode import lint_decode_stability
+from .docs import check_metric_doc_drift, render_metric_table
 from .fused_int8 import fused_dispatch_report, fused_structure_counts
 from .memory import flatten_donation, lint_donation, lint_memory
 
 __all__ = [
-    "collective_counts", "collectives", "concurrency", "decode",
-    "flatten_donation", "fused_dispatch_report", "fused_int8",
-    "fused_structure_counts", "graph_hygiene", "jaxpr_collective_counts",
-    "lint_decode_stability", "lint_donation", "lint_memory", "memory",
+    "check_metric_doc_drift", "collective_counts", "collectives",
+    "concurrency", "decode", "docs", "flatten_donation",
+    "fused_dispatch_report", "fused_int8", "fused_structure_counts",
+    "graph_hygiene", "jaxpr_collective_counts", "lint_decode_stability",
+    "lint_donation", "lint_memory", "memory", "render_metric_table",
 ]

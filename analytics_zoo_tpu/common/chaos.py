@@ -123,6 +123,14 @@ class ChaosSchedule:
             self._counts[key] = n
             hits = [r for r in self._rules if r.matches(site, tag, n)]
         for r in hits:
+            # every injected fault is a decision event: a drill's faults are
+            # auditable next to the failovers/rollbacks they provoked
+            # (lazy import: chaos must stay importable before observability)
+            from ..observability import events as _ev
+
+            _ev.emit("chaos.injected", severity="warning", site=site,
+                     tag=repr(tag) if tag is not None else None,
+                     action=r.action, occurrence=n)
             if r.action == "delay":
                 time.sleep(r.delay_s)
             elif r.action == "fail":

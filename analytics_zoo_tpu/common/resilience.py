@@ -342,15 +342,31 @@ class CircuitBreaker:
             else:
                 self._outcomes.append(True)
 
+    def _emit_open(self, cause: str) -> None:
+        """Decision event for an OPEN transition — emitted OUTSIDE the
+        breaker lock (the lock is a declared leaf). Callers may still hold
+        THEIR locks here (the router resolves probes under its own); emit is
+        safe there — sink I/O runs on the event log's drain thread, never
+        on this thread."""
+        from ..observability import events as _ev
+
+        _ev.emit("breaker.open", severity="warning", name=self.name,
+                 cause=cause)
+
     def record_failure(self):
+        opened = False
         with self._lock:
             if self._state == self.HALF_OPEN:
                 self._open()
-                return
-            self._outcomes.append(False)
-            if sum(1 for ok in self._outcomes if not ok) \
-                    >= self.failure_threshold:
-                self._open()
+                opened = True
+            else:
+                self._outcomes.append(False)
+                if sum(1 for ok in self._outcomes if not ok) \
+                        >= self.failure_threshold:
+                    self._open()
+                    opened = True
+        if opened:
+            self._emit_open("failures")
 
     def trip(self):
         """Force the circuit OPEN immediately, regardless of the outcome
@@ -358,11 +374,15 @@ class CircuitBreaker:
         guarded component dead shouldn't wait for ``failure_threshold``
         doomed calls to discover it). The normal open → half-open → probe
         readmission path applies from here."""
+        opened = False
         with self._lock:
             if self._state != self.OPEN:
                 self._open()
+                opened = True
             else:
                 self._opened_at = self._clock()   # restart the probe timer
+        if opened:
+            self._emit_open("tripped")
 
     def call(self, fn: Callable, *args, **kw) -> Any:
         """Run ``fn`` through the breaker; raises :class:`CircuitOpenError`

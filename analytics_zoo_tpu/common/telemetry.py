@@ -61,8 +61,8 @@ __all__ = [
     "TraceContext", "Span", "SpanRecord", "counter", "gauge", "histogram",
     "collector", "default_registry", "render_prometheus", "snapshot",
     "write_jsonl", "parse_prometheus", "span", "record_span", "spans",
-    "current_span", "current_wire_context", "reset_telemetry",
-    "DEFAULT_BUCKETS",
+    "trace_ids", "protected_trace_ids", "pin_trace", "current_span",
+    "current_wire_context", "reset_telemetry", "DEFAULT_BUCKETS",
 ]
 
 
@@ -165,20 +165,31 @@ class _CounterCell:
 
 
 class _HistCell:
-    __slots__ = ("counts", "sum")
+    __slots__ = ("counts", "sum", "ex")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets
         self.sum = 0.0
+        # per-bucket last exemplar (trace_id, value, wall_ts) or None —
+        # allocated lazily so exemplar-free histograms pay nothing
+        self.ex: Optional[List[Optional[Tuple[str, float, float]]]] = None
 
     def zero(self):
         self.counts = [0] * len(self.counts)
         self.sum = 0.0
+        self.ex = None
 
     def merge(self, other: "_HistCell"):
         for i, n in enumerate(other.counts):
             self.counts[i] += n
         self.sum += other.sum
+        if other.ex is not None:
+            if self.ex is None:
+                self.ex = [None] * len(self.counts)
+            for i, e in enumerate(other.ex):
+                if e is not None and (self.ex[i] is None
+                                      or e[2] >= self.ex[i][2]):
+                    self.ex[i] = e
 
 
 # ---------------------------------------------------------------------------
@@ -238,27 +249,45 @@ class Histogram:
         n = len(bs) + 1          # trailing slot = +Inf
         self._shards = _Shards(lambda: _HistCell(n))
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         cell = self._shards.cell()
-        cell.counts[bisect_left(self.buckets, v)] += 1
+        idx = bisect_left(self.buckets, v)
+        cell.counts[idx] += 1
         cell.sum += v
+        if exemplar:
+            # OpenMetrics exemplar: the last trace that landed in this bucket
+            # (per-thread cell write — lock-free like the count itself)
+            if cell.ex is None:
+                cell.ex = [None] * len(cell.counts)
+            cell.ex[idx] = (str(exemplar), float(v), time.time())
 
     def snapshot(self) -> Dict[str, Any]:
         """Merged ``{"buckets": [(le, cumulative), ...], "sum": s,
-        "count": n}``."""
+        "count": n, "exemplars": [(le, trace_id, value, ts), ...]}`` —
+        ``exemplars`` lists only buckets that hold one."""
         counts = [0] * (len(self.buckets) + 1)
+        ex: List[Optional[Tuple[str, float, float]]] = \
+            [None] * (len(self.buckets) + 1)
         total = 0.0
         for c in self._shards.cells():
             for i, n in enumerate(c.counts):
                 counts[i] += n
             total += c.sum
+            if c.ex is not None:
+                for i, e in enumerate(c.ex):
+                    if e is not None and (ex[i] is None or e[2] >= ex[i][2]):
+                        ex[i] = e
         cum, out = 0, []
         for le, n in zip(self.buckets, counts):
             cum += n
             out.append((le, cum))
         cum += counts[-1]
         out.append((float("inf"), cum))
-        return {"buckets": out, "sum": total, "count": cum}
+        les = list(self.buckets) + [float("inf")]
+        exemplars = [(les[i], e[0], e[1], e[2])
+                     for i, e in enumerate(ex) if e is not None]
+        return {"buckets": out, "sum": total, "count": cum,
+                "exemplars": exemplars}
 
     def count(self) -> int:
         return self.snapshot()["count"]
@@ -335,8 +364,8 @@ class MetricFamily:
     def add(self, v: float):
         self.labels().add(v)
 
-    def observe(self, v: float):
-        self.labels().observe(v)
+    def observe(self, v: float, exemplar: Optional[str] = None):
+        self.labels().observe(v, exemplar=exemplar)
 
     def value(self) -> float:
         return self.labels().value()
@@ -441,8 +470,12 @@ class MetricRegistry:
             self._collectors[name] = (help, kind, tuple(labels), fn)
 
     # -- exposition ----------------------------------------------------------
-    def render_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+    def render_prometheus(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition. ``openmetrics=True`` additionally
+        emits exemplar trailers on histogram bucket lines — exemplars are
+        only legal in the OpenMetrics format, so the default (0.0.4
+        text) stays consumable by stock Prometheus scrapers; the HTTP
+        frontend negotiates via the Accept header."""
         lines: List[str] = []
         with self._lock:
             families = sorted(self._families.items())
@@ -454,10 +487,22 @@ class MetricRegistry:
                 ls = _labels_str(fam.label_names, values)
                 if fam.kind == "histogram":
                     snap = child.snapshot()
+                    ex_by_le = {le: (tid, v, ts) for le, tid, v, ts
+                                in snap.get("exemplars", ())} \
+                        if openmetrics else {}
                     for le, cum in snap["buckets"]:
                         bl = _labels_str(fam.label_names, values,
                                          [("le", _fmt_value(le))])
-                        lines.append(f"{name}_bucket{bl} {cum}")
+                        line = f"{name}_bucket{bl} {cum}"
+                        ex = ex_by_le.get(le)
+                        if ex is not None:
+                            # OpenMetrics exemplar trailer: the last trace id
+                            # that landed in this bucket, linking the scrape
+                            # to /debug/traces/<id>
+                            tid, v, ts = ex
+                            line += (f' # {{trace_id="{_escape_label(tid)}"}}'
+                                     f" {_fmt_value(v)} {ts:.3f}")
+                        lines.append(line)
                     lines.append(
                         f"{name}_sum{ls} {_fmt_value(snap['sum'])}")
                     lines.append(f"{name}_count{ls} {snap['count']}")
@@ -475,8 +520,13 @@ class MetricRegistry:
                 lines.append(f"{name}{ls} {_fmt_value(v)}")
         return "\n".join(lines) + "\n"
 
-    def snapshot(self) -> Dict[str, Any]:
-        """JSON-able merged view of every family + collector."""
+    def snapshot(self, buckets: bool = False) -> Dict[str, Any]:
+        """JSON-able merged view of every family + collector.
+
+        ``buckets=True`` additionally carries each histogram child's
+        cumulative ``(le, count)`` ladder — what the observability history
+        store samples so quantile-over-time queries can difference bucket
+        counts between two points in time."""
         out: Dict[str, Any] = {}
         with self._lock:
             families = list(self._families.items())
@@ -487,8 +537,10 @@ class MetricRegistry:
                 key = ",".join(values) if values else ""
                 if fam.kind == "histogram":
                     snap = child.snapshot()
-                    entry["samples"][key] = {"sum": snap["sum"],
-                                             "count": snap["count"]}
+                    sample = {"sum": snap["sum"], "count": snap["count"]}
+                    if buckets:
+                        sample["buckets"] = snap["buckets"]
+                    entry["samples"][key] = sample
                 else:
                     entry["samples"][key] = child.value()
             out[name] = entry
@@ -527,6 +579,10 @@ class MetricRegistry:
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(-?[0-9.eE+-]+|[+-]Inf|NaN)$")
 _LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+# OpenMetrics exemplar trailer: `# {label="v",...} value [timestamp]`
+_EXEMPLAR_RE = re.compile(
+    r"^\{(?P<labels>.*)\}\s+(?P<value>-?[0-9.eE+-]+|[+-]Inf|NaN)"
+    r"(?:\s+(?P<ts>[0-9.eE+-]+))?$")
 
 
 def _unescape_label(s: str) -> str:
@@ -538,9 +594,11 @@ def _unescape_label(s: str) -> str:
 
 def parse_prometheus(text: str) -> Dict[str, Dict[str, Any]]:
     """Parse Prometheus text format into ``{family: {"type": ...,
-    "samples": [(name, labels_dict, value), ...]}}``. Raises
-    :class:`TelemetryError` on a malformed line — the bench uses this as its
-    validity assertion."""
+    "samples": [(name, labels_dict, value), ...]}}``. OpenMetrics exemplar
+    trailers (``... # {trace_id="x"} 0.42 ts``) are parsed into an
+    ``"exemplars"`` list of ``(sample_name, labels_dict, exemplar_dict)``
+    per family. Raises :class:`TelemetryError` on a malformed line — the
+    bench uses this as its validity assertion."""
     out: Dict[str, Dict[str, Any]] = {}
 
     def family_of(sample_name: str) -> str:
@@ -565,6 +623,24 @@ def parse_prometheus(text: str) -> Dict[str, Dict[str, Any]]:
                 out.setdefault(parts[2], {"type": ptype, "samples": []})
             continue
         m = _SAMPLE_RE.match(line)
+        exemplar = None
+        if not m and " # {" in line:
+            # exemplar trailer — split at the LAST marker so a (pathological)
+            # label value containing the marker still parses as a sample
+            sample_part, _sep, ex_part = line.rpartition(" # {")
+            em = _EXEMPLAR_RE.match("{" + ex_part)
+            if em is not None:
+                m = _SAMPLE_RE.match(sample_part)
+                if m is not None:
+                    ex_labels = {lm.group(1): _unescape_label(lm.group(2))
+                                 for lm in _LABEL_PAIR_RE.finditer(
+                                     em.group("labels"))}
+                    exemplar = {
+                        "labels": ex_labels,
+                        "value": float(em.group("value")
+                                       .replace("Inf", "inf")),
+                        "ts": (float(em.group("ts"))
+                               if em.group("ts") else None)}
         if not m:
             raise TelemetryError(f"line {lineno}: malformed sample {line!r}")
         name, labels_raw, value = m.group(1), m.group(2), m.group(3)
@@ -583,6 +659,9 @@ def parse_prometheus(text: str) -> Dict[str, Dict[str, Any]]:
         fam = family_of(name)
         out.setdefault(fam, {"type": "untyped", "samples": []})
         out[fam]["samples"].append((name, labels, v))
+        if exemplar is not None:
+            out[fam].setdefault("exemplars", []).append(
+                (name, labels, exemplar))
     return out
 
 
@@ -653,32 +732,113 @@ _current_span: "contextvars.ContextVar[Optional[Span]]" = \
 
 
 class _SpanRecorder:
-    """Bounded in-memory buffer of finished spans."""
+    """Bounded in-memory buffer of finished spans, evicted by WHOLE TRACE.
 
-    def __init__(self, maxlen: int = 8192):
+    The old flat deque evicted the oldest SPANS regardless of trace
+    membership, so a long-lived trace lost its parent/root spans and rendered
+    as orphans in the exporter — fatal once tail sampling made "keep this
+    trace whole" load-bearing. Spans are now bucketed per trace (insertion
+    order = trace age) and eviction drops the oldest whole trace at a time.
+
+    Tail retention: traces with an errored span, and the traces holding the
+    ``keep_slowest`` longest spans seen so far, are evicted LAST (they are
+    exactly what an operator wants whole after an incident). Memory stays
+    bounded regardless — when only protected traces remain over budget, the
+    oldest protected trace goes too.
+    """
+
+    def __init__(self, maxlen: int = 8192, keep_slowest: int = 16,
+                 max_pinned: int = 64):
         import collections
 
         self._lock = threading.Lock()
-        self._buf: "collections.deque[SpanRecord]" = \
-            collections.deque(maxlen=maxlen)
+        self._maxlen = maxlen
+        self._keep_slowest = keep_slowest
+        self._max_pinned = max_pinned
+        self._traces: "collections.OrderedDict[str, List[SpanRecord]]" = \
+            collections.OrderedDict()
+        self._count = 0
+        self._errored: Dict[str, None] = {}       # insertion-ordered set
+        self._slow: Dict[str, float] = {}         # trace_id -> max duration
+        # explicitly pinned traces (decision events pin theirs so an audit
+        # entry's trace survives high-traffic churn); bounded FIFO
+        self._pinned: Dict[str, None] = {}
 
     def record(self, rec: SpanRecord) -> None:
         with self._lock:
-            self._buf.append(rec)
+            bucket = self._traces.get(rec.trace_id)
+            if bucket is None:
+                bucket = self._traces[rec.trace_id] = []
+            bucket.append(rec)
+            self._count += 1
+            if rec.status != "ok":
+                self._errored[rec.trace_id] = None
+            cur = self._slow.get(rec.trace_id)
+            if cur is None or rec.duration_s > cur:
+                self._slow[rec.trace_id] = rec.duration_s
+                if len(self._slow) > self._keep_slowest:
+                    fastest = min(self._slow, key=self._slow.get)
+                    del self._slow[fastest]
+            self._evict_locked()
+
+    def pin(self, trace_id: str) -> None:
+        """Retain ``trace_id`` through eviction (decision-event traces).
+        Bounded: past ``max_pinned`` pins the oldest pin is released."""
+        with self._lock:
+            self._pinned[trace_id] = None
+            while len(self._pinned) > self._max_pinned:
+                self._pinned.pop(next(iter(self._pinned)))
+
+    def _evict_locked(self) -> None:
+        while self._count > self._maxlen and self._traces:
+            victim = None
+            for tid in self._traces:            # oldest unprotected first
+                if tid not in self._errored and tid not in self._slow \
+                        and tid not in self._pinned:
+                    victim = tid
+                    break
+            if victim is None:                  # all protected: oldest goes
+                victim = next(iter(self._traces))
+            dropped = self._traces.pop(victim)
+            self._count -= len(dropped)
+            self._errored.pop(victim, None)
+            self._slow.pop(victim, None)
+            self._pinned.pop(victim, None)
 
     def spans(self, trace_id: Optional[str] = None,
               name: Optional[str] = None) -> List[SpanRecord]:
         with self._lock:
-            out = list(self._buf)
-        if trace_id is not None:
-            out = [s for s in out if s.trace_id == trace_id]
+            if trace_id is not None:
+                out = list(self._traces.get(trace_id, ()))
+            else:
+                out = [s for bucket in self._traces.values() for s in bucket]
         if name is not None:
             out = [s for s in out if s.name == name]
         return out
 
+    def trace_ids(self) -> List[str]:
+        """Known trace ids, oldest first."""
+        with self._lock:
+            return list(self._traces)
+
+    def protected_ids(self) -> Dict[str, str]:
+        """``{trace_id: reason}`` for tail-retained traces (``error`` wins
+        over ``pinned`` wins over ``slow``)."""
+        with self._lock:
+            out = {tid: "slow" for tid in self._slow if tid in self._traces}
+            out.update({tid: "pinned" for tid in self._pinned
+                        if tid in self._traces})
+            out.update({tid: "error" for tid in self._errored
+                        if tid in self._traces})
+            return out
+
     def clear(self) -> None:
         with self._lock:
-            self._buf.clear()
+            self._traces.clear()
+            self._errored.clear()
+            self._slow.clear()
+            self._pinned.clear()
+            self._count = 0
 
 
 class Span:
@@ -766,7 +926,10 @@ _SPAN_ERRORS = _DEFAULT.counter(
 
 def _finish(name, trace_id, span_id, parent_id, wall, duration_s, status,
             tags) -> SpanRecord:
-    _SPAN_HIST.labels(span=name).observe(duration_s)
+    # the span's trace id rides the histogram bucket as an OpenMetrics
+    # exemplar, linking a latency bucket on the scrape to a concrete
+    # exported trace (/debug/traces/<id>)
+    _SPAN_HIST.labels(span=name).observe(duration_s, exemplar=trace_id)
     if status != "ok":
         _SPAN_ERRORS.labels(span=name).inc()
     rec = SpanRecord(name, trace_id, span_id, parent_id, wall,
@@ -802,12 +965,12 @@ def collector(name: str, help: str, fn: Callable,
     _DEFAULT.collector(name, help, fn, labels, kind)
 
 
-def render_prometheus() -> str:
-    return _DEFAULT.render_prometheus()
+def render_prometheus(openmetrics: bool = False) -> str:
+    return _DEFAULT.render_prometheus(openmetrics=openmetrics)
 
 
-def snapshot() -> Dict[str, Any]:
-    return _DEFAULT.snapshot()
+def snapshot(buckets: bool = False) -> Dict[str, Any]:
+    return _DEFAULT.snapshot(buckets=buckets)
 
 
 def write_jsonl(path: str) -> None:
@@ -839,6 +1002,24 @@ def spans(trace_id: Optional[str] = None,
           name: Optional[str] = None) -> List[SpanRecord]:
     """Finished spans from the bounded in-process recorder."""
     return _RECORDER.spans(trace_id=trace_id, name=name)
+
+
+def trace_ids() -> List[str]:
+    """Trace ids held by the in-process recorder, oldest first."""
+    return _RECORDER.trace_ids()
+
+
+def protected_trace_ids() -> Dict[str, str]:
+    """Tail-retained traces: ``{trace_id: "error"|"pinned"|"slow"}`` — the
+    traces the recorder refuses to evict before ordinary ones."""
+    return _RECORDER.protected_ids()
+
+
+def pin_trace(trace_id: str) -> None:
+    """Retain one trace through recorder eviction (bounded FIFO of pins) —
+    decision events pin theirs so the audit stream's trace links outlive
+    high-traffic span churn."""
+    _RECORDER.pin(trace_id)
 
 
 def current_span() -> Optional[Span]:

@@ -1,0 +1,93 @@
+"""Production observability plane — the judgment layer over the telemetry
+registry (PAPERS.md "BigDL 2.0" end-to-end pipeline story; the TensorFlow
+paper's continuous monitoring of live jobs).
+
+PR 3 gave the stack ONE metric registry and trace-span API; the mechanisms
+that followed (fleet failover, canary rollout, autoscaling, deadline
+shedding) each make consequential decisions against it — but until this
+tier there was no way to ask "are we meeting our SLOs", no history behind
+the instantaneous scrape, and every decision vanished into logs. Four
+pieces, composable and individually importable:
+
+* :mod:`.history` — background sampler into multi-resolution ring buffers
+  with ``rate()`` / ``delta()`` / ``quantile_over_time()`` window queries.
+* :mod:`.slo` — declarative objectives (``slo:`` YAML section) evaluated
+  with SRE-workbook multi-window burn rates into a firing/resolved alert
+  state machine, exported as ``zoo_slo_*``.
+* :mod:`.events` — ``emit(kind, severity, **fields)`` structured decision
+  events (autoscale, failover, rollout, breaker, shed, chaos, slo) with a
+  ring + JSONL + broker-stream sinks.
+* :mod:`.traces` — spans rendered as Chrome/Perfetto trace-event JSON, with
+  tail-based retention in the recorder (errored + slowest-k traces kept
+  whole) and OpenMetrics exemplars linking histogram buckets to trace ids.
+
+:class:`ObservabilityPlane` bundles history + SLO engine for the serving
+stack; :class:`~.debug.DebugSurface` serves it all at ``/debug``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from . import events, history, slo, traces
+from .debug import DebugSurface
+from .events import attach_broker, attach_jsonl, emit, reset_events
+from .history import DEFAULT_RESOLUTIONS, MetricsHistory
+from .slo import Objective, SLOEngine, parse_objectives
+from .traces import export_trace, trace_summaries
+
+__all__ = [
+    "DebugSurface", "MetricsHistory", "Objective", "ObservabilityPlane",
+    "SLOEngine", "DEFAULT_RESOLUTIONS", "attach_broker", "attach_jsonl",
+    "emit", "events", "export_trace", "history", "parse_objectives",
+    "reset_events", "slo", "trace_summaries", "traces",
+]
+
+
+class ObservabilityPlane:
+    """History sampler + (optional) SLO engine, one start/stop lifecycle.
+
+    ``from_config`` reads the ServingConfig observability knobs: the SLO
+    engine exists only when ``slo_objectives`` were declared; the history
+    store always runs (one snapshot per second is what makes ``/debug``
+    and burn rates self-contained).
+    """
+
+    def __init__(self, history_store: Optional[MetricsHistory] = None,
+                 slo_engine: Optional[SLOEngine] = None):
+        self.history = history_store or MetricsHistory()
+        self.slo = slo_engine
+        if self.slo is not None:
+            self.slo.attach()
+
+    @classmethod
+    def from_config(cls, config: Any) -> "ObservabilityPlane":
+        fast = float(getattr(config, "slo_fast_window_s", 60.0))
+        # a burn-rate window needs several samples in it to difference —
+        # scale the finest ring to at least ~5 samples per fast window
+        # (sub-second steps only when the config asks for drill-scale
+        # windows; production 60s windows keep the 1s default)
+        step = max(0.1, min(1.0, fast / 5.0))
+        span_s = DEFAULT_RESOLUTIONS[0][0] * DEFAULT_RESOLUTIONS[0][1]
+        resolutions = ((step, int(span_s / step)),) + DEFAULT_RESOLUTIONS[1:]
+        hist = MetricsHistory(resolutions=resolutions)
+        engine = None
+        objectives = tuple(getattr(config, "slo_objectives", ()) or ())
+        if objectives:
+            engine = SLOEngine(
+                hist, parse_objectives(objectives),
+                fast_window_s=fast,
+                slow_window_s=getattr(config, "slo_slow_window_s", 600.0),
+                burn_factor=getattr(config, "slo_burn_factor", 9.0))
+        return cls(history_store=hist, slo_engine=engine)
+
+    def start(self, interval_s: Optional[float] = None
+              ) -> "ObservabilityPlane":
+        self.history.start(interval_s=interval_s)
+        return self
+
+    def stop(self) -> None:
+        self.history.stop()
+
+    def debug_surface(self, extra_status: Any = None) -> DebugSurface:
+        return DebugSurface(self, extra_status=extra_status)

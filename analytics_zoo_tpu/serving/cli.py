@@ -20,6 +20,15 @@ reach the broker — the supervising stack process picks them up.
     python -m ... cli drain --replica r0 --port 6380          # graceful drain
     python -m ... cli rolling-restart  --port 6380            # zero-downtime
 
+Observability verbs (docs/observability.md): ``events`` tails the structured
+decision-event stream off the broker (autoscale/failover/rollout/breaker/
+shed/chaos/slo, one JSON object per line); ``slo-status`` and ``trace`` hit
+the frontend's ``/debug`` ops surface over HTTP.
+
+    python -m ... cli events     --port 6380 [--kind autoscale] [--count 50]
+    python -m ... cli slo-status --http 127.0.0.1:8080
+    python -m ... cli trace      --http 127.0.0.1:8080 --trace <id> --out t.json
+
 ``info`` prints the broker's data-plane gauges (wire protocol version,
 per-stream depths, bytes on wire by frame kind, shm attachment) as JSON —
 the operator-side view of the binary zero-copy data plane. Since the unified
@@ -247,6 +256,85 @@ def do_drain(args) -> int:
     return 1
 
 
+def do_events(args) -> int:
+    """Print the stack's structured decision events (autoscale, failover,
+    rollout, breaker, shed, chaos, slo transitions) from the broker's
+    ``events`` stream — the cross-process view of ``/debug/events``. One
+    JSON object per line, oldest first."""
+    from ..observability.events import EVENT_STREAM
+
+    cursor, rows = 0, []
+    limit = max(1, int(args.count))
+    try:
+        while True:
+            cursor, entries = _call(args.host, args.port, "XREAD",
+                                    EVENT_STREAM, cursor, 256, 0)
+            if not entries:
+                break
+            for _id, rec in entries:
+                if args.kind and not str(rec.get("kind", "")) \
+                        .startswith(args.kind):
+                    continue
+                rows.append(rec)
+    except (OSError, ConnectionError, ValueError) as e:
+        print(f"broker on {args.host}:{args.port} unreachable: {e}",
+              file=sys.stderr)
+        return 3
+    for rec in rows[-limit:]:
+        print(json.dumps(rec, sort_keys=True))
+    if not rows:
+        print("no decision events on this broker (stack not running with "
+              "the observability plane, or nothing has happened yet)",
+              file=sys.stderr)
+    return 0
+
+
+def _http_get(http: str, path: str, timeout: float = 5.0):
+    import urllib.request
+
+    url = f"http://{http}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def do_slo_status(args) -> int:
+    """Print the SLO engine's status (objectives, burn rates, alert states)
+    from the frontend's ``/debug/slo``."""
+    try:
+        payload = _http_get(args.http, "/debug/slo")
+    except Exception as e:
+        print(f"frontend on {args.http} unreachable: {e}", file=sys.stderr)
+        return 3
+    print(json.dumps(payload, indent=1, sort_keys=True))
+    if not payload.get("enabled"):
+        return 4
+    return 1 if payload.get("firing") else 0
+
+
+def do_trace(args) -> int:
+    """Fetch one trace as Chrome/Perfetto trace-event JSON from the
+    frontend's ``/debug/traces/<id>`` (load the file at ui.perfetto.dev)."""
+    if not args.trace:
+        print("trace needs --trace <trace_id> (see /debug/events or "
+              "`cli events` for ids)", file=sys.stderr)
+        return 2
+    try:
+        payload = _http_get(args.http, f"/debug/traces/{args.trace}")
+    except Exception as e:
+        print(f"frontend on {args.http} unreachable or unknown trace: {e}",
+              file=sys.stderr)
+        return 3
+    text = json.dumps(payload, indent=1)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {len(payload.get('traceEvents', []))} span(s) to "
+              f"{args.out}")
+    else:
+        print(text)
+    return 0
+
+
 def do_rolling_restart(args) -> int:
     """Ask the fleet supervisor for a rolling restart: each replica is
     drained, restarted and readmitted in turn — N-1 replicas keep serving
@@ -271,7 +359,8 @@ def main(argv=None) -> int:
                     "+ fleet operations (fleet-status/drain/rolling-restart)")
     ap.add_argument("action",
                     choices=["start", "stop", "restart", "status", "info",
-                             "fleet-status", "drain", "rolling-restart"])
+                             "fleet-status", "drain", "rolling-restart",
+                             "events", "slo-status", "trace"])
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6380)
     ap.add_argument("--aof", default=None,
@@ -280,11 +369,26 @@ def main(argv=None) -> int:
                     help="replica id for `drain` (see fleet-status)")
     ap.add_argument("--wait", type=float, default=10.0,
                     help="seconds to wait for start/stop/drain to take effect")
+    ap.add_argument("--http", default="127.0.0.1:8080",
+                    help="frontend host:port for `slo-status`/`trace` "
+                         "(the /debug ops surface)")
+    ap.add_argument("--count", type=int, default=100,
+                    help="`events`: print at most the newest N events")
+    ap.add_argument("--kind", default=None,
+                    help="`events`: only kinds with this prefix (e.g. "
+                         "autoscale, fleet, rollout, slo, chaos)")
+    ap.add_argument("--trace", default=None,
+                    help="`trace`: the trace id to export (from "
+                         "/debug/events or `cli events`)")
+    ap.add_argument("--out", default=None,
+                    help="`trace`: write the Perfetto-loadable JSON here "
+                         "instead of stdout")
     args = ap.parse_args(argv)
     return {"start": do_start, "stop": do_stop, "restart": do_restart,
             "status": do_status, "info": do_info,
             "fleet-status": do_fleet_status, "drain": do_drain,
-            "rolling-restart": do_rolling_restart}[args.action](args)
+            "rolling-restart": do_rolling_restart, "events": do_events,
+            "slo-status": do_slo_status, "trace": do_trace}[args.action](args)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -130,6 +130,19 @@ class ServingConfig:
                                          # one replica down
     autoscale_cooldown_s: float = 2.0    # min gap between scale events so
                                          # the signal can react to the last
+    # --- SLO engine (observability/slo.py; YAML `slo:` section) ---
+    slo_objectives: tuple = ()           # declared objectives, each a dict
+                                         # {name, type: latency|availability|
+                                         # error_ratio|queue_depth, priority,
+                                         # target, threshold_ms, max_depth};
+                                         # empty = no SLO engine
+    slo_fast_window_s: float = 60.0      # burn-rate short window (the
+                                         # "is it still happening" proof +
+                                         # the resolver)
+    slo_slow_window_s: float = 600.0     # burn-rate long window (the
+                                         # "sustained budget spend" proof)
+    slo_burn_factor: float = 9.0         # fire when burn > factor over BOTH
+                                         # windows (SRE-workbook pairing)
     # --- resilience (common.resilience wiring) ---
     infer_workers: int = 1               # model-worker threads; dead ones are
                                          # respawned by the engine supervisor
@@ -280,6 +293,29 @@ class ServingConfig:
                                      else cls.min_replicas)):
             raise ValueError(f"autoscale max_replicas ({hi!r}) must be >= "
                              f"min_replicas")
+        slo = raw.get("slo") or {}
+        if slo:
+            objectives = slo.get("objectives") or []
+            if not isinstance(objectives, list) or not all(
+                    isinstance(o, dict) for o in objectives):
+                raise ValueError("slo objectives must be a list of mappings")
+            # validate declaratively at CONFIG time (a typo'd objective must
+            # fail here, not wedge the engine at runtime) — parse_objectives
+            # raises on unknown type / bad target / duplicate names
+            from ..observability.slo import parse_objectives
+
+            parse_objectives(objectives)
+            flat["slo_objectives"] = tuple(dict(o) for o in objectives)
+            for key, alias in (("slo_fast_window_s", "fast_window_s"),
+                               ("slo_slow_window_s", "slow_window_s"),
+                               ("slo_burn_factor", "burn_factor")):
+                if alias in slo:
+                    flat[key] = float(slo[alias])
+            fast = flat.get("slo_fast_window_s", cls.slo_fast_window_s)
+            slow = flat.get("slo_slow_window_s", cls.slo_slow_window_s)
+            if fast >= slow:
+                raise ValueError(f"slo fast_window_s ({fast}) must be < "
+                                 f"slow_window_s ({slow})")
         for key in ("infer_workers", "heartbeat_timeout_s",
                     "http_max_inflight", "breaker_failure_threshold",
                     "breaker_reset_timeout_s"):

@@ -30,11 +30,12 @@ from ..common.resilience import (HealthRegistry, RetryAbortedError,
                                  RetryPolicy)
 from ..inference import InferenceModel, InferenceSummary
 from . import qos as _qos
+from . import slo_metrics as _slo_metrics
 from .client import INPUT_STREAM, RESULT_PREFIX, _Conn
 from .config import ServingConfig
 from .hotswap import MODEL_STREAM, ModelSwapper, SwapRejected
 from .schema import (MODEL_VERSION_KEY, decode_payload, payload_deadline,
-                     payload_trace)
+                     payload_priority, payload_trace)
 from .wire import set_wire_model_version
 
 logger = logging.getLogger("analytics_zoo_tpu.serving")
@@ -53,6 +54,10 @@ _ENGINE_SHED = _tm.counter(
     "Requests the engine shed instead of served, by overload class "
     "(deadline = expired in flight — incl. AOF-replayed / failover-"
     "requeued records)", labels=("reason",))
+# the SLO engine's per-class evidence (observability/slo.py), registered
+# once in serving/slo_metrics.py
+_REQ_LAT = _slo_metrics.REQUEST_LATENCY
+_REQ_OUTCOMES = _slo_metrics.REQUEST_OUTCOMES
 
 # fleet coordination keys on the broker (written by replica engines, read by
 # the ReplicaRouter/FleetSupervisor in serving/fleet.py)
@@ -195,9 +200,12 @@ class ClusterServing:
                     # already gave up on. The deadline is the ORIGINAL one:
                     # it rides the payload through every requeue.
                     dl = payload_deadline(payload)
+                    pri = payload_priority(payload)
                     if dl is not None and time.time() > dl:
                         chaos_point("overload.shed", tag="engine")
                         _ENGINE_SHED.labels(reason="deadline").inc()
+                        _REQ_OUTCOMES.labels(priority=pri,
+                                             outcome="shed").inc()
                         bad.append((_id, payload.get("uri"),
                                     _qos.shed_payload(
                                         "deadline expired before service",
@@ -209,7 +217,7 @@ class ClusterServing:
                     try:
                         batch.append((_id, payload["uri"],
                                       decode_payload(payload["data"]),
-                                      ctx, t_recv))
+                                      ctx, t_recv, pri))
                     except Exception as e:  # malformed record: report, keep running
                         logger.exception("malformed record %s", _id)
                         uri = payload.get("uri") if isinstance(payload, dict) else None
@@ -278,6 +286,15 @@ class ClusterServing:
                                        else 0.8 * self._lat_ema_s + 0.2 * lat)
                     self._svc_ema.observe((t_done - t_pick)
                                           / max(1, len(batch)))
+                    for rec in batch:
+                        # per-class SLO evidence; a pre-QoS record tuple
+                        # (5-long, e.g. handed back by an older requeue)
+                        # counts as the default class
+                        pri = rec[5] if len(rec) > 5 else "normal"
+                        _REQ_LAT.labels(priority=pri).observe(
+                            t_done - rec[4])
+                        _REQ_OUTCOMES.labels(priority=pri,
+                                             outcome="served").inc()
                     for ctx in ctxs:
                         if ctx is not None:
                             _tm.record_span("serving.engine.dispatch", t_pick,

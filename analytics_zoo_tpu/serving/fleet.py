@@ -65,7 +65,9 @@ from ..common.chaos import chaos_point
 from ..common.locks import traced_lock
 from ..common.resilience import (CircuitBreaker, HealthRegistry,
                                  RetryAbortedError, RetryPolicy)
+from ..observability import events as _ev
 from . import qos as _qos
+from . import slo_metrics as _slo_metrics
 from .client import INPUT_STREAM, RESULT_PREFIX, _Conn
 from .config import ServingConfig
 from .engine import FLEET_CTL_PREFIX, FLEET_HB_PREFIX, ClusterServing
@@ -105,6 +107,8 @@ _ROUTER_SHED = _tm.counter(
     "Requests the router shed (answered + acked, never dispatched) because "
     "their deadline provably cannot be met, by overload class",
     labels=("reason",))
+# per-class SLO evidence, registered once in serving/slo_metrics.py
+_REQ_OUTCOMES = _slo_metrics.REQUEST_OUTCOMES
 _AUTOSCALE = _tm.counter(
     "zoo_autoscale_events_total",
     "Autoscaler scale events, by direction (up = replica spawned on "
@@ -267,6 +271,8 @@ class ReplicaRouter:
                 return
             slot.breaker.trip()
             slot.probe = None
+        _ev.emit("fleet.evict", severity="warning", replica=rid,
+                 router=self.name)
         logger.warning("fleet: evicted replica %s (breaker open)", rid)
 
     def set_liveness(self, rid: str, alive: bool, state: str = "up",
@@ -494,7 +500,14 @@ class ReplicaRouter:
                 _qos.retry_after_s(total, svc, max(1, eligible)),
                 reason="deadline"))
         self.shed += 1
+        pri = payload_priority(payload)
         _ROUTER_SHED.labels(reason="deadline").inc()
+        _REQ_OUTCOMES.labels(priority=pri, outcome="shed").inc()
+        # audit-rate, not request-rate: under sustained overload this fires
+        # per request, so repeats within the window fold into `suppressed`
+        _ev.emit("shed.router", severity="warning", throttle_s=1.0,
+                 reason="deadline", priority=pri,
+                 est_wait_s=round(est + svc, 4), eligible=eligible)
         return True
 
     def _note_dispatched(self, rid: str) -> None:
@@ -889,44 +902,55 @@ class FleetSupervisor:
         """A replica went silent: evict it from routing, claim-transfer its
         owed requests back to the dispatch stream, respawn it (unless it was
         deliberately draining). Zero-loss: nothing it claimed was acked, so
-        everything it owed is still on the broker."""
+        everything it owed is still on the broker.
+
+        The whole action runs inside a ``fleet.failover`` span and emits one
+        decision event carrying that trace — an operator reading
+        ``/debug/events`` can pull the complete failover timeline as a
+        Perfetto trace."""
         t0 = time.perf_counter()
         handle = self._handles.get(rid)
-        self.router.evict(rid)
-        self.router.set_liveness(rid, False, state="dead")
-        try:
-            res = self._conn.call("XTRANSFER", self.router.prefix + rid,
-                                  f"fleet-{rid}", self.router.stream)
-            moved = int(res.get("moved", 0)) if isinstance(res, dict) else 0
-        except RetryAbortedError:
-            return
-        except Exception:
-            logger.exception("fleet: requeue for dead replica %s failed", rid)
-            moved = 0
-        if moved:
-            _REQUEUED.inc(moved)
-            self.requeued += moved
-        logger.warning("fleet: replica %s dead; requeued %d claimed "
-                       "request(s)", rid, moved)
-        if handle is None:
-            # unmanaged id (already removed): eviction + requeue is all
-            return
-        handle.kill()           # reap whatever half-dead incarnation remains
-        if not handle.drain_requested:
-            chaos_point("fleet.respawn", tag=rid)
-            self._spawn_replica(rid)
-            self.respawns += 1
-            _FLEET_RESPAWNS.inc()
-        else:
-            # died while draining: work requeued above; the drain decided
-            # this replica should not take traffic, so don't bring it back
-            self._handles.pop(rid, None)
-            self._hb_seen.pop(rid, None)
-            self.router.remove_replica(rid)
-            self.registry.deregister(f"replica.{rid}")
-        dt = time.perf_counter() - t0
-        self.failovers.append(dt)
-        _FAILOVER.observe(dt)
+        with _tm.span("fleet.failover", replica=rid) as sp:
+            self.router.evict(rid)
+            self.router.set_liveness(rid, False, state="dead")
+            try:
+                res = self._conn.call("XTRANSFER", self.router.prefix + rid,
+                                      f"fleet-{rid}", self.router.stream)
+                moved = (int(res.get("moved", 0))
+                         if isinstance(res, dict) else 0)
+            except RetryAbortedError:
+                return
+            except Exception:
+                logger.exception("fleet: requeue for dead replica %s failed",
+                                 rid)
+                moved = 0
+            if moved:
+                _REQUEUED.inc(moved)
+                self.requeued += moved
+            logger.warning("fleet: replica %s dead; requeued %d claimed "
+                           "request(s)", rid, moved)
+            respawned = False
+            if handle is not None:
+                handle.kill()   # reap whatever half-dead incarnation remains
+                if not handle.drain_requested:
+                    chaos_point("fleet.respawn", tag=rid)
+                    self._spawn_replica(rid)
+                    self.respawns += 1
+                    _FLEET_RESPAWNS.inc()
+                    respawned = True
+                else:
+                    # died while draining: work requeued above; the drain
+                    # decided this replica should not take traffic
+                    self._handles.pop(rid, None)
+                    self._hb_seen.pop(rid, None)
+                    self.router.remove_replica(rid)
+                    self.registry.deregister(f"replica.{rid}")
+            dt = time.perf_counter() - t0
+            self.failovers.append(dt)
+            _FAILOVER.observe(dt)
+            _ev.emit("fleet.failover", severity="warning",
+                     trace_id=sp.trace_id, replica=rid, requeued=moved,
+                     respawned=respawned, failover_s=round(dt, 4))
 
     # -- autoscaling ---------------------------------------------------------
 
@@ -1009,11 +1033,14 @@ class FleetSupervisor:
         # (the monitor retries next poll while pressure persists) — the
         # kill-during-scale-up drill targets the spawned replica instead
         chaos_point("autoscale.scale", tag="up")
-        self._spawn_replica(rid)
-        self._as_last_event_t = time.monotonic()
-        self._as_pressure_since = None
-        self.scale_events.append(("up", len(self._handles)))
-        _AUTOSCALE.labels(direction="up").inc()
+        with _tm.span("fleet.autoscale", direction="up", replica=rid) as sp:
+            self._spawn_replica(rid)
+            self._as_last_event_t = time.monotonic()
+            self._as_pressure_since = None
+            self.scale_events.append(("up", len(self._handles)))
+            _AUTOSCALE.labels(direction="up").inc()
+            _ev.emit("autoscale.up", trace_id=sp.trace_id, replica=rid,
+                     replicas=len(self._handles))
         logger.info("autoscale: spawned replica %s (%d total) on sustained "
                     "queue pressure", rid, len(self._handles))
 
@@ -1037,29 +1064,35 @@ class FleetSupervisor:
 
         def run():
             try:
-                self.drain(rid)
-                self.wait_state(rid, "drained",
-                                timeout_s=max(5.0, self.config
-                                              .fleet_failover_timeout_s * 4))
-                handle.stop(drain_s=2.0)
-                try:
-                    res = self._conn.call("XTRANSFER",
-                                          self.router.prefix + rid,
-                                          f"fleet-{rid}", self.router.stream)
-                    moved = (int(res.get("moved", 0))
-                             if isinstance(res, dict) else 0)
-                    if moved:
-                        _REQUEUED.inc(moved)
-                        self.requeued += moved
-                except Exception:
-                    logger.exception("autoscale: straggler requeue for %s "
-                                     "failed", rid)
-                self._handles.pop(rid, None)
-                self._hb_seen.pop(rid, None)
-                self.router.remove_replica(rid)
-                self.registry.deregister(f"replica.{rid}")
-                self.scale_events.append(("down", len(self._handles)))
-                _AUTOSCALE.labels(direction="down").inc()
+                with _tm.span("fleet.autoscale", direction="down",
+                              replica=rid) as sp:
+                    self.drain(rid)
+                    self.wait_state(rid, "drained",
+                                    timeout_s=max(
+                                        5.0, self.config
+                                        .fleet_failover_timeout_s * 4))
+                    handle.stop(drain_s=2.0)
+                    try:
+                        res = self._conn.call("XTRANSFER",
+                                              self.router.prefix + rid,
+                                              f"fleet-{rid}",
+                                              self.router.stream)
+                        moved = (int(res.get("moved", 0))
+                                 if isinstance(res, dict) else 0)
+                        if moved:
+                            _REQUEUED.inc(moved)
+                            self.requeued += moved
+                    except Exception:
+                        logger.exception("autoscale: straggler requeue for "
+                                         "%s failed", rid)
+                    self._handles.pop(rid, None)
+                    self._hb_seen.pop(rid, None)
+                    self.router.remove_replica(rid)
+                    self.registry.deregister(f"replica.{rid}")
+                    self.scale_events.append(("down", len(self._handles)))
+                    _AUTOSCALE.labels(direction="down").inc()
+                    _ev.emit("autoscale.down", trace_id=sp.trace_id,
+                             replica=rid, replicas=len(self._handles))
                 logger.info("autoscale: drained replica %s away (%d left)",
                             rid, len(self._handles))
             finally:

@@ -59,6 +59,7 @@ import numpy as np
 from ..common import telemetry as _tm
 from ..common.chaos import chaos_point
 from ..common.resilience import RetryAbortedError, RetryPolicy
+from ..observability import events as _ev
 from ..engine.checkpoint import (CheckpointCorruptError,
                                  param_tree_signature, read_manifest,
                                  verify_checkpoint)
@@ -625,6 +626,11 @@ class RolloutController:
             logger.exception("rollout: rejection record write failed")
         _ROLLOUTS.labels(outcome=outcome).inc()
         self.outcomes.append((str(record.get("version")), outcome))
+        # decision event, trace-linked via the ambient rollout span — a
+        # rollback on /debug/events resolves to the full rollout timeline
+        _ev.emit("rollout.rejected", severity="warning",
+                 version=str(record.get("version")), outcome=outcome,
+                 reason=reason)
 
     def _cohort_snapshot(self, exclude: str) -> Dict[str, Tuple[int, int]]:
         """(served, errors) per stable-cohort replica."""
@@ -653,6 +659,12 @@ class RolloutController:
             return
         chaos_point("rollout.phase", tag="start")
         self.target = record
+        # one span covers the whole rollout (entered manually: the body
+        # below returns from several phases); every decision event emitted
+        # inside — rejection or promotion — inherits its trace id, so
+        # /debug/events links straight to the rollout's Perfetto timeline
+        rollout_span = _tm.span("rollout", version=version)
+        rollout_span.__enter__()
         try:
             # ---- phase 1: canary swap -------------------------------------
             self._set_phase("canary")
@@ -755,9 +767,17 @@ class RolloutController:
                 logger.exception("rollout: model:current update failed")
             _ROLLOUTS.labels(outcome="promoted").inc()
             self.outcomes.append((version, "promoted"))
+            _ev.emit("rollout.promoted", version=version,
+                     replicas=len(swapped))
             logger.info("rollout: %s promoted fleet-wide (%d replicas)",
                         version, len(swapped))
         finally:
+            # propagate the in-flight exception (if any) into the span so a
+            # crashed rollout records status=error and earns the recorder's
+            # errored-trace retention
+            import sys as _sys
+
+            rollout_span.__exit__(*_sys.exc_info())
             self.target = None
             self.canary = None
             self._set_phase("idle")

@@ -54,7 +54,10 @@ from ..common.locks import traced_lock
 from ..common.resilience import (CircuitBreaker, CircuitOpenError,
                                  HealthRegistry, ResilienceError)
 from ..inference.summary import timing, timing_stats
+from ..observability import events as _ev
+from ..observability.debug import DebugSurface
 from . import qos as _qos
+from . import slo_metrics as _slo_metrics
 from .client import InputQueue, OutputQueue
 from .config import ServingConfig
 from .wire import wire_stats
@@ -68,6 +71,9 @@ _HTTP_SHED = _tm.counter("zoo_http_shed_total",
                          "circuit open, deadline = provably unmeetable, "
                          "backend = downstream tier shed it)",
                          labels=("reason",))
+# per-class SLO evidence, registered once in serving/slo_metrics.py
+_REQ_LAT = _slo_metrics.REQUEST_LATENCY
+_REQ_OUTCOMES = _slo_metrics.REQUEST_OUTCOMES
 
 # HTTP header twins of the payload/wire QoS fields (serving/qos.py):
 # X-Zoo-Priority: critical|normal|bulk; X-Zoo-Deadline-Ms: relative latency
@@ -135,11 +141,22 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             # ONE scrape shows the whole system: every subsystem (wire,
             # batching, engine compiles, breakers, heartbeats, spans,
-            # training) reports through the shared registry
-            text = _tm.render_prometheus().encode("utf-8")
+            # training) reports through the shared registry. Content
+            # negotiation: exemplar trailers are OpenMetrics-only syntax,
+            # so they are emitted only to scrapers that Accept it — a
+            # stock 0.0.4 Prometheus scraper gets a clean exposition
+            accept = self.headers.get("Accept", "")
+            om = "application/openmetrics-text" in accept
+            body = _tm.render_prometheus(openmetrics=om)
+            if om:
+                body += "# EOF\n"
+                ctype = ("application/openmetrics-text; version=1.0.0; "
+                         "charset=utf-8")
+            else:
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            text = body.encode("utf-8")
             self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(text)))
             self.end_headers()
             self.wfile.write(text)
@@ -158,6 +175,17 @@ class _Handler(BaseHTTPRequestHandler):
             stats["wire"] = wire_stats()    # bytes-on-wire / frame-kind gauges
             stats["shed_requests"] = app.shed_requests
             self._respond(200, stats)
+        elif self.path.startswith("/debug"):
+            # the ops surface (observability/debug.py): HTML dashboard,
+            # /debug/slo, /debug/events, /debug/traces/<id>
+            code, ctype, body, extra = app.debug.handle(self.path)
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in extra.items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
         elif self.path == "/healthz":
             if app.registry is None:
                 self._respond(200, {"status": "ok", "components": {}})
@@ -198,7 +226,7 @@ class _Handler(BaseHTTPRequestHandler):
             # an HONEST Retry-After (queue depth × measured service time)
             # instead of queueing work that will only time out
             app.shed_requests += 1
-            _HTTP_SHED.labels(reason=reason).inc()
+            app._note_shed(priority, reason)
             _HTTP_REQS.labels(code="503").inc()
             self._respond_shed(retry_after,
                                "server overloaded, request shed",
@@ -222,6 +250,17 @@ class _Handler(BaseHTTPRequestHandler):
                     priority=priority, deadline=deadline)
             n_served = len(instances)
             code = "200"
+            if app._batcher is not None:
+                # direct mode has no engine to account the per-class SLO
+                # evidence; queue mode counts at the engine (no double count)
+                pri = _qos.normalize_priority(
+                    priority if priority is not None
+                    else app.default_priority)
+                per_rec = (time.monotonic() - t_start) / n_served
+                for _ in range(n_served):
+                    _REQ_LAT.labels(priority=pri).observe(per_rec)
+                    _REQ_OUTCOMES.labels(priority=pri,
+                                         outcome="served").inc()
             body = {"predictions": preds}
             # hot-swap attribution: which model version(s) served this
             # request — a string normally, a list mid-swap (mixed versions
@@ -236,15 +275,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(400, {"error": str(e)})
         except _qos.ShedError as e:
             # a downstream tier (router, micro-batcher, engine) shed this
-            # request; relay ITS computed Retry-After to the client
+            # request; relay ITS computed Retry-After to the client. The
+            # queue-mode tiers already counted the per-class outcome; the
+            # in-process micro-batcher has no counter of its own, so direct
+            # mode attributes it here
             code = "503"
             app.shed_requests += 1
-            _HTTP_SHED.labels(reason=e.reason).inc()
+            app._note_shed(priority, e.reason,
+                           decided=app._batcher is not None)
             self._respond_shed(e.retry_after_s, str(e),
                                shed_reason=e.reason)
         except CircuitOpenError as e:
             code = "503"
-            _HTTP_SHED.labels(reason="breaker").inc()
+            app._note_shed(priority, "breaker")
             self._respond_shed(e.retry_after_s, str(e),
                                shed_reason="breaker")
         except TimeoutError as e:
@@ -252,7 +295,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(504, {"error": str(e)})
         except ResilienceError as e:   # broker unreachable after retries
             code = "503"
-            _HTTP_SHED.labels(reason="breaker").inc()
+            app._note_shed(priority, "breaker")
             self._respond_shed(app.retry_after_s(), str(e),
                                shed_reason="breaker")
         except Exception as e:  # pragma: no cover
@@ -301,7 +344,7 @@ class _Handler(BaseHTTPRequestHandler):
         admitted, retry_after, reason = app._admit(priority, deadline)
         if not admitted:
             app.shed_requests += 1
-            _HTTP_SHED.labels(reason=reason).inc()
+            app._note_shed(priority, reason)
             _HTTP_REQS.labels(code="503").inc()
             self._respond_shed(retry_after,
                                "server overloaded, request shed",
@@ -341,6 +384,8 @@ class _Handler(BaseHTTPRequestHandler):
                     if meta.get("error"):
                         raise RuntimeError(meta["error"])
                     code = "200"
+                    app._note_gen_outcome(priority,
+                                          meta.get("outcome", "ok"))
                     self._respond(200, {"tokens": tokens,
                                         "outcome": meta.get("outcome", "ok"),
                                         "n_tokens": len(tokens)})
@@ -350,9 +395,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 headers_sent = True
+                final_outcome = "ok"
                 for toks, final, meta in frames:
                     line = {"tokens": list(toks), "final": bool(final)}
                     if final:
+                        final_outcome = meta.get("outcome", "ok")
                         line.update({k: meta[k] for k in
                                      ("outcome", "error", "n_tokens",
                                       "retry_after_s")
@@ -360,6 +407,12 @@ class _Handler(BaseHTTPRequestHandler):
                     self._write_chunk(json.dumps(line).encode("utf-8")
                                       + b"\n")
                     self.wfile.flush()
+                # a shed that rode the stream as a terminal frame (not an
+                # exception) still counts as this class's SLO outcome —
+                # noted BEFORE the terminal chunk so a client that reads
+                # the stream to completion observes the outcome on the
+                # very next scrape
+                app._note_gen_outcome(priority, final_outcome)
                 self.wfile.write(b"0\r\n\r\n")
                 code = "200"
         except (ValueError, KeyError, json.JSONDecodeError) as e:
@@ -375,7 +428,10 @@ class _Handler(BaseHTTPRequestHandler):
         except _qos.ShedError as e:
             code = "503"
             app.shed_requests += 1
-            _HTTP_SHED.labels(reason=e.reason).inc()
+            # the generation tiers count only zoo_gen_shed_total — the
+            # per-class SLO outcome is attributed HERE (the frontend is the
+            # generation path's one per-class accountant)
+            app._note_shed(priority, e.reason)
             if headers_sent:
                 self._abort_stream(str(e))
             else:
@@ -414,10 +470,16 @@ class FrontEndApp:
                  max_inflight: Optional[int] = None,
                  registry: Optional[HealthRegistry] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 engine_stats=None, generator=None, ready_fn=None):
+                 engine_stats=None, generator=None, ready_fn=None,
+                 plane=None):
         self.config = config or ServingConfig()
         self.timeout_s = timeout_s
         self.registry = registry             # backs /healthz (None => always ok)
+        # observability plane (history + SLO engine, observability/__init__)
+        # behind the /debug ops surface; None still serves events + traces
+        # (process-global), just without sparklines/SLO
+        self.plane = plane
+        self.debug = DebugSurface(plane)
         # backs /readyz: () -> (ready, detail) — e.g. FleetSupervisor.
         # readiness (>= 1 eligible replica). None => backend always ready
         self._ready_fn = ready_fn
@@ -546,6 +608,35 @@ class FrontEndApp:
         with self._inflight_lock:
             self._inflight -= 1
         self._admission.release()
+
+    def _note_shed(self, priority: Optional[str], reason: str,
+                   decided: bool = True) -> None:
+        """Shed accounting: the HTTP-class counter always moves; the
+        per-class SLO outcome + decision event only when THIS tier decided
+        the shed (a relayed downstream shed was already counted there)."""
+        _HTTP_SHED.labels(reason=reason).inc()
+        if decided:
+            pri = _qos.normalize_priority(
+                priority if priority is not None else self.default_priority)
+            _REQ_OUTCOMES.labels(priority=pri, outcome="shed").inc()
+            _ev.emit("shed.frontend", severity="warning", throttle_s=1.0,
+                     reason=reason, priority=pri)
+
+    def _note_gen_outcome(self, priority: Optional[str],
+                          outcome: str) -> None:
+        """Per-class SLO outcome for one generation STREAM. The generation
+        tiers count only zoo_gen_* families, so the frontend is the one
+        per-class accountant here — no double count in either serving mode.
+        ``shed`` covers both transports of a batcher shed: the raised
+        ShedError (one-shot) and the terminal shed frame (streaming)."""
+        pri = _qos.normalize_priority(
+            priority if priority is not None else self.default_priority)
+        if outcome == "shed":
+            _REQ_OUTCOMES.labels(priority=pri, outcome="shed").inc()
+            _ev.emit("shed.frontend", severity="warning", throttle_s=1.0,
+                     reason="deadline", priority=pri, path="generate")
+        elif outcome == "ok":
+            _REQ_OUTCOMES.labels(priority=pri, outcome="served").inc()
 
     def readiness(self) -> tuple:
         """(ready, detail) for /readyz: NOT ready while draining, while the

@@ -14,15 +14,45 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import threading
 
+from ..common import telemetry as _tm
 from ..common.resilience import HealthRegistry
+from ..observability import ObservabilityPlane
+from ..observability import events as _events
 from .broker import start_broker
 from .config import ServingConfig
 from .engine import ClusterServing
 from .fleet import FleetSupervisor
 from .http_frontend import FrontEndApp
+
+_JSONL_BYTES = _tm.gauge(
+    "zoo_metrics_jsonl_bytes",
+    "Size of the --metrics-jsonl snapshot file after the last append "
+    "(drops to ~0 at each size-triggered rotation)")
+
+
+def write_metrics_snapshot(path: str, max_bytes: int) -> int:
+    """Append one telemetry snapshot line to ``path`` with size-based
+    rotation: past ``max_bytes`` the file moves to ``<path>.1`` (replacing
+    the previous rotation) and a fresh file starts — a long-lived stack can
+    never fill the disk with its own metrics. Returns the post-append size.
+    """
+    _tm.write_jsonl(path)
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        size = 0
+    if max_bytes > 0 and size > max_bytes:
+        try:
+            os.replace(path, path + ".1")
+            size = 0
+        except OSError:
+            logging.exception("metrics jsonl rotation failed")
+    _JSONL_BYTES.set(size)
+    return size
 
 
 def shutdown_stack(app, backend, broker, drain_s: float = 5.0) -> None:
@@ -102,6 +132,15 @@ def main(argv=None) -> int:
                          "to this file every --metrics-interval seconds and "
                          "at shutdown (the file-based twin of GET /metrics)")
     ap.add_argument("--metrics-interval", type=float, default=60.0)
+    ap.add_argument("--metrics-jsonl-max-mb", type=float, default=64.0,
+                    help="rotate the --metrics-jsonl file to <path>.1 once "
+                         "it grows past this many MiB (0 = never rotate); "
+                         "current size is the zoo_metrics_jsonl_bytes gauge")
+    ap.add_argument("--events-jsonl", default=None,
+                    help="append every structured decision event "
+                         "(autoscale, failover, rollout, breaker, shed, "
+                         "chaos, slo) to this JSONL file; events also ride "
+                         "the broker `events` stream for `cli events`")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     if args.no_shm:
@@ -135,6 +174,13 @@ def main(argv=None) -> int:
         cfg.hot_swap = False
 
     broker = start_broker("127.0.0.1", args.broker_port, aof_path=args.aof)
+    # observability plane: 1s metrics history behind /debug, SLO engine when
+    # the YAML declared objectives; decision events mirror onto the broker's
+    # `events` stream so `cli events` works from any host that reaches it
+    plane = ObservabilityPlane.from_config(cfg).start()
+    _events.attach_broker("127.0.0.1", args.broker_port)
+    if args.events_jsonl:
+        _events.attach_jsonl(args.events_jsonl)
     # one registry spans the stack: engine stage/worker heartbeats feed the
     # frontend's /healthz, so an orchestrator probes the whole pipeline
     registry = HealthRegistry(default_timeout_s=cfg.heartbeat_timeout_s)
@@ -163,10 +209,11 @@ def main(argv=None) -> int:
             _demo_model() if args.demo and not cfg.model_path else None,
             config=cfg, registry=registry)
         serving.start()
-    # engine_stats feeds the frontend's /metrics recompile-count gauges
+    # engine_stats feeds the frontend's /metrics recompile-count gauges;
+    # the plane backs its /debug ops surface
     app = FrontEndApp(cfg, host=args.host, port=args.http_port,
                       registry=registry, engine_stats=serving.stats,
-                      ready_fn=ready_fn)
+                      ready_fn=ready_fn, plane=plane)
 
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -174,12 +221,12 @@ def main(argv=None) -> int:
     threading.Thread(target=app.serve, daemon=True,
                      name="zoo-http-frontend").start()
     if args.metrics_jsonl:
-        from ..common import telemetry
+        max_bytes = int(args.metrics_jsonl_max_mb * (1 << 20))
 
         def _dump_loop():
             while not stop.wait(max(1.0, args.metrics_interval)):
                 try:
-                    telemetry.write_jsonl(args.metrics_jsonl)
+                    write_metrics_snapshot(args.metrics_jsonl, max_bytes)
                 except OSError:
                     logging.exception("metrics snapshot failed")
 
@@ -191,14 +238,15 @@ def main(argv=None) -> int:
     stop.wait()
     logging.info("shutting down")
     if args.metrics_jsonl:
-        from ..common import telemetry
-
         try:
-            telemetry.write_jsonl(args.metrics_jsonl)
+            write_metrics_snapshot(
+                args.metrics_jsonl,
+                int(args.metrics_jsonl_max_mb * (1 << 20)))
         except OSError:
             pass
     # ordered: stop accepting -> drain router+engines -> broker -> frontend
     # (construction-order stops strand accepted requests; see shutdown_stack)
+    plane.stop()
     shutdown_stack(app, serving, broker)
     return 0
 

@@ -1499,6 +1499,41 @@ def _fleet_run_phase(broker_port: int, n_replicas: int, n_requests: int,
             oq.close()
         wall = time.perf_counter() - t0
         reconverged = fleet.wait_eligible(n_replicas, timeout_s=15)
+        events_audit = None
+        if kill_rid is not None:
+            # decision-event audit (ISSUE 15): the kill's failover must be
+            # on the event stream, its trace must export whole (containing
+            # the fleet.failover span), and /debug/events must serve valid
+            # JSON over HTTP while the fleet is still up
+            import urllib.request
+
+            from analytics_zoo_tpu.observability import events as _events
+            from analytics_zoo_tpu.observability import export_trace
+            from analytics_zoo_tpu.serving.http_frontend import FrontEndApp
+
+            failovers = [e for e in _events.events(kind="fleet.failover")
+                         if e.fields.get("replica") == kill_rid]
+            traces_ok = bool(failovers) and all(
+                e.trace_id and any(
+                    s["name"] == "fleet.failover"
+                    for s in (export_trace(e.trace_id)
+                              or {"traceEvents": []})["traceEvents"])
+                for e in failovers)
+            app = FrontEndApp(cfg, port=0).start()
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{app.port}/debug/events",
+                        timeout=10) as r:
+                    page = json.loads(r.read())
+                scrape_ok = any(ev["kind"] == "fleet.failover"
+                                for ev in page["events"])
+            except Exception:
+                scrape_ok = False
+            finally:
+                app.stop()
+            events_audit = {"failover_events": len(failovers),
+                            "traces_complete": traces_ok,
+                            "debug_scrape_ok": scrape_ok}
         out = {
             "replicas": n_replicas,
             "requests": n_requests,
@@ -1517,6 +1552,8 @@ def _fleet_run_phase(broker_port: int, n_replicas: int, n_requests: int,
         if killed_at is not None:
             out["killed_replica"] = kill_rid
             out["killed_at_s"] = round(killed_at, 3)
+        if events_audit is not None:
+            out["events"] = events_audit
         return out
     finally:
         fleet.stop(drain_s=2.0)
@@ -1603,6 +1640,11 @@ def _overload_bimodal_phase(broker_port: int, *, n_replicas: int,
                                            OutputQueue, ServingConfig,
                                            ShedError)
 
+    from urllib.request import urlopen
+
+    from analytics_zoo_tpu.observability import ObservabilityPlane
+    from analytics_zoo_tpu.serving.http_frontend import FrontEndApp
+
     capacity = n_replicas * FLEET_BATCH / service_s      # req/s, nominal
     bulk_rate = 2.2 * capacity      # the overload (margin over the 2x
                                     # gate: sleep jitter on a loaded 1-core
@@ -1610,7 +1652,24 @@ def _overload_bimodal_phase(broker_port: int, *, n_replicas: int,
     cfg = ServingConfig(queue_port=broker_port, batch_size=FLEET_BATCH,
                         batch_timeout_ms=2, replicas=n_replicas,
                         fleet_heartbeat_s=0.1, fleet_failover_timeout_s=1.5,
-                        fleet_spawn_grace_s=10.0)
+                        fleet_spawn_grace_s=10.0,
+                        # SLO verdicts for the drill (ISSUE 15): the
+                        # critical latency objective must NEVER fire while
+                        # the bulk availability alert fires under overload
+                        # and resolves after the load drops. Windows are
+                        # drill-scaled; burn math is the production path.
+                        slo_objectives=(
+                            {"name": "critical-latency", "type": "latency",
+                             "priority": "critical",
+                             "threshold_ms": crit_deadline_ms,
+                             "target": 0.9},
+                            {"name": "bulk-availability",
+                             "type": "availability", "priority": "bulk",
+                             "target": 0.9}),
+                        slo_fast_window_s=2.0, slo_slow_window_s=8.0,
+                        slo_burn_factor=4.0)
+    plane = ObservabilityPlane.from_config(cfg).start()
+    app = FrontEndApp(cfg, port=0, plane=plane).start()
     fleet = FleetSupervisor(
         cfg, model_factory=lambda: _fleet_stub_model(service_s))
     fleet.start()
@@ -1682,7 +1741,31 @@ def _overload_bimodal_phase(broker_port: int, *, n_replicas: int,
         t0 = time.perf_counter()
         for t in threads:
             t.start()
-        time.sleep(duration_s)
+        # scrape the /debug ops surface DURING the overload (the CI gate:
+        # valid JSON, and the bulk-class alert observed firing over HTTP)
+        scrapes = {"slo_ok": 0, "slo_bad": 0, "events_ok": 0,
+                   "events_bad": 0}
+        fired_over_http: set = set()
+        drill_end = time.monotonic() + duration_s
+        while time.monotonic() < drill_end:
+            time.sleep(min(0.5, max(0.05, drill_end - time.monotonic())))
+            try:
+                with urlopen(f"http://127.0.0.1:{app.port}/debug/slo",
+                             timeout=5) as r:
+                    slo_page = json.loads(r.read())
+                scrapes["slo_ok"] += 1
+                for o in slo_page.get("objectives", ()):
+                    if o["state"] == "firing":
+                        fired_over_http.add(o["name"])
+            except Exception:
+                scrapes["slo_bad"] += 1
+            try:
+                with urlopen(f"http://127.0.0.1:{app.port}/debug/events",
+                             timeout=5) as r:
+                    json.loads(r.read())
+                scrapes["events_ok"] += 1
+            except Exception:
+                scrapes["events_bad"] += 1
         stop.set()
         for t in threads:
             t.join(timeout=30)
@@ -1704,6 +1787,33 @@ def _overload_bimodal_phase(broker_port: int, *, n_replicas: int,
                     timeout += 1
         finally:
             oq.close()
+        # SLO verdicts: bulk-availability must have FIRED during overload
+        # and must RESOLVE now that the load stopped (the fast window acts
+        # as the resolver); critical-latency must never have fired
+        engine = plane.slo
+        resolve_deadline = time.monotonic() + 15.0
+        while time.monotonic() < resolve_deadline and \
+                engine.state_of("bulk-availability") == "firing":
+            time.sleep(0.25)
+        from analytics_zoo_tpu.observability import events as _events
+        from analytics_zoo_tpu.observability import export_trace
+
+        shed_events = _events.events(kind="shed")
+        slo_events = _events.events(kind="slo")
+        slo_verdict = {
+            "critical_fired": engine.ever_fired("critical-latency"),
+            "bulk_fired": engine.ever_fired("bulk-availability"),
+            "bulk_fired_over_http": "bulk-availability" in fired_over_http,
+            "bulk_resolved":
+                engine.state_of("bulk-availability") == "ok",
+            "scrapes": scrapes,
+            "shed_events": len(shed_events),
+            "slo_transition_events": len(slo_events),
+            "event_traces_resolve": all(
+                (export_trace(e.trace_id) or {}).get("traceEvents")
+                for e in slo_events + shed_events if e.trace_id),
+            "objectives": engine.objective_states(),
+        }
         lat = sorted(crit_lat)
 
         def pct(q):
@@ -1741,10 +1851,13 @@ def _overload_bimodal_phase(broker_port: int, *, n_replicas: int,
                 },
             },
             "router_shed": fleet.router.shed,
+            "slo": slo_verdict,
         }
     finally:
         stop.set()
         fleet.stop(drain_s=2.0)
+        plane.stop()
+        app.stop()
 
 
 def _overload_autoscale_phase(broker_port: int, *, service_s: float,
@@ -1834,6 +1947,19 @@ def _overload_autoscale_phase(broker_port: int, *, service_s: float,
         while time.monotonic() < shrink_deadline and \
                 len(fleet.router.replica_ids()) > 1:
             time.sleep(0.1)
+        # decision-event audit (ISSUE 15): every scale action must appear on
+        # the event stream with a trace that exports as a complete Perfetto
+        # trace containing the fleet.autoscale span
+        from analytics_zoo_tpu.observability import events as _events
+        from analytics_zoo_tpu.observability import export_trace
+
+        def _trace_complete(ev) -> bool:
+            t = export_trace(ev.trace_id) if ev.trace_id else None
+            return bool(t) and any(e["name"] == "fleet.autoscale"
+                                   for e in t["traceEvents"])
+
+        ups = _events.events(kind="autoscale.up")
+        downs = _events.events(kind="autoscale.down")
         return {
             "requests": len(uris),
             "failed_requests": len(failed),
@@ -1844,6 +1970,14 @@ def _overload_autoscale_phase(broker_port: int, *, service_s: float,
             "scaled_back_to_min": len(fleet.router.replica_ids()) == 1,
             "scale_events": list(fleet.scale_events),
             "requeued": fleet.requeued,
+            "events": {
+                "autoscale_up": len(ups),
+                "autoscale_down": len(downs),
+                "matches_scale_events":
+                    len(ups) + len(downs) >= len(fleet.scale_events),
+                "traces_complete": bool(ups + downs) and all(
+                    _trace_complete(e) for e in ups + downs),
+            },
         }
     finally:
         stop.set()
@@ -2297,6 +2431,14 @@ if __name__ == "__main__":
             "kill drill requeued nothing — the dead replica held no claimed "
             "work; raise load or lower failover timeout")
         assert drill["reconverged"] and drill["eligible_at_end"] == 4, drill
+        ev = drill["events"]
+        assert ev["failover_events"] > 0, (
+            "the chaos kill's failover never appeared on the decision-event "
+            "stream")
+        assert ev["traces_complete"], (
+            f"a failover event's trace does not export whole: {ev}")
+        assert ev["debug_scrape_ok"], (
+            f"/debug/events scrape failed or missed the failover: {ev}")
         for arm in fb["scaling"].values():
             assert arm["failed_requests"] == 0, arm
         assert fb["speedup_4_vs_1"] >= 2.5, (
@@ -2354,6 +2496,32 @@ if __name__ == "__main__":
         assert bulk["retry_after_s"]["max"] > 0.05, (
             f"shed Retry-After never exceeded the floor — not computed "
             f"from queue state: {bulk['retry_after_s']}")
+        # SLO verdicts (ISSUE 15): the judgment layer must agree with the
+        # raw gates — critical never fires, bulk fires under overload and
+        # resolves once the load drops, and the /debug surface stayed
+        # valid JSON throughout
+        slo = bi["slo"]
+        assert not slo["critical_fired"], (
+            f"critical-latency SLO fired during the drill: "
+            f"{slo['objectives']}")
+        assert slo["bulk_fired"], (
+            f"bulk-availability alert never fired at "
+            f"{bi['offered_over_capacity']}x capacity: {slo['objectives']}")
+        assert slo["bulk_resolved"], (
+            f"bulk-availability alert did not resolve after load dropped: "
+            f"{slo['objectives']}")
+        assert slo["scrapes"]["slo_bad"] == 0 \
+            and slo["scrapes"]["events_bad"] == 0, (
+            f"/debug scrape returned invalid JSON during the drill: "
+            f"{slo['scrapes']}")
+        assert slo["scrapes"]["slo_ok"] > 0, slo["scrapes"]
+        assert slo["shed_events"] > 0, (
+            "no shed decision events emitted under overload")
+        assert slo["slo_transition_events"] >= 2, (
+            f"expected firing+resolved slo events, got "
+            f"{slo['slo_transition_events']}")
+        assert slo["event_traces_resolve"], (
+            "a decision event's trace_id no longer exports a trace")
         asc = ob["autoscale"]
         assert asc["failed_requests"] == 0, (
             f"autoscale drill lost requests: {asc['first_failure']}")
@@ -2362,6 +2530,12 @@ if __name__ == "__main__":
             f"fleet never reached max replicas: {asc['scale_events']}")
         assert asc["scaled_back_to_min"], (
             f"fleet never drained back to 1: {asc['scale_events']}")
+        ev = asc["events"]
+        assert ev["autoscale_up"] > 0 and ev["autoscale_down"] > 0, (
+            f"autoscale actions missing from the decision-event stream: "
+            f"{ev}")
+        assert ev["traces_complete"], (
+            f"an autoscale event's trace does not export whole: {ev}")
         print(f"[bench] overload gate OK: critical p99 "
               f"{crit['p99_ms']}ms (SLO {ob['slo_ms']}ms) at "
               f"{bi['offered_over_capacity']}x capacity, bulk shed "
@@ -2406,6 +2580,24 @@ if __name__ == "__main__":
             "canary kill did not abort the rollout: "
             f"{hs.get('killed_canary')}, {outcomes}")
         assert hs["fleet"]["eligible"] == 4, hs["fleet"]
+        # decision-event audit (ISSUE 15): promotions AND the poisoned
+        # publish's rollback must be on the event stream, each trace
+        # exporting whole (containing the rollout span)
+        from analytics_zoo_tpu.observability import events as _events
+        from analytics_zoo_tpu.observability import export_trace
+
+        promoted_evs = _events.events(kind="rollout.promoted")
+        rejected_evs = _events.events(kind="rollout.rejected")
+        assert promoted_evs, "no rollout.promoted decision events"
+        assert any(e.fields.get("outcome") == "rolled_back"
+                   for e in rejected_evs), (
+            f"poisoned publish's rollback missing from the event stream: "
+            f"{[e.fields for e in rejected_evs]}")
+        for e in promoted_evs + rejected_evs:
+            t = export_trace(e.trace_id) if e.trace_id else None
+            assert t and any(s["name"] == "rollout"
+                             for s in t["traceEvents"]), (
+                f"rollout event {e.fields} trace does not export whole")
         # bounded p95 inflation during swap windows: generous (shared 1-core
         # CI host; staging/validation runs off the hot path, but respawn +
         # requeue after the deliberate canary kill is inside these windows)

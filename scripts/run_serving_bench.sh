@@ -77,12 +77,16 @@ if [[ "${1:-}" == "--quick" ]]; then
     # and routed throughput scales >= 2.5x from 1 to 4 replicas
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
         python bench.py --fleet --quick
-    # overload gate (ISSUE 13): bimodal traffic at 2x capacity — the
-    # critical class holds its SLO (p99 <= deadline) while bulk traffic is
-    # shed with a COMPUTED Retry-After (never queued to timeout) — plus
-    # the autoscale 1->4->1 drill: sustained queue pressure spawns
-    # replicas to max, idleness drains them back, with zero lost and zero
-    # duplicated requests across every scale event
+    # overload gate (ISSUE 13 + the ISSUE-15 observability plane): bimodal
+    # traffic at 2x capacity — the critical class holds its SLO (p99 <=
+    # deadline) while bulk traffic is shed with a COMPUTED Retry-After
+    # (never queued to timeout) — plus the autoscale 1->4->1 drill. The
+    # drill scrapes /debug/slo and /debug/events over HTTP WHILE
+    # overloaded and gates on: every scrape valid JSON, the bulk-class
+    # burn-rate alert firing then resolving after load drops, the
+    # critical-class SLO never firing, shed/slo decision events emitted,
+    # and every autoscale action on the event stream with a trace that
+    # exports as a complete Perfetto trace
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
         python bench.py --overload --quick
     # hot-swap gate: sustained load through >= 3 consecutive canary-rolled

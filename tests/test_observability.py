@@ -1,0 +1,435 @@
+"""Observability plane (ISSUE 15): metrics history window queries, the SLO
+burn-rate engine + alert state machine, structured decision events with
+their sinks, tail-sampled Perfetto trace export, the /debug ops surface,
+the metric-doc-drift lint, and the metrics-jsonl rotation satellite."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from analytics_zoo_tpu.common import telemetry as tm
+from analytics_zoo_tpu.observability import events as ev
+from analytics_zoo_tpu.observability import (MetricsHistory, ObservabilityPlane,
+                                             SLOEngine, parse_objectives)
+from analytics_zoo_tpu.observability import traces as tr
+
+pytestmark = pytest.mark.observability
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_ROOT = os.path.join(REPO_ROOT, "analytics_zoo_tpu")
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    tm.reset_telemetry()
+    ev.reset_events()
+    yield
+    ev.reset_events()
+    tm.reset_telemetry()
+
+
+# ---------------------------------------------------------------------------
+# metrics history
+# ---------------------------------------------------------------------------
+
+def test_history_rate_delta_and_downsampling():
+    reg = tm.MetricRegistry()
+    c = reg.counter("zoo_t_hist_total", "t")
+    hist = MetricsHistory(registry=reg, resolutions=((1.0, 10), (5.0, 10)))
+    t0 = 1000.0
+    for i in range(20):
+        c.inc(3)
+        hist.sample(now=t0 + i)
+    # finest ring holds the last 10 samples; 5s ring downsampled 1-in-5
+    assert hist.rate("zoo_t_hist_total", "", 8, now=t0 + 19) \
+        == pytest.approx(3.0)
+    assert hist.delta("zoo_t_hist_total", "", 8, now=t0 + 19) \
+        == pytest.approx(24.0)
+    # a window wider than the finest ring's CAPACITY falls back to the
+    # coarse ring (which kept one sample per 5s: t0, t0+5, t0+10, t0+15)
+    wide = hist.series("zoo_t_hist_total", "", 40, now=t0 + 19)
+    assert len(wide) == 4
+    assert wide[-1][1] == pytest.approx(48.0)
+    # counter reset clamps increase() style
+    reg.reset()
+    c.inc(2)
+    hist.sample(now=t0 + 20)
+    assert hist.delta("zoo_t_hist_total", "", 5, now=t0 + 20) \
+        == pytest.approx(2.0)
+
+
+def test_history_quantile_over_time_differences_buckets():
+    reg = tm.MetricRegistry()
+    h = reg.histogram("zoo_t_q_seconds", "t", labels=("k",),
+                      buckets=(0.01, 0.1, 1.0))
+    hist = MetricsHistory(registry=reg, resolutions=((1.0, 30),))
+    t0 = 2000.0
+    # old observations OUTSIDE the window must not skew the quantile
+    for _ in range(100):
+        h.labels(k="a").observe(0.005)
+    hist.sample(now=t0)
+    hist.sample(now=t0 + 1)
+    for _ in range(10):
+        h.labels(k="a").observe(0.5)
+    hist.sample(now=t0 + 2)
+    q = hist.quantile_over_time("zoo_t_q_seconds", "a", 0.5, 1.5,
+                                now=t0 + 2)
+    assert 0.1 < q <= 1.0           # median of the WINDOW's observations
+    good, total = hist.fraction_le("zoo_t_q_seconds", "a", 0.1, 1.5,
+                                   now=t0 + 2)
+    assert total == 10 and good == 0
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+def _availability_engine(reg, hist):
+    objs = parse_objectives([
+        {"name": "bulk-avail", "type": "availability", "priority": "bulk",
+         "target": 0.9}])
+    return SLOEngine(hist, objs, fast_window_s=3.0, slow_window_s=9.0,
+                     burn_factor=3.0)
+
+
+def test_slo_fires_and_resolves_with_events():
+    reg = tm.MetricRegistry()
+    out = reg.counter("zoo_request_outcomes_total", "t",
+                      labels=("priority", "outcome"))
+    hist = MetricsHistory(registry=reg, resolutions=((1.0, 60),))
+    eng = _availability_engine(reg, hist)
+    t0 = 3000.0
+    # healthy traffic: no alert
+    for i in range(5):
+        out.labels(priority="bulk", outcome="served").inc(10)
+        hist.sample(now=t0 + i)
+        eng.evaluate(now=t0 + i)
+    assert eng.state_of("bulk-avail") == "ok"
+    # overload: 60% sheds -> burn = 6 > 3 on both windows -> fires once
+    for i in range(5, 12):
+        out.labels(priority="bulk", outcome="served").inc(4)
+        out.labels(priority="bulk", outcome="shed").inc(6)
+        hist.sample(now=t0 + i)
+        eng.evaluate(now=t0 + i)
+    assert eng.state_of("bulk-avail") == "firing"
+    assert eng.ever_fired("bulk-avail")
+    firing = ev.events(kind="slo.firing")
+    assert len(firing) == 1 and firing[0].fields["objective"] == "bulk-avail"
+    st = eng.objective_states()[0]
+    assert st["burn_fast"] > 3.0 and st["budget_remaining"] == 0.0
+    # load drops: the fast window clears and the alert resolves
+    for i in range(12, 20):
+        out.labels(priority="bulk", outcome="served").inc(10)
+        hist.sample(now=t0 + i)
+        eng.evaluate(now=t0 + i)
+    assert eng.state_of("bulk-avail") == "ok"
+    assert [e.fields["objective"] for e in ev.events(kind="slo.resolved")] \
+        == ["bulk-avail"]
+    # the state machine's transitions are in status(), newest last
+    tos = [t["to"] for t in eng.status()["transitions"]]
+    assert tos == ["firing", "resolved"]
+
+
+def test_slo_collectors_land_on_the_scrape():
+    hist = MetricsHistory(resolutions=((1.0, 10),))
+    # hold a reference: the collector walks a WeakSet of live engines
+    engine = SLOEngine(hist, parse_objectives(
+        [{"name": "lat", "type": "latency", "priority": "critical",
+          "threshold_ms": 100, "target": 0.99}]),
+        fast_window_s=3.0, slow_window_s=9.0)
+    assert engine.state_of("lat") == "ok"
+    fams = tm.parse_prometheus(tm.render_prometheus())
+    burn = {(l["objective"], l["window"]): v for _n, l, v
+            in fams["zoo_slo_burn_rate"]["samples"]}
+    assert ("lat", "fast") in burn and ("lat", "slow") in burn
+    assert fams["zoo_slo_alerts_firing"]["samples"][0][2] == 0.0
+    assert fams["zoo_slo_error_budget_remaining"]["samples"][0][2] == 1.0
+
+
+def test_slo_config_yaml_parsing_and_validation(tmp_path):
+    from analytics_zoo_tpu.serving.config import ServingConfig
+
+    p = tmp_path / "cfg.yaml"
+    p.write_text("""
+slo:
+  fast_window_s: 30
+  slow_window_s: 300
+  burn_factor: 6
+  objectives:
+    - {name: crit, type: latency, priority: critical,
+       threshold_ms: 250, target: 0.999}
+    - {name: avail, type: availability, priority: bulk, target: 0.9}
+""")
+    cfg = ServingConfig.from_yaml(str(p))
+    assert len(cfg.slo_objectives) == 2
+    assert cfg.slo_fast_window_s == 30.0 and cfg.slo_burn_factor == 6.0
+    plane = ObservabilityPlane.from_config(cfg)
+    assert plane.slo is not None
+    assert [o.name for o in plane.slo.objectives] == ["crit", "avail"]
+    # invalid objective type fails at CONFIG time
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("slo:\n  objectives:\n    - {name: x, type: bogus}\n")
+    with pytest.raises(ValueError):
+        ServingConfig.from_yaml(str(bad))
+    # fast window must be shorter than slow
+    bad2 = tmp_path / "bad2.yaml"
+    bad2.write_text("slo:\n  fast_window_s: 600\n  slow_window_s: 60\n"
+                    "  objectives:\n"
+                    "    - {name: x, type: error_ratio, target: 0.99}\n")
+    with pytest.raises(ValueError):
+        ServingConfig.from_yaml(str(bad2))
+
+
+# ---------------------------------------------------------------------------
+# decision events
+# ---------------------------------------------------------------------------
+
+def test_events_ring_counter_throttle_and_trace_pin():
+    with tm.span("decision.scope") as sp:
+        ev.emit("autoscale.up", replica="r1", replicas=2)
+    got = ev.events(kind="autoscale")
+    assert len(got) == 1
+    assert got[0].trace_id == sp.trace_id     # ambient span adopted
+    # the event pinned its trace against recorder eviction
+    assert tm.protected_trace_ids().get(sp.trace_id) == "pinned"
+    snap = tm.snapshot()
+    assert snap["zoo_events_total"]["samples"]["autoscale.up,info"] == 1
+    # throttling folds repeats into `suppressed` on the next stored event
+    for _ in range(10):
+        ev.emit("shed.router", severity="warning", throttle_s=60.0,
+                reason="deadline")
+    stored = ev.events(kind="shed.router")
+    assert len(stored) == 1
+    time.sleep(0.0)
+    with pytest.raises(ValueError):
+        ev.emit("x", severity="catastrophic")
+
+
+def test_events_jsonl_sink_and_broker_stream(tmp_path):
+    from analytics_zoo_tpu.serving import start_broker
+    from analytics_zoo_tpu.serving.client import _Conn
+
+    path = str(tmp_path / "events.jsonl")
+    ev.attach_jsonl(path)
+    broker = start_broker()
+    try:
+        ev.attach_broker("127.0.0.1", broker.port)
+        ev.emit("fleet.failover", severity="warning", replica="r0",
+                requeued=3)
+        # the broker sink drains on a background thread
+        deadline = time.time() + 5
+        entries = []
+        while time.time() < deadline and not entries:
+            c = _Conn("127.0.0.1", broker.port)
+            _cur, entries = c.call("XREAD", "events", 0, 16, 0)
+            c.close()
+            time.sleep(0.05)
+        assert entries, "event never reached the broker stream"
+        rec = entries[0][1]
+        assert rec["kind"] == "fleet.failover"
+        assert rec["fields"]["replica"] == "r0"
+    finally:
+        ev.detach_sinks()
+        broker.shutdown()
+        broker.server_close()
+    lines = [json.loads(l) for l in open(path)]
+    assert lines and lines[0]["kind"] == "fleet.failover"
+
+
+def test_breaker_open_and_chaos_fire_emit_events():
+    from analytics_zoo_tpu.common.chaos import ChaosSchedule, chaos_point
+    from analytics_zoo_tpu.common.resilience import CircuitBreaker
+
+    br = CircuitBreaker(failure_threshold=2, name="ev-breaker",
+                        clock=lambda: 0.0)
+    br.record_failure()
+    br.record_failure()
+    opens = ev.events(kind="breaker.open")
+    assert [e.fields["name"] for e in opens] == ["ev-breaker"]
+    sched = ChaosSchedule().delay("conn.call", at=1, seconds=0.0)
+    with sched:
+        chaos_point("conn.call")
+    chaos = ev.events(kind="chaos.injected")
+    assert len(chaos) == 1 and chaos[0].fields["site"] == "conn.call"
+
+
+# ---------------------------------------------------------------------------
+# trace export + tail sampling
+# ---------------------------------------------------------------------------
+
+def test_export_trace_is_perfetto_loadable():
+    with tm.span("root.op", user="u1") as root:
+        with tm.span("child.op"):
+            pass
+    trace = tr.export_trace(root.trace_id)
+    assert trace is not None
+    evs = trace["traceEvents"]
+    assert {e["name"] for e in evs} == {"root.op", "child.op"}
+    for e in evs:
+        assert e["ph"] == "X" and e["dur"] >= 0 and e["ts"] > 0
+        assert e["pid"] == 1 and "span_id" in e["args"]
+    child = next(e for e in evs if e["name"] == "child.op")
+    assert child["args"]["parent_id"] == root.span_id
+    assert tr.export_trace("no-such-trace") is None
+    summaries = tr.trace_summaries()
+    assert summaries[0]["trace_id"] == root.trace_id
+    assert summaries[0]["complete"]
+
+
+def test_interesting_traces_orders_errored_then_slow():
+    with pytest.raises(RuntimeError):
+        with tm.span("bad.op"):
+            raise RuntimeError("x")
+    errored_id = tm.spans(name="bad.op")[0].trace_id
+    t0 = time.perf_counter()
+    tm.record_span("slow.op", t0, t0 + 2.0)
+    tm.record_span("fast.op", t0, t0 + 0.001)
+    picks = tr.interesting_traces(10)
+    assert picks[0]["trace_id"] == errored_id and picks[0]["errored"]
+    assert picks[1]["root"] == "slow.op"
+
+
+# ---------------------------------------------------------------------------
+# /debug ops surface over real HTTP
+# ---------------------------------------------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=10) as r:
+        return r.status, r.headers, r.read()
+
+
+def test_debug_surface_over_http():
+    from analytics_zoo_tpu.serving import FrontEndApp, ServingConfig
+
+    cfg = ServingConfig(slo_objectives=(
+        {"name": "avail", "type": "availability", "priority": "bulk",
+         "target": 0.9},), slo_fast_window_s=2.0, slo_slow_window_s=8.0)
+    plane = ObservabilityPlane.from_config(cfg)
+    plane.history.sample()
+    with tm.span("op.traced") as sp:
+        ev.emit("autoscale.up", replica="r9", replicas=3)
+    app = FrontEndApp(cfg, port=0, plane=plane).start()
+    try:
+        status, headers, body = _get(app.port, "/debug")
+        assert status == 200
+        assert b"<svg" in body or b"no data" in body
+        assert b"SLO objectives" in body and b"autoscale.up" in body
+        status, _h, body = _get(app.port, "/debug/slo")
+        slo = json.loads(body)
+        assert slo["enabled"] and slo["objectives"][0]["name"] == "avail"
+        status, _h, body = _get(app.port, "/debug/events")
+        page = json.loads(body)
+        assert page["count"] >= 1
+        assert any(e["kind"] == "autoscale.up" for e in page["events"])
+        status, headers, body = _get(app.port,
+                                     f"/debug/traces/{sp.trace_id}")
+        trace = json.loads(body)
+        assert any(e["name"] == "op.traced" for e in trace["traceEvents"])
+        assert "attachment" in headers.get("Content-Disposition", "")
+        status, _h, _b = _get(app.port, "/debug/traces")
+        assert status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(app.port, "/debug/traces/doesnotexist")
+        assert ei.value.code == 404
+    finally:
+        app.stop()
+
+
+def test_cli_slo_status_and_trace(tmp_path, capsys):
+    from analytics_zoo_tpu.serving import FrontEndApp, ServingConfig
+    from analytics_zoo_tpu.serving.cli import main as cli_main
+
+    cfg = ServingConfig(slo_objectives=(
+        {"name": "avail", "type": "availability", "priority": "bulk",
+         "target": 0.9},), slo_fast_window_s=2.0, slo_slow_window_s=8.0)
+    plane = ObservabilityPlane.from_config(cfg)
+    with tm.span("cli.traced") as sp:
+        pass
+    app = FrontEndApp(cfg, port=0, plane=plane).start()
+    try:
+        rc = cli_main(["slo-status", "--http", f"127.0.0.1:{app.port}"])
+        assert rc == 0          # enabled, nothing firing
+        out = json.loads(capsys.readouterr().out)
+        assert out["objectives"][0]["name"] == "avail"
+        dest = str(tmp_path / "trace.json")
+        rc = cli_main(["trace", "--http", f"127.0.0.1:{app.port}",
+                       "--trace", sp.trace_id, "--out", dest])
+        assert rc == 0
+        saved = json.load(open(dest))
+        assert any(e["name"] == "cli.traced" for e in saved["traceEvents"])
+    finally:
+        app.stop()
+
+
+# ---------------------------------------------------------------------------
+# metric-doc-drift lint (satellite) — and the repo-wide green gate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.analysis
+def test_metric_doc_drift_both_directions(tmp_path):
+    from analytics_zoo_tpu.analysis.rules.docs import (
+        check_metric_doc_drift, registered_metrics, render_metric_table)
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'from x import telemetry as _tm\n'
+        '_C = _tm.counter("zoo_t_docs_total", "help text", labels=("k",))\n'
+        '_G = _tm.gauge("zoo_t_docs_gauge", "g help")\n')
+    doc = tmp_path / "observability.md"
+    doc.write_text(
+        "# obs\n\nprose mention of `zoo_t_prose_only` is fine\n\n"
+        "| metric | kind | meaning |\n|---|---|---|\n"
+        "| `zoo_t_docs_total{k}` | counter | help |\n"
+        "| `zoo_t_docs_stale_total` | counter | gone |\n")
+    names = registered_metrics([str(pkg)])
+    assert set(names) == {"zoo_t_docs_total", "zoo_t_docs_gauge"}
+    findings = check_metric_doc_drift([str(pkg)], str(doc))
+    msgs = {f.rule for f in findings}
+    assert msgs == {"metric-doc-drift"}
+    texts = " ".join(f.message for f in findings)
+    assert "zoo_t_docs_gauge" in texts          # registered, undocumented
+    assert "zoo_t_docs_stale_total" in texts    # documented, unregistered
+    assert "zoo_t_prose_only" not in texts      # prose is not contract
+    assert len(findings) == 2
+    table = render_metric_table([str(pkg)])
+    assert "| `zoo_t_docs_total` | counter | help text |" in table
+
+
+@pytest.mark.analysis
+def test_metric_doc_drift_repo_green():
+    """The acceptance gate: the live package and docs/observability.md agree
+    in both directions."""
+    from analytics_zoo_tpu.analysis.rules.docs import check_metric_doc_drift
+
+    doc = os.path.join(REPO_ROOT, "docs", "observability.md")
+    findings = check_metric_doc_drift([PKG_ROOT], doc)
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# metrics-jsonl rotation (satellite)
+# ---------------------------------------------------------------------------
+
+def test_metrics_jsonl_rotation_and_gauge(tmp_path):
+    from analytics_zoo_tpu.serving.stack import (_JSONL_BYTES,
+                                                 write_metrics_snapshot)
+
+    tm.counter("zoo_t_rot_total", "t").inc()
+    path = str(tmp_path / "metrics.jsonl")
+    size1 = write_metrics_snapshot(path, max_bytes=1 << 30)
+    assert size1 > 0 and _JSONL_BYTES.value() == size1
+    # a tiny cap forces rotation: the previous generation moves to .1
+    write_metrics_snapshot(path, max_bytes=1)
+    assert os.path.exists(path + ".1")
+    assert not os.path.exists(path) or os.path.getsize(path) == 0
+    assert _JSONL_BYTES.value() == 0
+    size3 = write_metrics_snapshot(path, max_bytes=1 << 30)
+    assert size3 > 0            # fresh file accumulates again
+    assert len(open(path).readlines()) == 1
+    assert len(open(path + ".1").readlines()) == 2

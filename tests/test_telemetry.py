@@ -146,6 +146,112 @@ def test_dead_thread_cells_retire_but_keep_totals():
     assert len(shards.cells()) <= 3     # retired + at most a couple live
 
 
+def test_scrape_under_mutation():
+    """Concurrent render_prometheus() vs. counter/histogram updates vs.
+    collector registration: every scrape must stay parseable — no torn
+    exposition, no exceptions (ISSUE 15 satellite)."""
+    stop = threading.Event()
+    errors: list = []
+
+    def mutate(idx):
+        c = tm.counter("zoo_t_mut_total", "t", labels=("k",))
+        h = tm.histogram("zoo_t_mut_seconds", "t", labels=("k",))
+        i = 0
+        while not stop.is_set():
+            i += 1
+            c.labels(k=f"w{idx}").inc()
+            h.labels(k=f"w{idx}").observe(0.001 * (i % 7),
+                                          exemplar=f"trace-{idx}-{i}")
+
+    def register(idx):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            tm.collector(f"zoo_t_mut_coll_{idx}_{i % 5}", "c",
+                         lambda: [((), 1.0)])
+
+    def scrape():
+        om = False
+        while not stop.is_set():
+            om = not om          # hammer both exposition variants
+            try:
+                tm.parse_prometheus(tm.render_prometheus(openmetrics=om))
+            except Exception as e:   # torn scrape — the failure under test
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=mutate, args=(i,)) for i in range(3)]
+    threads += [threading.Thread(target=register, args=(9,))]
+    threads += [threading.Thread(target=scrape) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.8)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors
+    # final full-registry round-trip, exemplar syntax included (OpenMetrics)
+    fams = tm.parse_prometheus(tm.render_prometheus(openmetrics=True))
+    assert "zoo_t_mut_total" in fams
+    mut = fams["zoo_t_mut_seconds"]
+    assert mut.get("exemplars"), "no exemplar trailer survived the round-trip"
+    name, labels, ex = mut["exemplars"][0]
+    assert name == "zoo_t_mut_seconds_bucket" and "le" in labels
+    assert ex["labels"]["trace_id"].startswith("trace-")
+    assert isinstance(ex["value"], float) and ex["ts"] is not None
+
+
+def test_exemplars_link_spans_to_buckets():
+    with tm.span("exemplar.op"):
+        pass
+    trace_id = tm.spans(name="exemplar.op")[0].trace_id
+    fams = tm.parse_prometheus(tm.render_prometheus(openmetrics=True))
+    exs = fams["zoo_span_duration_seconds"].get("exemplars", [])
+    assert any(ex["labels"]["trace_id"] == trace_id
+               for _n, l, ex in exs
+               if l.get("span") == "exemplar.op")
+    # the DEFAULT exposition stays clean 0.0.4 text — no exemplar trailers
+    # to break a stock Prometheus scraper
+    assert " # {" not in tm.render_prometheus()
+
+
+def test_span_recorder_evicts_whole_traces():
+    """Satellite: the recorder must never orphan a trace — eviction drops
+    oldest WHOLE traces, and errored / slowest / pinned traces survive
+    ordinary ones."""
+    rec = tm._SpanRecorder(maxlen=10, keep_slowest=1, max_pinned=2)
+
+    def spans_for(tid, n, dur=0.001, status="ok"):
+        for i in range(n):
+            rec.record(tm.SpanRecord(
+                f"s{i}", tid, f"{tid}-sp{i}",
+                None if i == 0 else f"{tid}-sp0",
+                1000.0 + i, dur, status, {}))
+
+    spans_for("t-old", 4)
+    spans_for("t-err", 2, status="error")
+    spans_for("t-slow", 2, dur=9.0)
+    spans_for("t-new", 4)          # 12 spans > 10: eviction kicks in
+    # the oldest UNPROTECTED trace went — whole, parent included
+    assert rec.spans(trace_id="t-old") == []
+    # protected traces survive INTACT (root + children, never orphaned)
+    assert {s.span_id for s in rec.spans(trace_id="t-err")} == \
+        {"t-err-sp0", "t-err-sp1"}
+    assert len(rec.spans(trace_id="t-slow")) == 2
+    assert rec.protected_ids()["t-err"] == "error"
+    assert rec.protected_ids()["t-slow"] == "slow"
+    # pins survive churn too (decision-event traces)
+    rec.pin("t-new")
+    spans_for("t-churn1", 4)
+    spans_for("t-churn2", 4)
+    assert len(rec.spans(trace_id="t-new")) == 4
+    assert rec.protected_ids()["t-new"] == "pinned"
+    # bounded even when everything is protected: oldest protected goes
+    for i in range(8):
+        spans_for(f"t-err-{i}", 3, status="error")
+    assert sum(1 for _ in rec.spans()) <= 10 + 3
+
+
 def test_nan_gauge_does_not_break_the_scrape():
     g = tm.gauge("zoo_t_nan_gauge", "t")
     g.set(float("nan"))                 # e.g. a diverged loss mirrored in
