@@ -178,6 +178,8 @@ KNOWN_SITES = {
     "estimator.step",     # engine/estimator.py per-step (both epoch runners)
     "fleet.route",        # serving/fleet.py per-dispatch routing decision
     "fleet.respawn",      # serving/fleet.py dead-replica respawn path
+    "fleet.host_respawn",  # serving/fleet.py whole-host failover respawns
+    "host.heartbeat",     # serving/hostagent.py agent hb/reconcile round
     "overload.shed",      # deadline/admission sheds at every serving tier
                           # (frontend, router, micro-batcher, gen batcher)
     "rollout.phase",      # serving/hotswap.py rollout state-machine phases

@@ -132,11 +132,19 @@ class ClusterLauncher:
 
     def __init__(self, num_processes: int, coordinator_port: int = 7877,
                  env_extra: Optional[Dict[str, str]] = None,
-                 python: Optional[str] = None):
+                 python: Optional[str] = None,
+                 platform: Optional[str] = None,
+                 collectives: Optional[str] = None):
         self.num_processes = int(num_processes)
         self.coordinator = f"127.0.0.1:{coordinator_port}"
         self.env_extra = dict(env_extra or {})
         self.python = python or sys.executable
+        # backend threading: workers that call configure_worker_jax() pick
+        # these up BEFORE importing anything that initializes jax —
+        # collectives="gloo" is what makes multi-process CPU jobs (the
+        # single-machine pod simulation) actually exchange gradients
+        self.platform = platform
+        self.collectives = collectives
         self.monitor = ProcessMonitor()
 
     def worker_env(self, rank: int) -> Dict[str, str]:
@@ -150,6 +158,10 @@ class ClusterLauncher:
             "ZOO_TPU_NUM_PROCESSES": str(self.num_processes),
             "ZOO_TPU_PROCESS_ID": str(rank),
         })
+        if self.platform:
+            env["ZOO_TPU_WORKER_PLATFORM"] = self.platform
+        if self.collectives:
+            env["ZOO_TPU_CPU_COLLECTIVES"] = self.collectives
         return env
 
     def launch(self, script: str, args: Sequence[str] = (),
@@ -170,6 +182,33 @@ class ClusterLauncher:
                                         stdout=logf, stderr=subprocess.STDOUT)
             self.monitor.register(WorkerProc(rank=rank, proc=proc, cmd=cmd))
         return self.monitor
+
+def configure_worker_jax():
+    """Apply the launcher-threaded JAX backend settings in a worker process.
+
+    Call this FIRST — before importing anything that initializes jax — so
+    the platform/collectives config lands before the backend does. Reads
+    the env :meth:`ClusterLauncher.worker_env` injected:
+
+    * ``ZOO_TPU_WORKER_PLATFORM`` → ``jax_platforms`` (e.g. ``cpu`` for the
+      single-machine pod simulation)
+    * ``ZOO_TPU_CPU_COLLECTIVES`` → ``jax_cpu_collectives_implementation``
+      (``gloo`` makes multi-process CPU collectives real, not N isolated
+      single-process meshes)
+
+    ``jax.distributed`` itself is joined later by ``init_zoo_context`` from
+    the ``ZOO_TPU_COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``
+    env the launcher also injected.
+    """
+    import jax
+
+    platform = os.environ.get("ZOO_TPU_WORKER_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    collectives = os.environ.get("ZOO_TPU_CPU_COLLECTIVES")
+    if collectives:
+        jax.config.update("jax_cpu_collectives_implementation", collectives)
+
 
 def barrier(name: str = "zoo_barrier", timeout_s: float = 120.0):
     """Host-level barrier across the jax.distributed job (BarrierTaskContext

@@ -384,6 +384,17 @@ class CircuitBreaker:
         if opened:
             self._emit_open("tripped")
 
+    def reset(self):
+        """Force-close on out-of-band proof of recovery — the inverse of
+        :meth:`trip`. A supervisor that SEES the guarded component healthy
+        again (a re-registered host heartbeating) shouldn't make traffic
+        wait out the reset timeout to rediscover it; the outcome window
+        restarts clean."""
+        with self._lock:
+            self._state = self.CLOSED
+            self._outcomes.clear()
+            self._probes = 0
+
     def call(self, fn: Callable, *args, **kw) -> Any:
         """Run ``fn`` through the breaker; raises :class:`CircuitOpenError`
         without calling when open."""

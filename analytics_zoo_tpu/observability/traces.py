@@ -37,10 +37,18 @@ def render_chrome_trace(records: Sequence[_tm.SpanRecord],
             "args": {"span_id": s.span_id, "parent_id": s.parent_id,
                      "status": s.status, **s.tags},
         })
+    # cross-host traces (whole-host failover) tag spans with the machine
+    # they ran on / acted about — surface the distinct set so an operator
+    # sees at a glance that one timeline stitches several hosts
+    hosts = sorted({str(v) for s in ordered for k, v in s.tags.items()
+                    if k in ("host", "failed_host") and v})
+    other: Dict[str, Any] = {"trace_id": trace_id,
+                             "spans": len(events),
+                             "exporter": "analytics_zoo_tpu.observability"}
+    if hosts:
+        other["hosts"] = hosts
     return {"traceEvents": events, "displayTimeUnit": "ms",
-            "otherData": {"trace_id": trace_id,
-                          "spans": len(events),
-                          "exporter": "analytics_zoo_tpu.observability"}}
+            "otherData": other}
 
 
 def export_trace(trace_id: str) -> Optional[Dict[str, Any]]:

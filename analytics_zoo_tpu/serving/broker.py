@@ -69,8 +69,9 @@ _AOF_REPLAYED = _tm.counter(
     "AOF records replayed at broker startup, by record op", labels=("op",))
 _SHM_NEG = _tm.counter(
     "zoo_broker_shm_negotiations_total",
-    "SHMOPEN ring negotiations, by outcome (fallback = connection stays "
-    "socket-only)", labels=("outcome",))
+    "SHMOPEN ring negotiations, by outcome (fallback/denied = connection "
+    "stays socket-only; denied = host-identity mismatch, a cross-host or "
+    "containerized peer)", labels=("outcome",))
 _AOF_COMPACT = _tm.counter(
     "zoo_broker_aof_compactions_total",
     "AOF compactions (live-state rewrite + atomic rename) triggered by the "
@@ -671,24 +672,38 @@ class _Handler(socketserver.BaseRequestHandler):
                     if resp is _SHMOPEN:
                         # same-host zero-copy negotiation: attach the client's
                         # ring; any failure leaves this connection on the
-                        # socket path (client falls back on a non-"OK" reply)
-                        try:
-                            from .shm import ShmChannel
+                        # socket path (client falls back on a non-"OK" reply).
+                        # A 4-element SHMOPEN carries the client's host
+                        # identity — refuse a peer in another kernel/ipc
+                        # namespace BEFORE touching /dev/shm: attach() can
+                        # spuriously succeed against a same-named segment in
+                        # our namespace that is NOT the client's memory
+                        from .shm import ShmChannel, host_identity
 
-                            new_ch = ShmChannel.attach(req[1], int(req[2]))
-                        except Exception as e:
-                            _SHM_NEG.labels(outcome="fallback").inc()
+                        peer = req[3] if len(req) > 3 else None
+                        if peer is not None and peer != host_identity():
+                            _SHM_NEG.labels(outcome="denied").inc()
                             self.server.count_shm(  # type: ignore[attr-defined]
-                                "fallback")
-                            resp = {"error": f"shm attach failed: {e}"}
+                                "denied")
+                            resp = {"error": "shm denied: cross-host peer "
+                                             f"{peer!r}"}
                         else:
-                            if shm_ch is not None:
-                                shm_ch.close()
-                            shm_ch = new_ch
-                            _SHM_NEG.labels(outcome="ok").inc()
-                            self.server.count_shm(  # type: ignore[attr-defined]
-                                "ok")
-                            resp = "OK"
+                            try:
+                                new_ch = ShmChannel.attach(req[1],
+                                                           int(req[2]))
+                            except Exception as e:
+                                _SHM_NEG.labels(outcome="fallback").inc()
+                                self.server.count_shm(  # type: ignore[attr-defined]
+                                    "fallback")
+                                resp = {"error": f"shm attach failed: {e}"}
+                            else:
+                                if shm_ch is not None:
+                                    shm_ch.close()
+                                shm_ch = new_ch
+                                _SHM_NEG.labels(outcome="ok").inc()
+                                self.server.count_shm(  # type: ignore[attr-defined]
+                                    "ok")
+                                resp = "OK"
                     elif resp is _SHUTDOWN:
                         send_msg(self.request, "OK")
                         threading.Thread(target=self.server.shutdown,
@@ -783,7 +798,7 @@ class QueueBroker(socketserver.ThreadingTCPServer):
         # zoo-lock: guards(_commands, _shm_neg)
         self._counts_lock = traced_lock("QueueBroker._counts_lock")
         self._commands: Dict[str, int] = {}
-        self._shm_neg = {"ok": 0, "fallback": 0}
+        self._shm_neg = {"ok": 0, "fallback": 0, "denied": 0}
 
     def count_command(self, verb: str) -> None:
         with self._counts_lock:
@@ -791,7 +806,7 @@ class QueueBroker(socketserver.ThreadingTCPServer):
 
     def count_shm(self, outcome: str) -> None:
         with self._counts_lock:
-            self._shm_neg[outcome] += 1
+            self._shm_neg[outcome] = self._shm_neg.get(outcome, 0) + 1
 
     def command_counts(self) -> Dict[str, int]:
         with self._counts_lock:

@@ -17,6 +17,7 @@ the commands ride broker control hashes, so they work from any host that can
 reach the broker — the supervising stack process picks them up.
 
     python -m ... cli fleet-status     --port 6380            # roster + hb
+    python -m ... cli hosts            --port 6380            # host agents
     python -m ... cli drain --replica r0 --port 6380          # graceful drain
     python -m ... cli rolling-restart  --port 6380            # zero-downtime
 
@@ -227,6 +228,44 @@ def do_fleet_status(args) -> int:
     return 0
 
 
+def do_hosts(args) -> int:
+    """Host-tier view of a cross-host fleet: each registered host agent's
+    heartbeat age, reported replicas, capacity, and last echoed clock
+    sample — the raw evidence behind `zoo_fleet_host_clock_skew_seconds`
+    and whole-host failover decisions."""
+    from .fleet import MEMBERS_KEY
+    from .hostagent import HOST_HB_PREFIX
+
+    try:
+        members = _call(args.host, args.port, "HGET", MEMBERS_KEY, 0)
+    except (OSError, ConnectionError, ValueError) as e:
+        print(f"broker on {args.host}:{args.port} unreachable: {e}",
+              file=sys.stderr)
+        return 3
+    if not isinstance(members, dict) or not members.get("hosts"):
+        print("no cross-host fleet registered on this broker",
+              file=sys.stderr)
+        return 4
+    import time
+
+    out = {"hosts": {}}
+    now = time.time()
+    for hid in members.get("hosts", ()):
+        hb = _call(args.host, args.port, "HGET", HOST_HB_PREFIX + hid, 0)
+        if isinstance(hb, dict):
+            out["hosts"][hid] = {
+                "state": hb.get("state"),
+                "identity": hb.get("identity"),
+                "capacity": hb.get("capacity"),
+                "replicas": hb.get("replicas"),
+                "pid": hb.get("pid"),
+                "hb_age_s": round(now - float(hb.get("ts", 0)), 3)}
+        else:
+            out["hosts"][hid] = {"state": "no-heartbeat"}
+    print(json.dumps(out, indent=1, sort_keys=True))
+    return 0
+
+
 def do_drain(args) -> int:
     """Graceful drain of one replica: it stops claiming new requests,
     finishes + acks in-flight work, and reports state ``drained``."""
@@ -359,8 +398,9 @@ def main(argv=None) -> int:
                     "+ fleet operations (fleet-status/drain/rolling-restart)")
     ap.add_argument("action",
                     choices=["start", "stop", "restart", "status", "info",
-                             "fleet-status", "drain", "rolling-restart",
-                             "events", "slo-status", "trace"])
+                             "fleet-status", "hosts", "drain",
+                             "rolling-restart", "events", "slo-status",
+                             "trace"])
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6380)
     ap.add_argument("--aof", default=None,
@@ -386,7 +426,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     return {"start": do_start, "stop": do_stop, "restart": do_restart,
             "status": do_status, "info": do_info,
-            "fleet-status": do_fleet_status, "drain": do_drain,
+            "fleet-status": do_fleet_status, "hosts": do_hosts,
+            "drain": do_drain,
             "rolling-restart": do_rolling_restart, "events": do_events,
             "slo-status": do_slo_status, "trace": do_trace}[args.action](args)
 

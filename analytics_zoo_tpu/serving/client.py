@@ -20,7 +20,8 @@ from ..common.locks import traced_lock
 from ..common.resilience import RetryPolicy
 from .qos import (ShedError, deadline_from_ms, normalize_deadline,
                   normalize_priority, shed_error_from_payload)
-from .shm import MIN_SHM_BUFFER_BYTES, ShmChannel, shm_enabled
+from .shm import (MIN_SHM_BUFFER_BYTES, ShmChannel, host_identity,
+                  shm_enabled)
 from .wire import (WireError, received_model_version, recv_msg, send_msg,
                    set_wire_qos)
 from .schema import (DEADLINE_KEY, PRIORITY_KEY, TRACE_KEY, decode_payload,
@@ -127,9 +128,13 @@ class _Conn:
             return
         try:
             # SHMOPEN negotiation is part of the serialized round trip the
-            # conn lock exists for (see _connect)
+            # conn lock exists for (see _connect); the host-identity token
+            # lets the broker refuse a peer that resolves to loopback but
+            # lives in another kernel/ipc namespace (port-forwarded or
+            # containerized "localhost")
             # zoo-lint: disable=lock-hold-hazard — serialized-I/O-by-design
-            send_msg(self.sock, ["SHMOPEN", ch.name, ch.size])
+            send_msg(self.sock, ["SHMOPEN", ch.name, ch.size,
+                                 host_identity()])
             # zoo-lint: disable=lock-hold-hazard — serialized-I/O-by-design
             if recv_msg(self.sock) == "OK":
                 self._shm = ch

@@ -71,13 +71,28 @@ class ServingConfig:
                                          # engines in-process) | "process"
                                          # (one subprocess per replica; needs
                                          # model_path — a live model object
-                                         # can't cross the fork)
+                                         # can't cross the fork) | "host"
+                                         # (replicas placed on HostAgents;
+                                         # see fleet_hosts)
     fleet_heartbeat_s: float = 0.5       # replica -> broker hb cadence
     fleet_failover_timeout_s: float = 3.0  # hb staleness => dead: evict,
                                          # requeue claimed work, respawn
     fleet_spawn_grace_s: float = 30.0    # extra liveness budget for a replica
                                          # that is still loading/compiling its
                                          # model (first heartbeat pending)
+    # --- cross-host fleet (serving/hostagent.py) ---
+    fleet_hosts: int = 0                 # host failure domains: 0 = single-
+                                         # machine fleet (legacy); N > 0 =
+                                         # the supervisor manages N local
+                                         # HostAgent subprocesses standing in
+                                         # for machines (real deployments run
+                                         # `python -m ...serving.hostagent`
+                                         # per machine and set spawn: host)
+    fleet_host_capacity: int = 4         # max replicas placed per host
+    fleet_host_skew_tolerance_s: float = 0.25  # deadline slack floor for
+                                         # cross-host wall-clock skew; the
+                                         # measured per-host offset (from hb
+                                         # round trips) is added on top
     # --- model hot-swap / canary rollout (serving/hotswap.py) ---
     hot_swap: bool = True                # consume the trainer's publish
                                          # stream: fleet stacks run the
@@ -223,7 +238,11 @@ class ServingConfig:
                            ("fleet_spawn", "spawn"),
                            ("fleet_heartbeat_s", "heartbeat_s"),
                            ("fleet_failover_timeout_s", "failover_timeout_s"),
-                           ("fleet_spawn_grace_s", "spawn_grace_s")):
+                           ("fleet_spawn_grace_s", "spawn_grace_s"),
+                           ("fleet_hosts", "hosts"),
+                           ("fleet_host_capacity", "host_capacity"),
+                           ("fleet_host_skew_tolerance_s",
+                            "host_skew_tolerance_s")):
             if key in raw:
                 flat[key] = type(getattr(cls, key))(raw[key])
             elif alias in fleet:
@@ -232,9 +251,15 @@ class ServingConfig:
                                             "round_robin"):
             raise ValueError(f"fleet policy must be 'least_pending'/"
                              f"'round_robin', got {flat['fleet_policy']!r}")
-        if flat.get("fleet_spawn") not in (None, "thread", "process"):
-            raise ValueError(f"fleet spawn must be 'thread'/'process', "
-                             f"got {flat['fleet_spawn']!r}")
+        if flat.get("fleet_spawn") not in (None, "thread", "process", "host"):
+            raise ValueError(f"fleet spawn must be 'thread'/'process'/"
+                             f"'host', got {flat['fleet_spawn']!r}")
+        if flat.get("fleet_hosts", 0) < 0:
+            raise ValueError(f"fleet hosts must be >= 0, "
+                             f"got {flat['fleet_hosts']!r}")
+        if flat.get("fleet_host_capacity", 1) < 1:
+            raise ValueError(f"fleet host_capacity must be >= 1, "
+                             f"got {flat['fleet_host_capacity']!r}")
         rollout = raw.get("rollout") or {}
         for key, alias in (("hot_swap", "enabled"),
                            ("swap_warmup", "warmup"),

@@ -37,12 +37,28 @@ learning":
   restarts and readmits replicas one at a time (the model hot-swap
   precondition).
 
+* Cross-host fleets (``fleet_spawn: host`` / ``fleet_hosts > 0``) add a HOST
+  failure domain above the replica tier: per-machine :class:`~.hostagent.
+  HostAgent` daemons register under ``fleet:host:<hid>``, spawn replicas on
+  supervisor command (the declarative ``fleet:hostctl:<hid>`` hash), and
+  heartbeat host-level liveness distinct from replica liveness. Placement is
+  spread-by-default (the emptiest registered host first — the autoscaler
+  "borrows an idle machine" before packing a busy one) under a per-host
+  capacity; host-heartbeat expiry triggers WHOLE-HOST failover: every
+  replica on the host is evicted, claim-transferred, and respawned on
+  surviving hosts in one decision (one ``fleet.host_failed`` event whose
+  trace carries spans tagged with both host ids and the measured clock
+  offset). A per-host :class:`~..common.resilience.CircuitBreaker` makes
+  dials to a dead host fail fast with a computed Retry-After.
+
 Wire layout on the broker::
 
     serving_stream                   client XADDs (unchanged client API)
     fleet:req:<rid>                  router -> replica dispatch stream
     fleet:hb:<rid>                   replica heartbeat hash {ts, state, served}
     fleet:ctl:<rid>                  supervisor/cli -> replica control hash
+    fleet:host:<hid>                 host-agent heartbeat hash (hostagent.py)
+    fleet:hostctl:<hid>              supervisor -> host-agent desired state
     fleet:members                    supervisor-published replica roster
     result:<uri>                     replica HSETNX (first answer wins)
 """
@@ -52,6 +68,7 @@ from __future__ import annotations
 import argparse
 import collections
 import logging
+import os
 import signal
 import subprocess
 import sys
@@ -71,7 +88,9 @@ from . import slo_metrics as _slo_metrics
 from .client import INPUT_STREAM, RESULT_PREFIX, _Conn
 from .config import ServingConfig
 from .engine import FLEET_CTL_PREFIX, FLEET_HB_PREFIX, ClusterServing
+from .hostagent import HOST_CTL_PREFIX, HOST_HB_PREFIX, HostAgent
 from .schema import payload_deadline, payload_priority
+from .shm import host_identity
 
 logger = logging.getLogger("analytics_zoo_tpu.serving.fleet")
 
@@ -111,9 +130,23 @@ _ROUTER_SHED = _tm.counter(
 _REQ_OUTCOMES = _slo_metrics.REQUEST_OUTCOMES
 _AUTOSCALE = _tm.counter(
     "zoo_autoscale_events_total",
-    "Autoscaler scale events, by direction (up = replica spawned on "
-    "sustained queue pressure, down = replica drained away when idle)",
-    labels=("direction",))
+    "Autoscaler scale events, by direction (up = capacity spawned on "
+    "sustained queue pressure, down = capacity drained away when idle) and "
+    "scope (replica = single-machine fleet, host = cross-host placement — "
+    "up borrows an idle host, down retires a whole host to idle)",
+    labels=("direction", "scope"))
+_HOST_SKEW = _tm.gauge(
+    "zoo_fleet_host_clock_skew_seconds",
+    "Per-host wall-clock offset vs the supervisor, estimated NTP-style from "
+    "heartbeat round trips (positive = host clock ahead); feeds the QoS "
+    "deadline skew tolerance", labels=("host",))
+_HOST_FAILOVERS = _tm.counter(
+    "zoo_fleet_host_failovers_total",
+    "Whole-host failovers: a host heartbeat expired and every replica on it "
+    "was evicted, requeued, and respawned on surviving hosts in one decision")
+_HOSTS = _tm.gauge(
+    "zoo_fleet_hosts",
+    "Registered fleet hosts, by liveness state", labels=("state",))
 
 # scrape-time gauges walk the live routers (weakset, the resilience.py
 # pattern): eligible-replica count + per-replica queue depth — the numbers
@@ -181,6 +214,7 @@ class _ReplicaSlot:
         # canary traffic weight: 1.0 = full member of the rotation; a
         # fraction f < 1 admits this replica on ~every (1/f)th pick only
         self.weight = 1.0
+        self.host: Optional[str] = None   # placement (cross-host fleets)
 
 
 class ReplicaRouter:
@@ -213,10 +247,19 @@ class ReplicaRouter:
             raise ValueError(f"unknown routing policy {self.policy!r}")
         self.registry = registry
         self.name = name
-        # zoo-lock: guards(_slots, _rr_next, _pick_seq)
+        # zoo-lock: guards(_slots, _rr_next, _pick_seq, _host_breakers)
         self._lock = traced_lock("ReplicaRouter._lock")
         self._slots: "collections.OrderedDict[str, _ReplicaSlot]" = \
             collections.OrderedDict()
+        # per-host circuit breakers (supervisor-fed, shared objects): an
+        # OPEN host breaker removes every replica placed there from
+        # eligibility in one stroke — dials to a dead host fail fast
+        self._host_breakers: Dict[str, CircuitBreaker] = {}
+        # fleet-wide deadline slack for cross-host clock skew (supervisor-
+        # fed: configured floor + worst measured per-host offset). Plain
+        # float, single writer — a stale read for one poll interval only
+        # shifts the shed boundary by that poll's skew delta
+        self.skew_s = 0.0
         for rid in replica_ids:
             self.add_replica(rid)
         self._rr_next = 0
@@ -241,6 +284,35 @@ class ReplicaRouter:
     def remove_replica(self, rid: str) -> None:
         with self._lock:
             self._slots.pop(rid, None)
+
+    def set_replica_host(self, rid: str, hid: Optional[str]) -> None:
+        """Record a replica's host placement (cross-host fleets): host-spread
+        tie-breaking in ``least_pending`` and host-breaker gating key on it."""
+        with self._lock:
+            slot = self._slots.get(rid)
+            if slot is not None:
+                slot.host = hid
+
+    def set_host_breaker(self, hid: str,
+                         breaker: Optional[CircuitBreaker]) -> None:
+        """Share a host's breaker with the router (``None`` removes it). The
+        SUPERVISOR owns host liveness and trips it; the router only reads
+        state — a dial toward a dead host is refused at pick time instead of
+        hanging a dispatch."""
+        with self._lock:
+            if breaker is None:
+                self._host_breakers.pop(hid, None)
+            else:
+                self._host_breakers[hid] = breaker
+
+    def _host_open_locked(self, slot: _ReplicaSlot) -> bool:
+        """Caller holds the router lock. Reading the breaker takes its leaf
+        lock — the declared ReplicaRouter._lock < CircuitBreaker._lock
+        order."""
+        if slot.host is None:
+            return False
+        b = self._host_breakers.get(slot.host)
+        return b is not None and b.state == CircuitBreaker.OPEN
 
     def replica_ids(self) -> List[str]:
         with self._lock:
@@ -337,14 +409,15 @@ class ReplicaRouter:
 
     def eligible_ids(self) -> List[str]:
         """Replicas a dispatch could go to right now (hb fresh, lifecycle
-        ``up``, breaker not open; half-open counts — the probe admission
-        happens per-dispatch via ``allow()``)."""
+        ``up``, neither the replica's nor its host's breaker open; half-open
+        counts — the probe admission happens per-dispatch via ``allow()``)."""
         with self._lock:
             slots = list(self._slots.values())
+            host_open = {s.rid: self._host_open_locked(s) for s in slots}
         return [s.rid for s in slots
                 if s.alive and s.state == "up"
                 and s.breaker.state != CircuitBreaker.OPEN
-                and s.probe is None]
+                and s.probe is None and not host_open[s.rid]]
 
     def set_traffic_fraction(self, rid: str, fraction: float) -> None:
         """Canary traffic weighting (the rollout-policy hook): route roughly
@@ -367,7 +440,7 @@ class ReplicaRouter:
         with self._lock:
             slots = list(self._slots.values())
         return {"routed": self.routed, "shed": self.shed,
-                "policy": self.policy,
+                "policy": self.policy, "skew_s": self.skew_s,
                 "replicas": {
                     s.rid: {"dispatched": s.dispatched, "depth": s.depth,
                             "alive": s.alive, "state": s.state,
@@ -375,7 +448,7 @@ class ReplicaRouter:
                             "model_version": s.model_version,
                             "swap_state": s.swap_state,
                             "weight": s.weight, "lat_ms": s.lat_ms,
-                            "svc_ms": s.svc_ms,
+                            "svc_ms": s.svc_ms, "host": s.host,
                             "breaker": s.breaker.state} for s in slots}}
 
     # -- routing -------------------------------------------------------------
@@ -418,7 +491,8 @@ class ReplicaRouter:
         candidates only on every ``round(1/weight)``-th pick."""
         with self._lock:
             slots = [s for s in self._slots.values()
-                     if s.alive and s.state == "up"]
+                     if s.alive and s.state == "up"
+                     and not self._host_open_locked(s)]
             if not slots:
                 return None
             self._pick_seq += 1
@@ -430,7 +504,16 @@ class ReplicaRouter:
                 # a rotation of only weighted members must not stall traffic
                 slots = admitted or slots
             if self.policy == "least_pending":
-                order = sorted(slots, key=lambda s: s.depth)
+                # host-spread tie-break: equal-depth replicas go to the host
+                # with the least TOTAL pending work first, so cross-host
+                # placement stays balanced even when every replica is idle
+                hload: Dict[str, int] = {}
+                for s in slots:
+                    key = s.host or s.rid
+                    hload[key] = hload.get(key, 0) + s.depth
+                order = sorted(slots,
+                               key=lambda s: (s.depth,
+                                              hload[s.host or s.rid]))
             else:                       # round_robin over the stable roster
                 n = len(slots)
                 start = self._rr_next % n
@@ -460,7 +543,8 @@ class ReplicaRouter:
         with self._lock:
             live = [s for s in self._slots.values()
                     if s.alive and s.state == "up"
-                    and s.breaker.state != CircuitBreaker.OPEN]
+                    and s.breaker.state != CircuitBreaker.OPEN
+                    and not self._host_open_locked(s)]
             depths = [s.depth for s in live]
             svcs = [s.svc_ms for s in live if s.svc_ms > 0]
         if not live:
@@ -489,7 +573,9 @@ class ReplicaRouter:
         if dl is None:
             return False
         est, svc, total, eligible = self._wait_estimate()
-        if not _qos.cannot_meet(dl, est, svc):
+        # skew_s loosens the verdict by the fleet's measured cross-host
+        # clock uncertainty: the deadline was stamped on the CLIENT's clock
+        if not _qos.cannot_meet(dl, est, svc, skew_tolerance_s=self.skew_s):
             return False
         chaos_point("overload.shed", tag="router")
         uri = payload.get("uri") if isinstance(payload, dict) else None
@@ -624,9 +710,10 @@ class _ReplicaHandle:
 
     def __init__(self, rid: str, mode: str):
         self.rid = rid
-        self.mode = mode                    # "thread" | "process"
+        self.mode = mode                    # "thread" | "process" | "host"
         self.engine: Optional[ClusterServing] = None
         self.proc: Optional[subprocess.Popen] = None
+        self.host: Optional[str] = None     # placement (host mode)
         self.spawned_at = time.monotonic()
         self.drain_requested = False
         self.restarting = False             # deliberate restart in progress:
@@ -651,6 +738,38 @@ class _ReplicaHandle:
                 self.proc.kill()
 
 
+class _HostSlot:
+    """Supervisor-side view of one host failure domain: the desired replica
+    placement, the host breaker (dials fail fast while it is open), the
+    measured clock offset, and the locally-managed stand-in agent (if any).
+    Single-writer: mutated only by the monitor thread + lifecycle calls,
+    like ``_handles``."""
+
+    def __init__(self, hid: str, config: ServingConfig):
+        self.hid = hid
+        self.capacity = max(1, getattr(config, "fleet_host_capacity", 4))
+        self.breaker = CircuitBreaker(
+            failure_threshold=config.breaker_failure_threshold,
+            reset_timeout_s=config.breaker_reset_timeout_s,
+            name=f"fleet-host-{hid}")
+        self.replicas: set = set()      # desired placement (rids)
+        self.reported: set = set()      # rids the agent reports running
+        self.alive = False
+        self.hb_seen = False            # first fresh heartbeat observed?
+        self.state = "up"
+        self.identity: Optional[str] = None
+        self.last_hb_wall = 0.0         # supervisor clock at last fresh hb
+        # NTP-style offset estimate (host clock - supervisor clock) from the
+        # ping/pong riding the ctl/hb hashes; EMA over round trips
+        self.clock_offset_s = 0.0
+        self.skew_samples = 0
+        self.last_pong_t0: Any = None   # dedupe: one sample per echo
+        self.ctl_nonce = 0
+        self.retiring = False           # scale-down drain owns this host
+        self.proc: Optional[subprocess.Popen] = None   # stand-in subprocess
+        self.agent: Optional[HostAgent] = None         # in-process stand-in
+
+
 class FleetSupervisor:
     """Heartbeat-monitors N replicas, requeues a dead replica's claimed
     work, respawns it, and supports graceful drain / rolling restart.
@@ -660,6 +779,18 @@ class FleetSupervisor:
     ``config.model_path``); ``spawn="process"`` launches
     ``python -m analytics_zoo_tpu.serving.fleet --replica <rid> ...`` — real
     process isolation, requires ``config.model_path`` (or ``demo=True``).
+
+    ``spawn="host"`` (implied by ``config.fleet_hosts > 0``) places replicas
+    on :class:`~.hostagent.HostAgent` failure domains instead of spawning
+    them directly: the supervisor writes desired state into each host's
+    ``fleet:hostctl:<hid>`` hash and the agents reconcile. With
+    ``manage_agents=True`` the supervisor also launches the agents — as
+    local stand-in subprocesses (each under a synthetic host identity, so
+    their connections negotiate shm like genuinely remote peers and settle
+    on TCP), or in-process when a live ``model_factory`` is supplied (tests:
+    ``agent.kill()`` is the whole-host death). Real deployments run
+    ``python -m analytics_zoo_tpu.serving.hostagent`` per machine and pass
+    ``manage_agents=False``.
     """
 
     def __init__(self, config: ServingConfig, *,
@@ -669,10 +800,14 @@ class FleetSupervisor:
                  router: Optional[ReplicaRouter] = None,
                  registry: Optional[HealthRegistry] = None,
                  demo: bool = False, config_path: Optional[str] = None,
-                 platform: Optional[str] = None):
+                 platform: Optional[str] = None,
+                 host_ids: Optional[List[str]] = None,
+                 manage_agents: bool = True):
         self.config = config
-        self.spawn = spawn or config.fleet_spawn
-        if self.spawn not in ("thread", "process"):
+        self.spawn = spawn or (
+            "host" if getattr(config, "fleet_hosts", 0) > 0
+            else config.fleet_spawn)
+        if self.spawn not in ("thread", "process", "host"):
             raise ValueError(f"unknown spawn mode {self.spawn!r}")
         self.model_factory = model_factory
         self.demo = demo
@@ -709,6 +844,18 @@ class FleetSupervisor:
         self.requeued = 0
         self.respawns = 0
         self.failovers: List[float] = []
+        # host failure domains (spawn="host"): desired placement + liveness
+        # per host; single-writer on the monitor thread like _handles
+        self._host_mode = self.spawn == "host"
+        self._hosts: Dict[str, _HostSlot] = {}
+        self.manage_agents = manage_agents
+        self.host_failovers = 0
+        if self._host_mode:
+            n_hosts = max(1, getattr(config, "fleet_hosts", 0) or 2)
+            hids = list(host_ids) if host_ids else \
+                [f"h{i}" for i in range(n_hosts)]
+            for hid in hids:
+                self._hosts[hid] = _HostSlot(hid, config)
         # queue-driven autoscaling (ROADMAP "adaptive serving under
         # overload"): the monitor loop watches owed work per eligible
         # replica (the zoo_fleet_queue_depth signal) plus the router's
@@ -748,7 +895,8 @@ class FleetSupervisor:
             # roster published for operators (`cli fleet-status`/frontends)
             self._conn.call("HSET", MEMBERS_KEY,
                             {"replicas": self.router.replica_ids(),
-                             "spawn": self.spawn})
+                             "spawn": self.spawn,
+                             "hosts": sorted(self._hosts)})
             # a rolling-restart nonce left by a PREVIOUS stack incarnation
             # (the hash is never deleted and survives AOF replay) is an
             # already-executed command, not an order for this one: snapshot
@@ -759,6 +907,15 @@ class FleetSupervisor:
         except RetryAbortedError:
             pass
         self.router.start()
+        for hid, slot in self._hosts.items():
+            self.router.set_host_breaker(hid, slot.breaker)
+            # host liveness budget: spawn grace until the first heartbeat
+            # (the agent may still be importing/compiling), failover timeout
+            # after
+            self.registry.register(f"host.{hid}",
+                                   timeout_s=self.config.fleet_spawn_grace_s)
+            if self.manage_agents:
+                self._start_agent(hid)
         for rid in self.router.replica_ids():
             self._spawn_replica(rid)
         self._monitor = threading.Thread(target=self._monitor_loop,
@@ -774,7 +931,107 @@ class FleetSupervisor:
 
         return dataclasses.replace(self.config)
 
+    def _start_agent(self, hid: str) -> None:
+        """Launch the stand-in agent for one host: in-process (a live
+        ``model_factory`` can't cross a fork) or as a subprocess under a
+        synthetic host identity — its engines then negotiate shm like
+        genuinely remote peers (denied → TCP with retry-backed reconnect)."""
+        slot = self._hosts[hid]
+        if self.model_factory is not None and not self.demo:
+            slot.agent = HostAgent(hid, self._replica_config(),
+                                   model_factory=self.model_factory,
+                                   capacity=slot.capacity)
+            slot.agent.start()
+            return
+        cmd = [sys.executable, "-m", "analytics_zoo_tpu.serving.hostagent",
+               "--hid", hid,
+               "--broker-host", self.config.queue_host,
+               "--broker-port", str(self.config.queue_port),
+               "--capacity", str(slot.capacity)]
+        if self.config_path:
+            cmd += ["--config", self.config_path]
+        if self.platform:
+            cmd += ["--platform", self.platform]
+        if self.demo:
+            cmd.append("--demo")
+        elif self.config.model_path:
+            cmd += ["--model", self.config.model_path]
+        elif not self.config_path:
+            raise ValueError("host-mode agents need model_path, config_path, "
+                             "demo=True, or an in-process model_factory")
+        env = dict(os.environ)
+        env["ZOO_HOST_IDENTITY"] = f"{host_identity()}/{hid}"
+        slot.proc = subprocess.Popen(cmd, env=env)
+
+    def _place_host(self, exclude: Tuple[str, ...] = ()) -> Optional[str]:
+        """Spread placement: the emptiest host with free capacity wins, live
+        hosts before not-yet-heartbeating ones, never one whose breaker is
+        open. "Emptiest first" IS the borrow-a-machine policy — an idle
+        registered host attracts the next replica before any occupied host
+        gets packed further."""
+        cands = [s for s in self._hosts.values()
+                 if s.hid not in exclude and not s.retiring
+                 and s.breaker.state != CircuitBreaker.OPEN
+                 and len(s.replicas) < s.capacity]
+        if not cands:
+            return None
+        cands.sort(key=lambda s: (not s.alive, len(s.replicas), s.hid))
+        return cands[0].hid
+
+    def _push_host_ctl(self, hid: str, shutdown: bool = False) -> None:
+        """Publish one host's desired state (declarative: the agent
+        reconciles; re-sends converge idempotently). The piggybacked
+        ``ping_t0`` is the skew-estimation round trip's first leg."""
+        slot = self._hosts.get(hid)
+        if slot is None:
+            return
+        slot.ctl_nonce += 1
+        mapping: Dict[str, Any] = {
+            "replicas": {rid: self._handles[rid].generation
+                         for rid in sorted(slot.replicas)
+                         if rid in self._handles},
+            "nonce": slot.ctl_nonce, "ping_t0": time.time()}
+        if shutdown:
+            mapping["shutdown"] = True
+        try:
+            self._conn.call("HSET", HOST_CTL_PREFIX + hid, mapping)
+        except RetryAbortedError:
+            raise
+        except Exception:
+            logger.exception("fleet: host ctl push for %s failed", hid)
+
+    def _assign_replica(self, rid: str, hid: str) -> None:
+        """Place one replica on a host: desired-state bookkeeping here, the
+        actual engine spawn happens agent-side on the next reconcile."""
+        handle = self._handles.get(rid)
+        generation = handle.generation + 1 if handle is not None else 1
+        handle = _ReplicaHandle(rid, "host")
+        handle.generation = generation
+        handle.host = hid
+        try:
+            self._conn.call("HDEL", FLEET_HB_PREFIX + rid)
+            self._conn.call("HDEL", FLEET_CTL_PREFIX + rid)
+        except RetryAbortedError:
+            pass
+        for s in self._hosts.values():
+            s.replicas.discard(rid)
+        self._hosts[hid].replicas.add(rid)
+        self._handles[rid] = handle
+        self._hb_seen[rid] = False
+        self.registry.register(f"replica.{rid}",
+                               timeout_s=self.config.fleet_spawn_grace_s)
+        self.router.add_replica(rid)
+        self.router.set_replica_host(rid, hid)
+        self._push_host_ctl(hid)
+
     def _spawn_replica(self, rid: str) -> None:
+        if self._host_mode:
+            target = self._place_host()
+            if target is None:
+                raise RuntimeError(f"fleet: no host with free capacity for "
+                                   f"replica {rid}")
+            self._assign_replica(rid, target)
+            return
         handle = self._handles.get(rid)
         generation = handle.generation + 1 if handle is not None else 1
         handle = _ReplicaHandle(rid, self.spawn)
@@ -833,8 +1090,83 @@ class FleetSupervisor:
                 logger.exception("fleet: supervisor poll failed")
             self._stop.wait(interval)
 
+    def _poll_hosts(self, now: float) -> None:
+        """Host-tier liveness + clock-skew pass. Runs BEFORE the replica
+        pass so a whole-host death is recognized as ONE decision (the
+        replica pass then skips that host's replicas instead of issuing N
+        independent failovers)."""
+        for hid, slot in self._hosts.items():
+            # re-publishing desired state is idempotent agent-side and
+            # refreshes ping_t0 — each round trip is one skew sample
+            self._push_host_ctl(hid)
+            hb = self._conn.call("HGET", HOST_HB_PREFIX + hid, 0)
+            proc_dead = (slot.proc is not None
+                         and slot.proc.poll() is not None)
+            fresh = False
+            if isinstance(hb, dict):
+                slot.identity = hb.get("identity") or slot.identity
+                slot.reported = set(hb.get("replicas") or ())
+                slot.state = str(hb.get("state", "up"))
+                pong_t0 = hb.get("pong_t0")
+                pong_host_t = hb.get("pong_host_t")
+                if (pong_t0 is not None and pong_host_t is not None
+                        and pong_t0 != slot.last_pong_t0):
+                    # one sample per DISTINCT echo: re-reading a frozen
+                    # heartbeat (dead host) must not keep feeding the EMA
+                    # with an ever-staler round trip
+                    slot.last_pong_t0 = pong_t0
+                    # NTP-style offset from the hb round trip: the agent saw
+                    # our ping_t0 and stamped its own clock at the echo;
+                    # midpoint of [t0, now] is our best guess at when.
+                    t2 = time.time()
+                    rtt = t2 - float(pong_t0)
+                    if 0.0 <= rtt < 5.0:
+                        off = float(pong_host_t) - (float(pong_t0) + t2) / 2.0
+                        if slot.skew_samples == 0:
+                            slot.clock_offset_s = off
+                        else:
+                            slot.clock_offset_s = (0.7 * slot.clock_offset_s
+                                                   + 0.3 * off)
+                        slot.skew_samples += 1
+                        _HOST_SKEW.labels(host=hid).set(slot.clock_offset_s)
+                # translate the host's clock into ours before judging
+                # freshness — a skewed-but-healthy host must not look stale
+                ts = float(hb.get("ts", 0.0)) - slot.clock_offset_s
+                fresh = (now - ts < self.config.fleet_failover_timeout_s
+                         and slot.state != "stopped")
+            if fresh and not proc_dead:
+                if not slot.hb_seen:
+                    slot.hb_seen = True
+                    self.registry.register(
+                        f"host.{hid}",
+                        timeout_s=self.config.fleet_failover_timeout_s)
+                self.registry.beat(f"host.{hid}")
+                if not slot.alive:
+                    # dead -> alive edge: a fresh heartbeat is live proof of
+                    # recovery — close the per-host breaker rather than
+                    # waiting out its probe cycle
+                    slot.alive = True
+                    if slot.breaker.state != CircuitBreaker.CLOSED:
+                        logger.info("fleet: host %s is back", hid)
+                        slot.breaker.reset()
+                slot.last_hb_wall = now
+            elif proc_dead:
+                self.registry.register(f"host.{hid}", timeout_s=0.0)
+        alive = sum(1 for s in self._hosts.values() if s.alive)
+        _HOSTS.labels(state="alive").set(alive)
+        _HOSTS.labels(state="dead").set(len(self._hosts) - alive)
+        # worst observed |offset| across live hosts widens the QoS deadline
+        # tolerance: a request is only refused when it cannot be met even
+        # after allowing for how far fleet clocks disagree
+        worst = max((abs(s.clock_offset_s) for s in self._hosts.values()
+                     if s.alive and s.skew_samples), default=0.0)
+        self.router.skew_s = (self.config.fleet_host_skew_tolerance_s
+                              + worst)
+
     def _poll_once(self):
         now = time.time()
+        if self._host_mode:
+            self._poll_hosts(now)
         for rid in list(self._handles):
             hb = self._conn.call("HGET", FLEET_HB_PREFIX + rid, 0)
             handle = self._handles.get(rid)
@@ -851,11 +1183,17 @@ class FleetSupervisor:
             if fresh and not proc_dead:
                 if not self._hb_seen.get(rid):
                     # first beat: tighten the liveness budget from spawn
-                    # grace down to the failover timeout
+                    # grace down to the failover timeout. Host-placed
+                    # replicas get 1.5x — if the whole host died, the host
+                    # component (1.0x) must expire FIRST so the failover is
+                    # one host-level decision, not N per-replica races; a
+                    # lone engine crash inside a live host still trips this.
                     self._hb_seen[rid] = True
-                    self.registry.register(
-                        f"replica.{rid}",
-                        timeout_s=self.config.fleet_failover_timeout_s)
+                    budget = self.config.fleet_failover_timeout_s
+                    if self._host_mode:
+                        budget *= 1.5
+                    self.registry.register(f"replica.{rid}",
+                                           timeout_s=budget)
                 self.registry.beat(f"replica.{rid}")
                 state = str(hb.get("state", "up"))
                 if state in ("draining", "drained") and not handle.restarting:
@@ -885,6 +1223,29 @@ class FleetSupervisor:
         self._autoscale_check()
 
     def _on_transition(self, component: str, alive: bool) -> None:
+        if component.startswith("host."):
+            hid = component[len("host."):]
+            slot = self._hosts.get(hid)
+            if slot is None:
+                return
+            if alive:
+                # re-registering a failed-over host resurrects its registry
+                # component and fires this edge too — only a FRESH heartbeat
+                # (slot.alive, set by the host poll) is proof of recovery
+                if slot.alive:
+                    logger.info("fleet: host %s is back", hid)
+                    slot.breaker.reset()
+                return
+            if self._stop.is_set() or slot.retiring:
+                return
+            if slot.state == "stopped":
+                # graceful agent shutdown, not a failure
+                slot.alive = False
+                return
+            if not slot.alive:
+                return  # already failed over; edge only fires once per death
+            self._host_failover(hid)
+            return
         if not component.startswith("replica."):
             return
         rid = component[len("replica."):]
@@ -896,6 +1257,16 @@ class FleetSupervisor:
         handle = self._handles.get(rid)
         if handle is not None and handle.restarting:
             return      # deliberate rolling restart owns this lifecycle
+        if handle is not None and handle.host is not None:
+            hslot = self._hosts.get(handle.host)
+            if hslot is not None and (
+                    not hslot.alive
+                    or time.time() - hslot.last_hb_wall
+                    > self.config.fleet_failover_timeout_s):
+                # its whole host is dead/dying: the host failover owns
+                # every replica there in ONE decision — no per-replica
+                # failovers racing it
+                return
         self._failover(rid)
 
     def _failover(self, rid: str) -> None:
@@ -945,12 +1316,146 @@ class FleetSupervisor:
                     self._hb_seen.pop(rid, None)
                     self.router.remove_replica(rid)
                     self.registry.deregister(f"replica.{rid}")
+                    if handle.host is not None:
+                        hslot = self._hosts.get(handle.host)
+                        if hslot is not None:
+                            hslot.replicas.discard(rid)
+                            self._push_host_ctl(handle.host)
             dt = time.perf_counter() - t0
             self.failovers.append(dt)
             _FAILOVER.observe(dt)
             _ev.emit("fleet.failover", severity="warning",
                      trace_id=sp.trace_id, replica=rid, requeued=moved,
                      respawned=respawned, failover_s=round(dt, 4))
+
+    def _host_failover(self, hid: str) -> None:
+        """An entire host went silent: evict EVERY replica it carried,
+        claim-transfer all their owed work back, and respawn each on a
+        surviving host — one decision, one span, one ``fleet.host_failed``
+        event. Zero-loss for the same reason single-replica failover is:
+        dead engines acked nothing, so everything they owed is still on the
+        broker (dedup tombstones absorb the did-the-ack-race cases).
+
+        The parent span is tagged with THIS process's host identity; each
+        per-replica child span carries the failed host's id and its last
+        estimated clock offset — the exported trace therefore stitches
+        spans from both machines with explicit clock-offset annotations."""
+        slot = self._hosts[hid]
+        t0 = time.perf_counter()
+        rids = sorted(slot.replicas)
+        with _tm.span("fleet.host_failover", host=host_identity(),
+                      failed_host=hid, replicas=len(rids)) as sp:
+            # fail fast from now on: dials/routes to this host short-circuit
+            # through the breaker until fresh heartbeats prove recovery
+            slot.breaker.trip()
+            slot.alive = False
+            slot.hb_seen = False
+            self.registry.register(f"host.{hid}",
+                                   timeout_s=self.config.fleet_spawn_grace_s)
+            if slot.agent is not None:
+                try:
+                    slot.agent.kill()
+                except Exception:
+                    pass
+                slot.agent = None
+            if slot.proc is not None:
+                try:
+                    slot.proc.kill()
+                    slot.proc.wait(timeout=2.0)
+                except Exception:
+                    pass
+                slot.proc = None
+            total_moved = 0
+            for rid in rids:
+                with _tm.span("fleet.host_failover.evict", replica=rid,
+                              host=hid,
+                              clock_offset_s=round(slot.clock_offset_s, 6)):
+                    self.router.evict(rid)
+                    self.router.set_liveness(rid, False, state="dead")
+                    try:
+                        res = self._conn.call(
+                            "XTRANSFER", self.router.prefix + rid,
+                            f"fleet-{rid}", self.router.stream)
+                        moved = (int(res.get("moved", 0))
+                                 if isinstance(res, dict) else 0)
+                    except RetryAbortedError:
+                        return
+                    except Exception:
+                        logger.exception("fleet: requeue for %s on dead "
+                                         "host %s failed", rid, hid)
+                        moved = 0
+                    total_moved += moved
+            if total_moved:
+                _REQUEUED.inc(total_moved)
+                self.requeued += total_moved
+            slot.replicas.clear()
+            logger.warning("fleet: host %s dead; evicted %s, requeued %d "
+                           "claimed request(s)", hid, rids, total_moved)
+            respawned: Dict[str, Optional[str]] = {}
+            for rid in rids:
+                handle = self._handles.get(rid)
+                if handle is not None and handle.drain_requested:
+                    self._handles.pop(rid, None)
+                    self._hb_seen.pop(rid, None)
+                    self.router.remove_replica(rid)
+                    self.registry.deregister(f"replica.{rid}")
+                    continue
+                chaos_point("fleet.host_respawn", tag=rid)
+                target = self._place_host(exclude=(hid,))
+                if target is None:
+                    # honest stall: no surviving capacity — leave the handle
+                    # so a later recovery/scale-up can re-place it
+                    logger.error("fleet: no surviving host can take %s "
+                                 "(all at capacity or open)", rid)
+                    respawned[rid] = None
+                    continue
+                self._assign_replica(rid, target)
+                self.respawns += 1
+                _FLEET_RESPAWNS.inc()
+                respawned[rid] = target
+            dt = time.perf_counter() - t0
+            self.failovers.append(dt)
+            _FAILOVER.observe(dt)
+            _HOST_FAILOVERS.inc()
+            self.host_failovers += 1
+            _ev.emit("fleet.host_failed", severity="error",
+                     trace_id=sp.trace_id, host=hid, replicas=rids,
+                     requeued=total_moved, respawned=respawned,
+                     failover_s=round(dt, 4),
+                     clock_offset_s=round(slot.clock_offset_s, 6))
+
+    def dial_host(self, hid: str) -> Any:
+        """Probe one host through its circuit breaker. While the host is
+        marked dead the breaker is OPEN and this fails fast —
+        :class:`CircuitOpenError` with a computed ``retry_after_s`` —
+        without touching the network path. Half-open probes judge the
+        host's HEARTBEAT freshness (broker reachability proves nothing
+        about the host), so a still-dead host re-opens the breaker."""
+        slot = self._hosts[hid]
+
+        def probe():
+            hb = self._conn.call("HGET", HOST_HB_PREFIX + hid, 0)
+            fresh = (isinstance(hb, dict)
+                     and time.time() - (float(hb.get("ts", 0.0))
+                                        - slot.clock_offset_s)
+                     < self.config.fleet_failover_timeout_s
+                     and hb.get("state") != "stopped")
+            if not fresh:
+                raise ConnectionError(f"host {hid}: heartbeat stale or "
+                                      "missing")
+            return hb
+
+        return slot.breaker.call(probe)
+
+    def kill_host(self, hid: str) -> None:
+        """Chaos hook: SIGKILL the whole host agent (subprocess) or
+        hard-kill the in-process one — every replica it carried dies at
+        once, nothing acks, no goodbye heartbeat."""
+        slot = self._hosts[hid]
+        if slot.agent is not None:
+            slot.agent.kill()
+        if slot.proc is not None:
+            slot.proc.kill()
 
     # -- autoscaling ---------------------------------------------------------
 
@@ -1033,14 +1538,21 @@ class FleetSupervisor:
         # (the monitor retries next poll while pressure persists) — the
         # kill-during-scale-up drill targets the spawned replica instead
         chaos_point("autoscale.scale", tag="up")
+        scope = "host" if self._host_mode else "replica"
         with _tm.span("fleet.autoscale", direction="up", replica=rid) as sp:
             self._spawn_replica(rid)
             self._as_last_event_t = time.monotonic()
             self._as_pressure_since = None
             self.scale_events.append(("up", len(self._handles)))
-            _AUTOSCALE.labels(direction="up").inc()
+            _AUTOSCALE.labels(direction="up", scope=scope).inc()
+            extra = {}
+            if self._host_mode:
+                handle = self._handles.get(rid)
+                # placement is borrow-a-machine: _place_host already chose
+                # the emptiest (idlest) registered host for the new replica
+                extra["host"] = handle.host if handle is not None else None
             _ev.emit("autoscale.up", trace_id=sp.trace_id, replica=rid,
-                     replicas=len(self._handles))
+                     replicas=len(self._handles), **extra)
         logger.info("autoscale: spawned replica %s (%d total) on sustained "
                     "queue pressure", rid, len(self._handles))
 
@@ -1050,6 +1562,9 @@ class FleetSupervisor:
         stragglers back to the dispatch pool before deregistering. Runs on
         a side thread — the monitor must keep polling heartbeats during the
         drain."""
+        if self._host_mode:
+            self._scale_down_host()
+            return
         victims = [rid for rid, h in self._handles.items()
                    if not h.drain_requested and not h.restarting]
         if len(victims) <= max(1, self.config.min_replicas):
@@ -1090,7 +1605,8 @@ class FleetSupervisor:
                     self.router.remove_replica(rid)
                     self.registry.deregister(f"replica.{rid}")
                     self.scale_events.append(("down", len(self._handles)))
-                    _AUTOSCALE.labels(direction="down").inc()
+                    _AUTOSCALE.labels(direction="down",
+                                      scope="replica").inc()
                     _ev.emit("autoscale.down", trace_id=sp.trace_id,
                              replica=rid, replicas=len(self._handles))
                 logger.info("autoscale: drained replica %s away (%d left)",
@@ -1100,6 +1616,78 @@ class FleetSupervisor:
 
         threading.Thread(target=run, daemon=True,
                          name=f"zoo-autoscale-drain-{rid}").start()
+
+    def _scale_down_host(self) -> None:
+        """Host-scoped scale-down: retire a WHOLE host to idle, zero-loss.
+        The least-loaded occupied host's replicas are drained (finish +
+        ack everything claimed), stragglers claim-transferred back, and
+        the host is left registered-but-empty — exactly the idle machine a
+        later scale-up borrows first."""
+        occupied = [s for s in self._hosts.values()
+                    if s.replicas and s.alive and not s.retiring]
+        if len(occupied) < 2:
+            return      # never drain the last working host
+        victim = min(occupied, key=lambda s: (len(s.replicas), s.hid))
+        rids = sorted(victim.replicas)
+        handles = [self._handles[r] for r in rids if r in self._handles]
+        if len(self._handles) - len(rids) < max(1, self.config.min_replicas):
+            return      # the fleet floor survives the retirement
+        if any(h.drain_requested or h.restarting for h in handles):
+            return
+        for h in handles:
+            h.restarting = True      # monitor hands off these lifecycles
+        victim.retiring = True
+        self._as_busy = True
+        self._as_last_event_t = time.monotonic()
+        self._as_idle_since = None
+        chaos_point("autoscale.scale", tag="down")
+
+        def run():
+            try:
+                with _tm.span("fleet.autoscale", direction="down",
+                              host=victim.hid, replicas=len(rids)) as sp:
+                    for rid in rids:
+                        self.drain(rid)
+                    for rid in rids:
+                        self.wait_state(rid, "drained",
+                                        timeout_s=max(
+                                            5.0, self.config
+                                            .fleet_failover_timeout_s * 4))
+                    # emptying the desired set makes the agent stop its
+                    # engines on the monitor's next ctl push
+                    victim.replicas.clear()
+                    for rid in rids:
+                        try:
+                            res = self._conn.call("XTRANSFER",
+                                                  self.router.prefix + rid,
+                                                  f"fleet-{rid}",
+                                                  self.router.stream)
+                            moved = (int(res.get("moved", 0))
+                                     if isinstance(res, dict) else 0)
+                            if moved:
+                                _REQUEUED.inc(moved)
+                                self.requeued += moved
+                        except Exception:
+                            logger.exception("autoscale: straggler requeue "
+                                             "for %s failed", rid)
+                        self._handles.pop(rid, None)
+                        self._hb_seen.pop(rid, None)
+                        self.router.remove_replica(rid)
+                        self.registry.deregister(f"replica.{rid}")
+                    self.scale_events.append(("down", len(self._handles)))
+                    _AUTOSCALE.labels(direction="down", scope="host").inc()
+                    _ev.emit("autoscale.down", trace_id=sp.trace_id,
+                             host=victim.hid, replicas_drained=rids,
+                             replicas=len(self._handles))
+                logger.info("autoscale: retired host %s to idle (drained "
+                            "%s; %d replicas left)", victim.hid, rids,
+                            len(self._handles))
+            finally:
+                victim.retiring = False
+                self._as_busy = False
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"zoo-autoscale-drain-{victim.hid}").start()
 
     # -- drain / rolling restart --------------------------------------------
 
@@ -1210,6 +1798,13 @@ class FleetSupervisor:
                 "events": len(self.scale_events)}
         if self.rollout is not None:
             detail["rollout"] = self.rollout.state()
+        if self._host_mode:
+            detail["hosts"] = {
+                hid: {"alive": s.alive, "replicas": sorted(s.replicas),
+                      "clock_offset_s": round(s.clock_offset_s, 6),
+                      "breaker": s.breaker.state}
+                for hid, s in self._hosts.items()}
+            detail["host_failovers"] = self.host_failovers
         return len(eligible) >= 1, detail
 
     def model_versions(self) -> Dict[str, Optional[str]]:
@@ -1228,6 +1823,14 @@ class FleetSupervisor:
                                 "events": list(self.scale_events)}
         if self.rollout is not None:
             out["rollout"] = self.rollout.state()
+        if self._host_mode:
+            out["hosts"] = {
+                hid: {"alive": s.alive, "replicas": sorted(s.replicas),
+                      "capacity": s.capacity,
+                      "clock_offset_s": round(s.clock_offset_s, 6),
+                      "breaker": s.breaker.state}
+                for hid, s in self._hosts.items()}
+            out["host_failovers"] = self.host_failovers
         slots = router_stats.get("replicas", {})
         for rid, handle in list(self._handles.items()):
             if handle.engine is not None:
@@ -1254,6 +1857,39 @@ class FleetSupervisor:
         if self.rollout is not None:
             self.rollout.stop()
         self.router.stop(drain_s=min(2.0, drain_s))
+        if self._host_mode:
+            # agents own the engines: command shutdown (they drain their
+            # engines themselves), then reap whatever we manage locally
+            for hid, slot in self._hosts.items():
+                try:
+                    self._push_host_ctl(hid, shutdown=True)
+                except Exception:
+                    pass
+            self._stop.set()
+            for slot in self._hosts.values():
+                if slot.agent is not None:
+                    try:
+                        slot.agent.stop(drain_s=min(2.0, drain_s))
+                    except Exception:
+                        pass
+                    slot.agent = None
+                if slot.proc is not None:
+                    try:
+                        slot.proc.terminate()
+                        slot.proc.wait(timeout=max(5.0, drain_s + 2.0))
+                    except Exception:
+                        try:
+                            slot.proc.kill()
+                        except Exception:
+                            pass
+                    slot.proc = None
+            if self._monitor is not None:
+                self._monitor.join(timeout=2.0)
+                self._monitor = None
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+            return
         for rid, handle in list(self._handles.items()):
             if handle.engine is not None:
                 handle.engine.drain()

@@ -167,15 +167,24 @@ def estimated_wait_s(queue_depth: int, service_ema_s: float,
 
 
 def cannot_meet(deadline: Any, est_wait_s: float, service_ema_s: float = 0.0,
-                now: Optional[float] = None) -> bool:
+                now: Optional[float] = None,
+                skew_tolerance_s: float = 0.0) -> bool:
     """True when a request with ``deadline`` provably cannot be served in
     time: already expired, or the estimated queue wait plus one service time
-    overruns it. Deadline-less requests always pass."""
+    overruns it. Deadline-less requests always pass.
+
+    ``skew_tolerance_s`` loosens the verdict by the fleet's measured cross-
+    host clock uncertainty: deadlines are wall-clock epoch seconds stamped on
+    the CLIENT's host, so a router whose clock runs ahead of the client's
+    would otherwise shed requests that are in fact meetable. Shedding is
+    irreversible while a late answer is merely late — so skew widens the
+    admit side, never the shed side."""
     dl = normalize_deadline(deadline)
     if dl is None:
         return False
     t = time.time() if now is None else now
-    return t + max(0.0, est_wait_s) + max(0.0, service_ema_s) > dl
+    return (t + max(0.0, est_wait_s) + max(0.0, service_ema_s)
+            > dl + max(0.0, skew_tolerance_s))
 
 
 def retry_after_s(queue_depth: int, service_ema_s: float,

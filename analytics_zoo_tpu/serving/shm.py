@@ -10,11 +10,15 @@ of a connection and split into two half-duplex rings:
     [size/2, size)     broker writes, client reads   (results)
 
 Negotiation: the client sends the JSON control message
-``["SHMOPEN", name, size]``; a broker that can attach replies ``"OK"`` and
+``["SHMOPEN", name, size, host_identity]``; a broker that can attach AND
+whose own :func:`host_identity` matches the client's replies ``"OK"`` and
 both sides start placing large buffers in their ring. Any failure — remote
-broker, ``/dev/shm`` unavailable, an old broker answering ``{"error": ...}``
-— simply leaves the connection on the socket path (fallback-to-socket rule:
+broker, a containerized peer with its own ``/dev/shm`` (identity mismatch),
+``/dev/shm`` unavailable, an old broker answering ``{"error": ...}`` —
+simply leaves the connection on the socket path (fallback-to-socket rule:
 shm is an optimisation, never a requirement; see docs/serving_protocol.md).
+Three-element ``SHMOPEN`` from older clients keeps the legacy attach-only
+check.
 
 Ring discipline: the serving protocol is strict request/response per
 connection (the client lock serialises calls), so at most one message is in
@@ -40,6 +44,25 @@ MIN_SHM_BUFFER_BYTES = int(os.environ.get("ZOO_SERVING_SHM_MIN_BYTES",
 
 def shm_enabled() -> bool:
     return os.environ.get("ZOO_SERVING_SHM", "1") != "0"
+
+
+def host_identity() -> str:
+    """A token that is equal iff two processes share a kernel (and therefore
+    a ``/dev/shm``). The boot id distinguishes containers and distinct
+    machines even when hostnames collide (two pods both named ``localhost``);
+    hostname is the fallback on kernels without it. ``ZOO_HOST_IDENTITY``
+    overrides for tests and for deployments that KNOW two namespaces share an
+    IPC mount."""
+    env = os.environ.get("ZOO_HOST_IDENTITY")
+    if env:
+        return env
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            return f.read().strip()
+    except OSError:
+        import socket
+
+        return socket.gethostname()
 
 
 def _shared_memory():

@@ -113,6 +113,13 @@ def main(argv=None) -> int:
                          "`autoscale:` section sets the thresholds)")
     ap.add_argument("--min-replicas", type=int, default=None)
     ap.add_argument("--max-replicas", type=int, default=None)
+    ap.add_argument("--hosts", type=int, default=None,
+                    help="cross-host fleet: place replicas on N host-agent "
+                         "failure domains (local stand-in subprocesses here; "
+                         "run `python -m analytics_zoo_tpu.serving.hostagent`"
+                         " per real machine instead). Whole-host death "
+                         "evicts+respawns every replica in one decision; "
+                         "cross-host connections never use shm")
     ap.add_argument("--int8", action="store_true")
     ap.add_argument("--no-hot-swap", action="store_true",
                     help="ignore the trainer's model_updates publish stream "
@@ -170,6 +177,8 @@ def main(argv=None) -> int:
         cfg.min_replicas = args.min_replicas
     if args.max_replicas is not None:
         cfg.max_replicas = args.max_replicas
+    if args.hosts is not None:
+        cfg.fleet_hosts = args.hosts
     if args.no_hot_swap:
         cfg.hot_swap = False
 
@@ -185,15 +194,19 @@ def main(argv=None) -> int:
     # frontend's /healthz, so an orchestrator probes the whole pipeline
     registry = HealthRegistry(default_timeout_s=cfg.heartbeat_timeout_s)
     ready_fn = None
-    if cfg.replicas > 1 or cfg.autoscale:
+    if cfg.replicas > 1 or cfg.autoscale or cfg.fleet_hosts > 0:
         # fleet mode: router + N supervised replicas; /readyz reflects the
         # eligible-replica count, `cli drain`/`rolling-restart` work.
         # Autoscaling implies fleet mode even at 1 replica — the supervisor
-        # owns the spawn/drain lifecycle the autoscaler drives
+        # owns the spawn/drain lifecycle the autoscaler drives; fleet_hosts
+        # shifts placement onto host-agent failure domains
         demo_module = (_demo_model() if args.demo and not cfg.model_path
                        else None)
         if cfg.fleet_spawn == "process" and demo_module is not None:
             ap.error("--demo needs thread-mode replicas (fleet: spawn)")
+        if cfg.fleet_hosts > 0 and demo_module is not None:
+            # host-agent subprocesses rebuild the demo model themselves
+            demo_module = None
         # the supervisor keeps its OWN registry: a dead replica is a
         # READINESS event (supervisor evicts + respawns; /readyz reflects
         # it) — it must not flip /healthz and get the whole stack restarted
@@ -201,6 +214,7 @@ def main(argv=None) -> int:
             cfg,
             model_factory=((lambda: demo_module) if demo_module is not None
                            else None),
+            demo=bool(args.demo and not cfg.model_path),
             config_path=args.config, platform=args.platform)
         serving.start()
         ready_fn = serving.readiness

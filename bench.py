@@ -1612,6 +1612,162 @@ def run_fleet_bench(quick: bool = False) -> dict:
     return out
 
 
+def run_host_fleet_bench(quick: bool = False, n_hosts: int = 2) -> dict:
+    """Cross-host fleet arm (ISSUE 16): host-level failure domains.
+
+    Topology: ``n_hosts`` in-process HostAgents, replicas spread across them
+    by the placement policy. The drill hard-kills ONE ENTIRE HOST mid-burst
+    (agent.kill() — every replica dies at once, no goodbye heartbeat) and
+    verifies the whole-host failover contract: every request answered
+    exactly once, ONE ``fleet.host_failed`` decision whose exported trace
+    stitches spans from both hosts, survivors absorb the respawns, and a
+    dial to the dead host fails fast through the per-host breaker with a
+    computed Retry-After."""
+    import threading
+
+    import numpy as np
+
+    from analytics_zoo_tpu.common import resilience as _res
+    from analytics_zoo_tpu.observability import ObservabilityPlane
+    from analytics_zoo_tpu.observability import events as _events
+    from analytics_zoo_tpu.observability import export_trace
+    from analytics_zoo_tpu.serving import (FleetSupervisor, InputQueue,
+                                           OutputQueue, ServingConfig,
+                                           start_broker)
+
+    service_s = FLEET_SERVICE_MS / 1e3
+    n_replicas = 2 * n_hosts
+    n_requests = 120 if quick else 400
+    broker = start_broker()
+    cfg = ServingConfig(queue_port=broker.port, batch_size=FLEET_BATCH,
+                        batch_timeout_ms=2, replicas=n_replicas,
+                        fleet_hosts=n_hosts, fleet_heartbeat_s=0.1,
+                        fleet_failover_timeout_s=0.8,
+                        fleet_spawn_grace_s=10.0,
+                        breaker_reset_timeout_s=0.5,
+                        # the SLO verdict the drill gates on: the critical
+                        # class must ride out the whole-host kill without
+                        # its latency objective ever firing (requeued
+                        # requests wait one failover detection, well under
+                        # the threshold)
+                        slo_objectives=(
+                            {"name": "critical-latency", "type": "latency",
+                             "priority": "critical",
+                             "threshold_ms": 2500.0, "target": 0.9},),
+                        slo_fast_window_s=2.0, slo_slow_window_s=8.0,
+                        slo_burn_factor=4.0)
+    plane = ObservabilityPlane.from_config(cfg).start()
+    fleet = FleetSupervisor(
+        cfg, model_factory=lambda: _fleet_stub_model(service_s))
+    fleet.start()
+    try:
+        assert fleet.wait_eligible(n_replicas, timeout_s=15), \
+            f"host fleet never reached {n_replicas}: {fleet.router.stats()}"
+        topology = {hid: sorted(s.replicas)
+                    for hid, s in fleet._hosts.items()}
+        uris: list = []
+        uris_lock = threading.Lock()
+        t0 = time.perf_counter()
+
+        def submit(idx: int, threads: int = 4):
+            iq = InputQueue(port=broker.port)
+            try:
+                for i in range(idx, n_requests, threads):
+                    u = iq.enqueue(None, priority="critical",
+                                   input=np.full((4,), float(i),
+                                                 np.float32))
+                    with uris_lock:
+                        uris.append((i, u))
+            finally:
+                iq.close()
+
+        threads = [threading.Thread(target=submit, args=(i,), daemon=True)
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        while True:
+            with uris_lock:
+                if len(uris) >= n_requests // 3:
+                    break
+            time.sleep(0.005)
+        victim = "h0"
+        fleet.kill_host(victim)
+        killed_at = time.perf_counter() - t0
+        for t in threads:
+            t.join()
+
+        oq = OutputQueue(port=broker.port)
+        failed = []
+        try:
+            for i, u in sorted(uris):
+                try:
+                    v = oq.query(u, timeout_s=60)
+                    if abs(float(np.asarray(v).ravel()[0]) - 4.0 * i) > 1e-5:
+                        failed.append((u, "wrong value"))
+                except Exception as e:
+                    failed.append((u, repr(e)))
+        finally:
+            oq.close()
+        wall = time.perf_counter() - t0
+
+        # let the SLO evaluator tick past the fast window before reading
+        # the verdict — a breach during the kill would fire within it
+        time.sleep(2.5)
+        slo_fired = [e for e in _events.events(kind="slo.firing")
+                     if e.fields.get("objective") == "critical-latency"]
+
+        host_events = [e for e in _events.events(kind="fleet.host_failed")
+                       if e.fields.get("host") == victim]
+        trace_hosts: list = []
+        if host_events:
+            tr = export_trace(host_events[-1].trace_id) or {}
+            trace_hosts = sorted(tr.get("otherData", {}).get("hosts", ()))
+        # fail-fast contract: the breaker answers without touching the
+        # network, with an honest Retry-After. A first dial may land in the
+        # half-open window (the drain outlasts breaker_reset_timeout_s) —
+        # its probe judges the heartbeat stale and re-opens, so the SECOND
+        # dial must be the fast path either way.
+        dial = {"fast_failed": False, "retry_after_s": None}
+        for _ in range(2):
+            t_dial = time.perf_counter()
+            try:
+                fleet.dial_host(victim)
+                break
+            except _res.CircuitOpenError as e:
+                dial = {"fast_failed": True,
+                        "retry_after_s": round(e.retry_after_s, 3)}
+                break
+            except ConnectionError:
+                continue            # half-open probe: breaker re-opened
+        dial["dial_seconds"] = round(time.perf_counter() - t_dial, 4)
+
+        return {
+            "hosts": n_hosts,
+            "replicas": n_replicas,
+            "requests": n_requests,
+            "topology_before_kill": topology,
+            "killed_host": victim,
+            "killed_at_s": round(killed_at, 3),
+            "failed_requests": len(failed),
+            "first_failure": failed[0] if failed else None,
+            "wall_seconds": round(wall, 3),
+            "req_per_s": round(n_requests / wall, 1),
+            "requeued": fleet.requeued,
+            "host_failovers": fleet.host_failovers,
+            "host_failed_events": len(host_events),
+            "respawned": (host_events[-1].fields.get("respawned")
+                          if host_events else None),
+            "trace_hosts": trace_hosts,
+            "dial_dead_host": dial,
+            "critical_slo_fired": len(slo_fired),
+            "eligible_at_end": len(fleet.router.eligible_ids()),
+        }
+    finally:
+        fleet.stop(drain_s=2.0)
+        plane.stop()
+        broker.shutdown()
+
+
 # --------------------------------------------------------------------------
 # adaptive-serving-under-overload bench (ISSUE 13): bimodal traffic at 2x
 # capacity (high-priority p99 holds its SLO while bulk sheds with computed
@@ -2418,6 +2574,10 @@ if __name__ == "__main__":
         _jax.config.update("jax_platforms", "cpu")
         quick = "--quick" in sys.argv
         fb = run_fleet_bench(quick=quick)
+        if "--hosts" in sys.argv:
+            # cross-host arm (ISSUE 16): spread placement + whole-host kill
+            n_hosts = int(sys.argv[sys.argv.index("--hosts") + 1])
+            fb["hosts"] = run_host_fleet_bench(quick=quick, n_hosts=n_hosts)
         if not quick:
             # quick is the CI gate and never touches the committed artifact
             with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -2450,6 +2610,37 @@ if __name__ == "__main__":
               f"{drill['requeued']}, dups_dropped="
               f"{drill['duplicates_dropped']}, failover="
               f"{drill['failover_s']})", file=sys.stderr)
+        if "hosts" in fb:
+            hb = fb["hosts"]
+            # whole-host contract: zero loss, ONE decision, a trace that
+            # spans both machines, and a breaker that fails dials fast
+            assert hb["failed_requests"] == 0, (
+                f"host drill lost requests: {hb['first_failure']}")
+            assert hb["host_failovers"] == 1, hb
+            assert hb["host_failed_events"] == 1, (
+                "host kill must surface as exactly ONE fleet.host_failed "
+                f"decision: {hb['host_failed_events']}")
+            assert len(hb["trace_hosts"]) >= 2, (
+                f"host-failover trace spans one host only: "
+                f"{hb['trace_hosts']}")
+            assert hb["requeued"] > 0, (
+                "host drill requeued nothing — the dead host held no "
+                "claimed work; raise load or lower failover timeout")
+            sizes = sorted(len(r) for r in
+                           hb["topology_before_kill"].values())
+            assert sizes[0] >= 1 and sizes[-1] - sizes[0] <= 1, (
+                f"placement did not spread: {hb['topology_before_kill']}")
+            assert hb["dial_dead_host"]["fast_failed"], hb["dial_dead_host"]
+            assert hb["dial_dead_host"]["retry_after_s"] > 0
+            assert hb["dial_dead_host"]["dial_seconds"] < 0.1
+            assert hb["critical_slo_fired"] == 0, (
+                "the critical-class latency SLO fired during the "
+                "whole-host kill — failover is not transparent")
+            print(f"[bench] host-fleet gate OK: {hb['hosts']} hosts, "
+                  f"whole-host drill zero-loss (requeued={hb['requeued']}, "
+                  f"trace_hosts={hb['trace_hosts']}, retry_after="
+                  f"{hb['dial_dead_host']['retry_after_s']}s)",
+                  file=sys.stderr)
         sys.exit(0)
     if "--overload" in sys.argv:
         # adaptive serving under overload (ISSUE 13): bimodal traffic at 2x

@@ -1,11 +1,13 @@
 #!/usr/bin/env bash
 # Run the deterministic fault-injection suite (tests marked `chaos`, plus the
-# replica-fleet failover drills marked `fleet`, the model hot-swap /
-# canary-rollout drills marked `hotswap` — kill-the-canary-mid-rollout,
-# kill-the-engine-mid-swap, NaN-poisoned publish — and the overload/QoS
-# drills marked `overload` — per-tier deadline shedding, bulk-slot
-# preemption, kill-during-autoscale-scale-up) on the CPU backend with a
-# hard wall-clock cap, independently of tier-1.
+# replica-fleet failover drills marked `fleet` — including the ISSUE-16
+# whole-host kill drill in tests/test_host_fleet.py: an entire host agent
+# SIGKILL-dies and every replica on it fails over in ONE decision — the
+# model hot-swap / canary-rollout drills marked `hotswap` —
+# kill-the-canary-mid-rollout, kill-the-engine-mid-swap, NaN-poisoned
+# publish — and the overload/QoS drills marked `overload` — per-tier
+# deadline shedding, bulk-slot preemption, kill-during-autoscale-scale-up)
+# on the CPU backend with a hard wall-clock cap, independently of tier-1.
 #
 #   scripts/run_chaos_suite.sh            # chaos + fleet + hotswap markers
 #   scripts/run_chaos_suite.sh -k broker  # usual pytest filters pass through

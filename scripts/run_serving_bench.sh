@@ -74,9 +74,14 @@ if [[ "${1:-}" == "--quick" ]]; then
         python -m analytics_zoo_tpu.analysis --mem-witness "$MEM_WITNESS"
     # replica-fleet gate: zero lost requests with one of 4 replicas chaos-
     # killed mid-burst (requeue + dedup-on-uri verified), fleet reconverges,
-    # and routed throughput scales >= 2.5x from 1 to 4 replicas
+    # and routed throughput scales >= 2.5x from 1 to 4 replicas.
+    # --hosts 2 (ISSUE 16) adds the cross-host arm: replicas spread over 2
+    # host agents, ONE ENTIRE HOST killed mid-burst — zero loss, exactly
+    # one fleet.host_failed decision whose trace stitches spans from both
+    # hosts, survivors absorb the respawns, and a dial to the dead host
+    # fails fast through the per-host breaker with a computed Retry-After
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
-        python bench.py --fleet --quick
+        python bench.py --fleet --hosts 2 --quick
     # overload gate (ISSUE 13 + the ISSUE-15 observability plane): bimodal
     # traffic at 2x capacity — the critical class holds its SLO (p99 <=
     # deadline) while bulk traffic is shed with a COMPUTED Retry-After

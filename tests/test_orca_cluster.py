@@ -327,3 +327,52 @@ def test_two_process_distributed_fit_failfast_and_resume(tmp_path):
                                   worker_log(launcher3, 1))
     r0 = json.load(open(out3 / "result-0.json"))
     assert r0["resumed_from_iteration"] > 0, r0
+
+
+def test_cluster_launcher_threads_backend_env():
+    """The launcher's platform/collectives choices ride the worker env so
+    configure_worker_jax() in the child applies them before backend init."""
+    launcher = ClusterLauncher(2, coordinator_port=7921, platform="cpu",
+                               collectives="gloo")
+    env = launcher.worker_env(1)
+    assert env["ZOO_TPU_WORKER_PLATFORM"] == "cpu"
+    assert env["ZOO_TPU_CPU_COLLECTIVES"] == "gloo"
+    assert env["ZOO_TPU_PROCESS_ID"] == "1"
+    assert env["ZOO_TPU_NUM_PROCESSES"] == "2"
+    # defaults: nothing injected, workers keep whatever backend they pick
+    bare = ClusterLauncher(2, coordinator_port=7923).worker_env(0)
+    assert "ZOO_TPU_WORKER_PLATFORM" not in bare
+    assert "ZOO_TPU_CPU_COLLECTIVES" not in bare
+
+
+@pytest.mark.slow
+def test_two_process_flat_zero1_training(tmp_path):
+    """REAL 2-process flat ZeRO-1 (ISSUE 16 sat-3): the PR-5 weight-update
+    sharding runs as genuine 2-process jax.distributed training over gloo —
+    dp-sharded optimizer state, one reduce-scatter + one all-gather per step
+    (asserted in-worker by the collective-budget lint), and both ranks end
+    with identical weights."""
+    import json
+
+    script = os.path.join(os.path.dirname(__file__), "workers",
+                          "zero1_worker.py")
+    out = tmp_path / "zero1"
+    out.mkdir()
+    launcher = ClusterLauncher(
+        2, coordinator_port=7925, platform="cpu", collectives="gloo",
+        # one CPU device per process: dp=2 means one optimizer shard per
+        # PROCESS, so the budgeted collectives genuinely cross gloo
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    mon = launcher.launch(script, [str(out)], log_dir=str(out / "logs"))
+    rcs = mon.wait(timeout_s=420)
+
+    def log(rank):
+        p = os.path.join(launcher.log_dir, f"worker-{rank}.log")
+        return open(p).read()[-2000:] if os.path.exists(p) else "<no log>"
+
+    assert rcs == {0: 0, 1: 0}, (rcs, log(0), log(1))
+    r0, r1 = (json.load(open(out / f"result-{r}.json")) for r in (0, 1))
+    assert r0["process_count"] == 2 and r0["devices"] == 2, r0
+    assert r0["lint_findings"] == 0, r0
+    assert r0["param_digest"] == pytest.approx(r1["param_digest"], rel=1e-6)
+    assert r0["last_loss"] < r0["first_loss"] * 0.1, r0   # actually trains
