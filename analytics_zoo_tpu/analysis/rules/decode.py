@@ -22,7 +22,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
-from ..core import Finding, Rule, RuleContext, register
+from ..core import Finding, Rule, RuleContext, finding, register
 from ..graphlint import walk_eqns
 from .graph_hygiene import _HOST_PRIMITIVES
 
@@ -202,4 +202,39 @@ def lint_decode_stability(model, params, cache_cfg, cache, *,
     return findings
 
 
-__all__ = ["DecodeShapeStabilityRule", "lint_decode_stability"]
+def lint_prefix_write_isolation(pool, row, start: int, *,
+                                page_size: int,
+                                where: str = "serving.generation"
+                                ) -> List[Finding]:
+    """Refcounted-aliasing twin of the cache-alias rule, for the HOST side
+    of shared-prefix admission: a suffix prefill starting at position
+    ``start`` writes K/V into the pages backing positions ``start ..``, so
+    every one of those table pages must be EXCLUSIVELY the stream's
+    (pool refcount 1). A shared page here means the copy-on-write of the
+    boundary page was skipped or mis-indexed — the write would silently
+    corrupt every sibling stream (and the cache) mapped onto that page.
+
+    ``pool``: the :class:`~analytics_zoo_tpu.ops.kv_cache.PagePool`;
+    ``row``: the stream's page ids in table order; ``start``: the first
+    position the suffix dispatch writes. Pages strictly below
+    ``start // page_size`` are the read-only shared prefix and are expected
+    to carry refcount >= 2 (that is the whole point); they are not flagged.
+    Returns one error finding per violating page (empty = isolated)."""
+    out: List[Finding] = []
+    first_written = int(start) // int(page_size)
+    for idx in range(first_written, len(row)):
+        page = int(row[idx])
+        refs = pool.ref_count(page)
+        if refs > 1:
+            out.append(finding(
+                "prefix-share-isolation", "error", f"pool:{where}",
+                f"page {page} (table index {idx}) is written by the suffix "
+                f"prefill from position {start} but carries {refs} "
+                f"references — shared pages must be copy-on-write before "
+                f"any paged_write touches them",
+                page=page, table_index=idx, refcount=refs, start=int(start)))
+    return out
+
+
+__all__ = ["DecodeShapeStabilityRule", "lint_decode_stability",
+           "lint_prefix_write_isolation"]

@@ -182,6 +182,9 @@ KNOWN_SITES = {
     "host.heartbeat",     # serving/hostagent.py agent hb/reconcile round
     "overload.shed",      # deadline/admission sheds at every serving tier
                           # (frontend, router, micro-batcher, gen batcher)
+    "prefix.publish",     # serving/generation.py between a stream's prefill
+                          # compute and its prefix-cache publish (torn-entry
+                          # / page-leak drill)
     "rollout.phase",      # serving/hotswap.py rollout state-machine phases
     "serving.generate",   # serving/generation.py continuous-batch decode loop
     "serving.infer",      # serving/engine.py model-worker batch loop

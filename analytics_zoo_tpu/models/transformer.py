@@ -211,6 +211,49 @@ class TransformerLM(Layer, KerasNet):
         logits = last @ jnp.asarray(params["logits_kernel"], last.dtype)
         return logits.astype(jnp.float32), {"k": k_cache, "v": v_cache}
 
+    def prefill_from(self, params, cache, ids, start, lengths, table, *,
+                     page_size: int):
+        """Chunked SUFFIX prefill: run the tokens from the divergence point
+        of a shared-prefix hit against an already-populated cache prefix.
+
+        ``ids``: (B, T_bucket) int32 — the suffix tokens, occupying
+        positions ``start .. start + T_bucket - 1``; ``start``: (B,) int32
+        — the first position to compute (everything below it is already in
+        the cache via shared prefix pages); ``lengths``: (B,) — the TOTAL
+        true prompt length (``start + true suffix length``). ``table`` must
+        map every position below ``lengths`` to a real page and positions
+        the bucket padding spills into to scratch. Suffix token ``i``
+        attends causally to the whole cached prefix plus suffix tokens
+        ``<= i`` (the speculative verify step's masking, reused block by
+        block); padding rows' K/V land in-page past the true length,
+        invisible through the length mask and overwritten by decode before
+        ever becoming visible. Returns ``(logits (B, V) f32 — at position
+        ``lengths - 1``, cache)``. With ``start == 0`` this is semantically
+        :meth:`prefill` (modulo write path); the warm/cold bit-identity
+        tests pin that equivalence.
+        """
+        ids = jnp.asarray(ids, jnp.int32)
+        start = jnp.asarray(start, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        t = ids.shape[1]
+        positions = start[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+        h = jnp.take(params["token_embeddings"], ids, axis=0)
+        h = h + jnp.take(params["pos_embeddings"], positions, axis=0)
+        h = as_compute(h)
+        k_cache, v_cache = cache["k"], cache["v"]
+        for i, blk in enumerate(self.blocks):
+            h, kp, vp = blk.verify_step(
+                params[f"block{i}"], h, k_cache[i], v_cache[i], table,
+                start, page_size=page_size)
+            k_cache = k_cache.at[i].set(kp)
+            v_cache = v_cache.at[i].set(vp)
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h)
+        last_row = jnp.maximum(lengths - 1 - start, 0)
+        last = jnp.take_along_axis(
+            h, last_row[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        logits = last @ jnp.asarray(params["logits_kernel"], last.dtype)
+        return logits.astype(jnp.float32), {"k": k_cache, "v": v_cache}
+
     def decode_step(self, params, cache, ids, lengths, table, seeds,
                     token_idx, temperature, *, page_size: int,
                     top_k: int = 0):

@@ -25,6 +25,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, quote, urlsplit
 
+from ..common import telemetry as _tm
 from . import events as _ev
 from . import traces as _traces
 
@@ -148,6 +149,9 @@ class DebugSurface:
         ("queue depth", "zoo_fleet_queue_depth", None, None, False),
         ("eligible replicas", "zoo_fleet_eligible_replicas", None, None,
          False),
+        ("prefix hits/s", "zoo_gen_prefix_hits_total", None, None, True),
+        ("prefix tokens saved/s", "zoo_gen_prefix_tokens_saved_total", None,
+         None, True),
     )
 
     def _spark_points(self, metric: str, as_rate: bool,
@@ -223,6 +227,33 @@ class DebugSurface:
                 pts = self._spark_points(metric, as_rate)
                 rows.append(f'<span class="spark">{html.escape(title)}'
                             f"<br>{_spark(pts)}</span>")
+
+        # shared-prefix KV cache (live registry counters; the families only
+        # exist once serving.generation is imported — absent families mean
+        # no generation engine in this process, so the section is omitted)
+        snap = _tm.default_registry().snapshot()
+
+        def _total(name: str) -> Optional[float]:
+            fam = snap.get(name)
+            if not isinstance(fam, dict):
+                return None
+            return sum(float(v) for v in fam.get("samples", {}).values())
+
+        hits = _total("zoo_gen_prefix_hits_total")
+        misses = _total("zoo_gen_prefix_misses_total")
+        if hits is not None and misses is not None:
+            rows.append("<h2>generation prefix cache</h2>")
+            total = hits + misses
+            rate = (f"<b>{hits / total:.1%}</b>" if total
+                    else '<span class="dim">no prefills yet</span>')
+            saved = _total("zoo_gen_prefix_tokens_saved_total") or 0.0
+            evicted = _total("zoo_gen_prefix_evicted_pages_total") or 0.0
+            reclaimable = _total("zoo_gen_prefix_reclaimable_pages") or 0.0
+            rows.append(
+                f"<p>hit rate {rate} ({hits:.0f} hits / {misses:.0f} "
+                f"misses) · {saved:.0f} prompt tokens not recomputed · "
+                f"{evicted:.0f} pages evicted · {reclaimable:.0f} held "
+                f"pages reclaimable</p>")
 
         # decision events
         evs = _ev.events(limit=20)

@@ -33,6 +33,7 @@ jnp gather/scatter so it runs on any backend and stays one jaxpr.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -88,20 +89,32 @@ def init_cache(cfg: KVCacheConfig) -> Dict[str, jax.Array]:
 
 
 class PagePool:
-    """Host-side free-list allocator over the cache's page pool.
+    """Host-side REFCOUNTED free-list allocator over the cache's page pool.
 
-    Thread-safe; page 0 (scratch) is never handed out. ``alloc`` raises
-    :class:`OutOfPages` when the pool is dry — the batcher turns that into a
-    truncated stream rather than a deadlock.
+    Thread-safe; page 0 (scratch) is never handed out. ``alloc`` hands out
+    pages at refcount 1 and raises :class:`OutOfPages` when the pool is dry —
+    the batcher turns that into a truncated stream rather than a deadlock.
+
+    Refcounts are what make shared-prefix serving safe: a page a completed
+    prefill published into the :class:`PrefixCache` can back MANY streams'
+    page tables at once (each holder took :meth:`incref`), and ``release``
+    only reclaims it when the LAST holder lets go. Double-free and leak
+    accounting survive the upgrade: releasing a page nobody holds still
+    raises, and every page is at all times exactly one of *free* or *held*
+    (``free_count() + held_count() == capacity`` — the conservation law the
+    refcount property test drives).
     """
 
     def __init__(self, cfg: KVCacheConfig):
         self.cfg = cfg
         # taken under ContinuousBatcher._lock by the decode loop's page-grow
-        # path and acquires nothing itself
+        # path and under PrefixCache._lock by publish/evict; acquires
+        # nothing itself
         # zoo-lock: leaf
         self._lock = traced_lock("PagePool._lock")
         self._free: List[int] = list(range(cfg.total_pages - 1, 0, -1))
+        # page id -> refcount; absent = free. alloc() starts a page at 1.
+        self._refs: Dict[int, int] = {}
         self._capacity = len(self._free)
 
     @property
@@ -112,6 +125,22 @@ class PagePool:
         with self._lock:
             return len(self._free)
 
+    def held_count(self) -> int:
+        """Distinct pages currently allocated (any refcount)."""
+        with self._lock:
+            return len(self._refs)
+
+    def shared_count(self) -> int:
+        """Pages with refcount >= 2 — prefix pages mapped into more than
+        one holder (streams and/or the prefix cache)."""
+        with self._lock:
+            return sum(1 for r in self._refs.values() if r >= 2)
+
+    def ref_count(self, page: int) -> int:
+        """Current refcount of ``page`` (0 = free/scratch)."""
+        with self._lock:
+            return self._refs.get(int(page), 0)
+
     def alloc(self, n: int = 1) -> List[int]:
         with self._lock:
             if n > len(self._free):
@@ -119,16 +148,57 @@ class PagePool:
                     f"requested {n} pages, {len(self._free)} free "
                     f"(capacity {self._capacity})")
             out = [self._free.pop() for _ in range(n)]
+            for p in out:
+                self._refs[p] = 1
         return out
 
-    def release(self, pages: Sequence[int]) -> None:
+    def incref(self, pages: Sequence[int]) -> None:
+        """Add one reference per page — mapping an already-allocated page
+        into another holder's table (prefix sharing). Increffing a free
+        page is a use-after-free and raises."""
         with self._lock:
             for p in pages:
+                p = int(p)
                 if p == SCRATCH_PAGE:
                     continue
-                if p in self._free:
+                if p not in self._refs:
+                    raise ValueError(
+                        f"incref of unallocated page {p} (use-after-free)")
+                self._refs[p] += 1
+
+    def release(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; a page returns to the free list
+        when its LAST reference is dropped. Releasing a free page raises
+        (double free)."""
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                if p == SCRATCH_PAGE:
+                    continue
+                r = self._refs.get(p)
+                if r is None:
                     raise ValueError(f"double free of page {p}")
-                self._free.append(int(p))
+                if r <= 1:
+                    del self._refs[p]
+                    self._free.append(p)
+                else:
+                    self._refs[p] = r - 1
+
+    def check_conservation(self) -> None:
+        """Assert the pool invariant: every non-scratch page is exactly one
+        of free or held, and the two partitions sum to capacity."""
+        with self._lock:
+            free = set(self._free)
+            held = set(self._refs)
+            if free & held:
+                raise AssertionError(
+                    f"pages both free and held: {sorted(free & held)}")
+            if len(self._free) != len(free):
+                raise AssertionError("duplicate pages on the free list")
+            if len(free) + len(held) != self._capacity:
+                raise AssertionError(
+                    f"page conservation violated: {len(free)} free + "
+                    f"{len(held)} held != capacity {self._capacity}")
 
 
 class OutOfPages(RuntimeError):
@@ -136,8 +206,309 @@ class OutOfPages(RuntimeError):
 
 
 # ---------------------------------------------------------------------------
+# content-addressed prefix cache — host-side index over published KV pages
+# ---------------------------------------------------------------------------
+
+def prefix_block_key(parent: Optional[str], tokens: np.ndarray) -> str:
+    """Chain hash of one page-aligned prefix block: H(parent key, tokens).
+
+    Keying each block by its parent's key makes a block's identity the
+    identity of the WHOLE prefix through it, so lookup is a longest-prefix
+    walk (block i only matches if blocks 0..i-1 matched) and two prompts
+    sharing a block's tokens but not its prefix never collide."""
+    h = hashlib.blake2b(digest_size=16)
+    if parent is not None:
+        h.update(parent.encode("ascii"))
+    h.update(b"|")
+    h.update(np.ascontiguousarray(tokens, dtype=np.int32).tobytes())
+    return h.hexdigest()
+
+
+class _PrefixEntry:
+    """One published block: the pages backing ``block_tokens`` tokens of
+    some prompt prefix, plus the chain bookkeeping."""
+
+    __slots__ = ("key", "parent", "pages", "n_tokens", "last_used",
+                 "active", "children")
+
+    def __init__(self, key: str, parent: Optional[str], pages: List[int],
+                 n_tokens: int, last_used: int):
+        self.key = key
+        self.parent = parent
+        self.pages = pages          # page ids this entry holds one ref each
+        self.n_tokens = n_tokens    # cumulative prefix tokens through here
+        self.last_used = last_used  # logical clock, bumped per hit
+        self.active = 0             # streams currently matched through here
+        self.children: set = set()  # keys chained directly off this block
+
+
+class PrefixMatch:
+    """Result of a :meth:`PrefixCache.lookup` hit. The caller OWNS one
+    pool reference per page in ``pages`` (taken by lookup) and must either
+    install them in a stream's table or release them."""
+
+    __slots__ = ("keys", "pages", "n_tokens")
+
+    def __init__(self, keys: List[str], pages: List[int], n_tokens: int):
+        self.keys = keys
+        self.pages = pages
+        self.n_tokens = n_tokens
+
+
+class PrefixCache:
+    """Content-addressed index of published prefix KV pages.
+
+    Completed prefills :meth:`publish` their full page-aligned blocks under
+    a rolling chain hash; new prefills :meth:`lookup` their prompt and get
+    the longest cached prefix mapped back as shared pages (refcount bump,
+    zero compute, zero new HBM). The cache holds its OWN pool reference on
+    every published page, so entries survive their publisher retiring;
+    eviction (:meth:`evict_to_budget` / :meth:`reclaim_pages`) is LRU over
+    entries no live stream is matched through, leaf blocks first (an
+    interior block is unreachable-from-root only after its children go).
+
+    Thread-safe. All mutation is all-or-nothing under one lock — a chaos
+    kill between a stream's prefill and its publish can never leave a torn
+    (half-inserted) chain. The K/V *contents* of published pages are
+    weight-dependent, so a hot-swap must call :meth:`invalidate`.
+    """
+
+    def __init__(self, pool: PagePool, *, block_tokens: int, page_size: int,
+                 max_pages: int):
+        if block_tokens < 1 or block_tokens % page_size:
+            raise ValueError(
+                f"prefix_block_tokens must be a positive multiple of "
+                f"page_size {page_size}, got {block_tokens}")
+        if max_pages < 1:
+            raise ValueError(f"prefix cache budget must be >= 1 page, "
+                             f"got {max_pages}")
+        self.pool = pool
+        self.block_tokens = int(block_tokens)
+        self.page_size = int(page_size)
+        self.max_pages = int(max_pages)
+        # taken under ContinuousBatcher._lock (retire path) and takes
+        # PagePool._lock (a leaf) for incref/release
+        # zoo-lock: guards(_entries, _held_pages, _clock)
+        self._lock = traced_lock("PrefixCache._lock")
+        self._entries: Dict[str, _PrefixEntry] = {}
+        self._held_pages = 0
+        self._clock = 0
+        # plain counters — the serving layer mirrors these into telemetry
+        self.hits = 0
+        self.misses = 0
+        self.evicted_pages = 0
+        self.evict_sweeps = 0
+
+    # ------------------------------------------------------------ read side
+
+    def _pages_per_block(self) -> int:
+        return self.block_tokens // self.page_size
+
+    def lookup(self, tokens: np.ndarray) -> Optional[PrefixMatch]:
+        """Longest-prefix match of ``tokens`` against the published chains.
+
+        On a hit, takes one pool reference per matched page FOR THE CALLER
+        (atomic with the walk, so a concurrent eviction can never reclaim a
+        matched page first) and marks each matched entry stream-active
+        until :meth:`release_stream`. Returns ``None`` on a miss."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = int(tokens.size)
+        bt = self.block_tokens
+        with self._lock:
+            keys: List[str] = []
+            pages: List[int] = []
+            matched = 0
+            parent: Optional[str] = None
+            while matched + bt <= n:
+                key = prefix_block_key(parent, tokens[matched:matched + bt])
+                entry = self._entries.get(key)
+                if entry is None:
+                    break
+                keys.append(key)
+                pages.extend(entry.pages)
+                matched += bt
+                parent = key
+            if not keys:
+                self.misses += 1
+                return None
+            self._clock += 1
+            for k in keys:
+                e = self._entries[k]
+                e.last_used = self._clock
+                e.active += 1
+            self.pool.incref(pages)      # the caller's references
+            self.hits += 1
+            return PrefixMatch(keys, list(pages), matched)
+
+    def release_stream(self, keys: Sequence[str]) -> None:
+        """Drop a stream's active marks (retire/cancel/failed prefill).
+        Tolerates keys already gone — an intervening :meth:`invalidate`
+        cleared the index but the stream's own page refs were its safety."""
+        with self._lock:
+            for k in keys:
+                e = self._entries.get(k)
+                if e is not None and e.active > 0:
+                    e.active -= 1
+
+    # ----------------------------------------------------------- write side
+
+    def publish(self, tokens: np.ndarray, n_tokens: int,
+                pages: Sequence[int]) -> int:
+        """Publish a completed prefill's FULL blocks into the index.
+
+        ``tokens``: the prompt; ``n_tokens``: how many of them are prefilled
+        (decode writes start at ``n_tokens``, so only blocks wholly below it
+        are frozen and publishable); ``pages``: the stream's page ids in
+        table order. The cache takes its own reference on every newly
+        published page. Blocks already present are skipped (first publisher
+        wins — identical content by construction). Insertion of the whole
+        chain happens under one lock hold: all-or-nothing, never torn.
+        Returns the number of blocks newly published."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bt = self.block_tokens
+        ppb = self._pages_per_block()
+        n_full = int(n_tokens) // bt
+        if n_full < 1:
+            return 0
+        with self._lock:
+            parent: Optional[str] = None
+            fresh: List[Tuple[str, Optional[str], List[int], int]] = []
+            for b in range(n_full):
+                key = prefix_block_key(parent, tokens[b * bt:(b + 1) * bt])
+                if key not in self._entries:
+                    blk = [int(p) for p in pages[b * ppb:(b + 1) * ppb]]
+                    fresh.append((key, parent, blk, (b + 1) * bt))
+                parent = key
+            if not fresh:
+                return 0
+            self._clock += 1
+            for key, par, blk, ntok in fresh:
+                self.pool.incref(blk)   # the cache's own references
+                self._entries[key] = _PrefixEntry(key, par, blk, ntok,
+                                                  self._clock)
+                self._held_pages += len(blk)
+                if par is not None:
+                    self._entries[par].children.add(key)
+        return len(fresh)
+
+    # ------------------------------------------------------------- eviction
+
+    def _remove_locked(self, entry: _PrefixEntry) -> None:
+        del self._entries[entry.key]
+        self._held_pages -= len(entry.pages)
+        if entry.parent is not None:
+            par = self._entries.get(entry.parent)
+            if par is not None:
+                par.children.discard(entry.key)
+        self.pool.release(entry.pages)
+
+    def _evict_locked(self, done) -> Tuple[int, int]:
+        """LRU-evict leaf entries with no active streams until ``done()``
+        or no candidates remain. Caller holds the lock."""
+        n_entries = n_pages = 0
+        while not done():
+            cands = [e for e in self._entries.values()
+                     if not e.children and e.active == 0]
+            if not cands:
+                break
+            victim = min(cands, key=lambda e: e.last_used)
+            self._remove_locked(victim)
+            n_entries += 1
+            n_pages += len(victim.pages)
+        return n_entries, n_pages
+
+    def evict_to_budget(self) -> Dict[str, int]:
+        """Shrink cache-held pages to ``max_pages`` (LRU, leaf-first).
+        Returns sweep stats (zeros when already under budget)."""
+        with self._lock:
+            if self._held_pages <= self.max_pages:
+                return {"entries": 0, "pages": 0, "held_pages":
+                        self._held_pages}
+            n_entries, n_pages = self._evict_locked(
+                lambda: self._held_pages <= self.max_pages)
+            self.evict_sweeps += 1
+            self.evicted_pages += n_pages
+            return {"entries": n_entries, "pages": n_pages,
+                    "held_pages": self._held_pages}
+
+    def reclaim_pages(self, need_free: int) -> int:
+        """Pool-pressure valve: evict (LRU, leaf-first) until the POOL has
+        ``need_free`` free pages or nothing evictable remains. Returns
+        pages released — cache-held-but-unreferenced HBM is reclaimable
+        memory, not occupancy."""
+        with self._lock:
+            n_entries, n_pages = self._evict_locked(
+                lambda: self.pool.free_count() >= need_free)
+            if n_pages:
+                self.evict_sweeps += 1
+                self.evicted_pages += n_pages
+            return n_pages
+
+    def invalidate(self) -> int:
+        """Drop EVERY entry and the cache's page references — the hot-swap
+        hook (published K/V was computed under the old weights). Streams
+        matched through dropped entries are unaffected: they hold their own
+        page references and never re-read the index. Returns pages
+        released."""
+        with self._lock:
+            released = 0
+            for e in self._entries.values():
+                self.pool.release(e.pages)
+                released += len(e.pages)
+            self._entries.clear()
+            self._held_pages = 0
+            return released
+
+    # ---------------------------------------------------------- diagnostics
+
+    def held_pages(self) -> int:
+        with self._lock:
+            return self._held_pages
+
+    def reclaimable_pages(self) -> int:
+        """Cache-held pages whose ONLY reference is the cache's (refcount
+        1, entry not stream-active): what an eviction sweep would actually
+        hand back to the free list right now."""
+        with self._lock:
+            return sum(1 for e in self._entries.values() if e.active == 0
+                       for p in e.pages if self.pool.ref_count(p) == 1)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            entries = len(self._entries)
+            held = self._held_pages
+            active = sum(1 for e in self._entries.values() if e.active)
+        total = self.hits + self.misses
+        return {
+            "entries": entries,
+            "held_pages": held,
+            "budget_pages": self.max_pages,
+            "block_tokens": self.block_tokens,
+            "stream_active_entries": active,
+            "reclaimable_pages": self.reclaimable_pages(),
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            "evicted_pages": self.evicted_pages,
+            "evict_sweeps": self.evict_sweeps,
+        }
+
+
+# ---------------------------------------------------------------------------
 # device ops — all shapes fixed by KVCacheConfig; traced once
 # ---------------------------------------------------------------------------
+
+def copy_page(cache: Dict[str, jax.Array], src, dst) -> Dict[str, jax.Array]:
+    """Copy one page's K and V across every layer, ``src`` -> ``dst`` — the
+    copy-on-write op for the one partially-shared boundary page of a
+    full-prompt prefix hit. ``src``/``dst`` are traced int32 scalars, so
+    every (src, dst) pair rides ONE compiled executable; jit with the cache
+    donated and the copy is an in-place page-sized update, not a second
+    pool."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    return {name: pages.at[:, dst].set(pages[:, src])
+            for name, pages in cache.items()}
 
 def paged_write(pages: jax.Array, table: jax.Array, pos: jax.Array,
                 new: jax.Array, *, page_size: int) -> jax.Array:
@@ -299,7 +670,9 @@ def sample_tokens(logits: jax.Array, seeds: jax.Array, token_idx: jax.Array,
 
 
 __all__ = [
-    "KVCacheConfig", "OutOfPages", "PagePool", "SCRATCH_PAGE",
-    "decode_attention", "decode_attention_multi", "init_cache", "paged_read",
-    "paged_write", "paged_write_multi", "prefill_write", "sample_tokens",
+    "KVCacheConfig", "OutOfPages", "PagePool", "PrefixCache", "PrefixMatch",
+    "SCRATCH_PAGE", "copy_page", "decode_attention",
+    "decode_attention_multi", "init_cache", "paged_read", "paged_write",
+    "paged_write_multi", "prefill_write", "prefix_block_key",
+    "sample_tokens",
 ]

@@ -175,6 +175,30 @@ def do_info(args) -> int:
                                ("phase", "current", "target", "canary")}
     except (OSError, ConnectionError, ValueError):
         pass
+    # generation operator view: the engine's source loop republishes its
+    # stats hash ~1/s (GEN_STATS_PREFIX); present only when a generation
+    # engine consumes from this broker
+    try:
+        from .generation import GEN_STATS_PREFIX
+
+        gen = _call(args.host, args.port, "HGET",
+                    GEN_STATS_PREFIX + "generation", 0)
+        if isinstance(gen, dict):
+            entry = {k: gen.get(k) for k in
+                     ("served_streams", "active_slots", "backlog",
+                      "model_version", "ts")}
+            prefix = gen.get("prefix")
+            if isinstance(prefix, dict):
+                # shared-prefix KV cache headline: fraction of prefills
+                # served (partly) from published prefix pages, plus the
+                # compute + HBM those hits represent
+                entry["prefix_cache"] = {k: prefix.get(k) for k in
+                                         ("hit_rate", "hits", "misses",
+                                          "tokens_saved", "held_pages",
+                                          "budget_pages", "entries")}
+            info["generation"] = entry
+    except (OSError, ConnectionError, ValueError):
+        pass
     print(json.dumps(info, indent=1, sort_keys=True))
     return 0
 

@@ -62,6 +62,15 @@ class ServingConfig:
                                          # executable per (k, slot-count))
     gen_spec_ngram: int = 3              # longest suffix n-gram the
                                          # self-drafting proposer matches on
+    gen_prefix_cache_pages: int = 0      # shared-prefix KV cache: HBM
+                                         # budget in pool pages the cache
+                                         # may hold (0 = sharing disabled;
+                                         # held pages are reclaimed under
+                                         # pool pressure before any stream
+                                         # truncates)
+    gen_prefix_block_tokens: int = 0     # tokens per content-hashed prefix
+                                         # block (0 = one page; must be a
+                                         # positive multiple of page_size)
     # --- replica fleet (serving/fleet.py) ---
     replicas: int = 1                    # engine replicas behind the router
                                          # (1 = classic single-engine stack)
@@ -221,17 +230,42 @@ class ServingConfig:
         if hb is not None:
             flat["hbm_budget_mb"] = float(hb)
         gen = raw.get("generation") or {}
-        for key, alias in (("gen_slots", "slots"),
-                           ("gen_page_size", "page_size"),
-                           ("gen_max_seq_len", "max_seq_len"),
-                           ("gen_pages", "pages"),
-                           ("gen_top_k", "top_k"),
-                           ("gen_spec_k", "spec_k"),
-                           ("gen_spec_ngram", "spec_ngram")):
+        gen_aliases = (("gen_slots", "slots"),
+                       ("gen_page_size", "page_size"),
+                       ("gen_max_seq_len", "max_seq_len"),
+                       ("gen_pages", "pages"),
+                       ("gen_top_k", "top_k"),
+                       ("gen_spec_k", "spec_k"),
+                       ("gen_spec_ngram", "spec_ngram"),
+                       ("gen_prefix_cache_pages", "prefix_cache_pages"),
+                       ("gen_prefix_block_tokens", "prefix_block_tokens"))
+        # typo rejection (same contract as graph_checks/fleet/overload): a
+        # misspelled generation knob must fail at config time, not silently
+        # serve with the default (e.g. `prefix_cache_page:` quietly leaving
+        # sharing off)
+        known_gen = {alias for _, alias in gen_aliases}
+        unknown_gen = sorted(set(gen) - known_gen)
+        if unknown_gen:
+            raise ValueError(
+                f"unknown generation key(s) {unknown_gen}; valid keys: "
+                f"{sorted(known_gen)}")
+        for key, alias in gen_aliases:
             if key in raw:
                 flat[key] = int(raw[key])
             elif alias in gen:
                 flat[key] = int(gen[alias])
+        pcp = flat.get("gen_prefix_cache_pages")
+        if pcp is not None and pcp < 0:
+            raise ValueError(f"generation prefix_cache_pages must be >= 0, "
+                             f"got {pcp}")
+        pbt = flat.get("gen_prefix_block_tokens")
+        if pbt is not None:
+            ps = flat.get("gen_page_size", cls.gen_page_size)
+            if pbt < 0 or (pbt and pbt % ps):
+                raise ValueError(
+                    f"generation prefix_block_tokens must be 0 (= one "
+                    f"page) or a positive multiple of page_size {ps}, "
+                    f"got {pbt}")
         fleet = raw.get("fleet") or {}
         for key, alias in (("replicas", "replicas"),
                            ("fleet_policy", "policy"),

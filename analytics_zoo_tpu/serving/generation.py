@@ -44,7 +44,9 @@ from ..common import telemetry as _tm
 from ..common.chaos import WorkerKilled, chaos_point
 from ..common.locks import traced_lock
 from ..common.resilience import HealthRegistry, RetryAbortedError, RetryPolicy
-from ..ops.kv_cache import OutOfPages, PagePool, SCRATCH_PAGE
+from ..observability import events as _events
+from ..ops.kv_cache import (OutOfPages, PagePool, PrefixCache, SCRATCH_PAGE,
+                            copy_page)
 from . import qos as _qos
 from .client import _Conn
 from .config import ServingConfig
@@ -55,6 +57,11 @@ logger = logging.getLogger("analytics_zoo_tpu.serving.generation")
 
 GEN_STREAM = "generation_stream"
 GEN_OUT_PREFIX = "genout:"
+# broker-side stats hash (per consumer group): the engine's source loop
+# republishes GenerationEngine.stats() here ~1/s so `cli info` can show
+# decode occupancy + prefix-cache hit rate without reaching into the
+# serving process
+GEN_STATS_PREFIX = "gen:stats:"
 
 _GEN_TOKENS = _tm.counter("zoo_gen_tokens_total",
                           "Tokens processed by generation serving, by phase "
@@ -95,6 +102,23 @@ _GEN_SWAPS = _tm.counter(
     "zoo_gen_swaps_total",
     "Atomic (target params, draft schedule) hot-swap pairs applied by live "
     "continuous batchers between decode steps")
+_GEN_PREFIX_HITS = _tm.counter(
+    "zoo_gen_prefix_hits_total",
+    "Prefills that matched at least one published prefix block in the "
+    "shared-prefix KV cache (matched pages mapped read-only, zero compute)")
+_GEN_PREFIX_MISSES = _tm.counter(
+    "zoo_gen_prefix_misses_total",
+    "Prefills that matched no published prefix block (full cold prefill)")
+_GEN_PREFIX_TOKENS_SAVED = _tm.counter(
+    "zoo_gen_prefix_tokens_saved_total",
+    "Prompt tokens NOT recomputed because their KV pages came from the "
+    "shared-prefix cache (per warm prefill: tokens before the divergence "
+    "point)")
+_GEN_PREFIX_EVICTED = _tm.counter(
+    "zoo_gen_prefix_evicted_pages_total",
+    "KV pages released by prefix-cache eviction sweeps (LRU over entries "
+    "no live stream is matched through: budget overflow + pool-pressure "
+    "reclaims)")
 _LIVE_GENERATORS: "weakref.WeakSet[ContinuousBatcher]" = weakref.WeakSet()
 _tm.collector("zoo_gen_active_slots",
               "Occupied decode slots summed over live continuous batchers",
@@ -104,6 +128,15 @@ _tm.collector("zoo_gen_free_pages",
               "Free KV-cache pages summed over live continuous batchers",
               lambda: [((), float(sum(g.pool.free_count()
                                       for g in list(_LIVE_GENERATORS))))])
+_tm.collector("zoo_gen_prefix_reclaimable_pages",
+              "Prefix-cache pages whose only reference is the cache's own "
+              "(no live stream attached) — HBM an eviction sweep would "
+              "return to the free list, distinguishing 'held but "
+              "reclaimable' from truly occupied pages",
+              lambda: [((), float(sum(
+                  g.prefix_cache.reclaimable_pages()
+                  for g in list(_LIVE_GENERATORS)
+                  if g.prefix_cache is not None)))])
 
 
 def _next_pow2(n: int) -> int:
@@ -118,7 +151,8 @@ class _Request:
 
     __slots__ = ("uri", "prompt", "max_new_tokens", "temperature", "seed",
                  "eos_id", "on_chunk", "ctx", "submitted_t", "cancelled",
-                 "last_emit_t", "priority", "deadline", "seq")
+                 "last_emit_t", "priority", "deadline", "seq",
+                 "cached_prefix_tokens")
 
     def __init__(self, uri, prompt, max_new_tokens, temperature, seed,
                  eos_id, on_chunk, ctx, priority=None, deadline=None,
@@ -139,6 +173,9 @@ class _Request:
         self.priority = _qos.normalize_priority(priority)
         self.deadline = _qos.normalize_deadline(deadline)
         self.seq = seq
+        # prompt tokens served from the shared-prefix cache instead of
+        # recomputed (set at admission; rides the final frame's meta)
+        self.cached_prefix_tokens = 0
 
     @property
     def order_key(self) -> Tuple:
@@ -205,10 +242,11 @@ class _Slot:
     """One decode slot's host-side state (device state lives in the cache)."""
 
     __slots__ = ("request", "length", "generated", "last_token", "pages",
-                 "handle", "history", "pending_drafts")
+                 "handle", "history", "pending_drafts", "prefix_keys")
 
     def __init__(self, request: _Request, length: int, last_token: int,
-                 pages: List[int], history: Optional[List[int]] = None):
+                 pages: List[int], history: Optional[List[int]] = None,
+                 prefix_keys: Optional[List[str]] = None):
         self.request = request
         self.length = length            # tokens already in the cache
         self.generated = 1              # prefill samples token 0
@@ -222,6 +260,10 @@ class _Slot:
         # so a PREEMPTED slot parks carrying its pending draft state and
         # resumes without re-drafting (PR-13 composition)
         self.pending_drafts: Optional[List[int]] = None
+        # prefix-cache entry keys this stream matched through at admission;
+        # released (stream-active decrement) when the slot retires. The
+        # PAGE references ride slot.pages and release with them.
+        self.prefix_keys: List[str] = prefix_keys or []
 
 
 class ContinuousBatcher:
@@ -247,6 +289,8 @@ class ContinuousBatcher:
                  spec_k: int = 0, spec_ngram: int = 3,
                  admit_policy: str = "continuous",
                  batch_window_s: float = 0.05,
+                 prefix_cache_pages: int = 0,
+                 prefix_block_tokens: int = 0,
                  graph_checks: Optional[str] = None,
                  hbm_budget_bytes: Optional[int] = None,
                  donate_cache: bool = True,
@@ -278,6 +322,18 @@ class ContinuousBatcher:
             n_slots, page_size=page_size, max_seq_len=max_seq_len,
             n_pages=n_pages)
         self.pool = PagePool(self.cfg)
+        # shared-prefix KV cache (ISSUE 17): 0 pages disables sharing
+        # entirely (the cold baseline); the budget counts CACHE-held pages
+        # inside the one pool, reclaimed under pool pressure before any
+        # stream is ever truncated for pages the cache is sitting on
+        self.prefix_cache: Optional[PrefixCache] = None
+        if int(prefix_cache_pages) > 0:
+            self.prefix_cache = PrefixCache(
+                self.pool,
+                block_tokens=int(prefix_block_tokens) or page_size,
+                page_size=page_size, max_pages=int(prefix_cache_pages))
+        self.prefix_tokens_saved = 0
+        self.peak_pages_in_use = 0
         self.registry = registry
         # host-side mirrors of the traced arrays (fixed shapes)
         self._table = np.full((self.n_slots, self.cfg.pages_per_slot),
@@ -352,6 +408,15 @@ class ContinuousBatcher:
             lambda p, c, ids, ln, tb: model.prefill(
                 p, c, ids, ln, tb, page_size=cfg.page_size),
             donate_argnums=donate)
+        # suffix prefill from the divergence point of a prefix hit (one
+        # executable per pow2 suffix bucket, same ladder as _prefill) and
+        # the COW boundary-page copy (ONE executable: src/dst are traced)
+        self._prefill_from = jax.jit(
+            lambda p, c, ids, st, ln, tb: model.prefill_from(
+                p, c, ids, st, ln, tb, page_size=cfg.page_size),
+            donate_argnums=donate)
+        self._copy_page = jax.jit(
+            copy_page, donate_argnums=(0,) if donate_cache else ())
         # one compiled verify executable per k ever used (lazily jitted; a
         # spec-schedule hot-swap to a new k compiles exactly one more — the
         # per-(k, slot-count) executable invariant the lint gate asserts)
@@ -418,10 +483,19 @@ class ContinuousBatcher:
                             error="generator closed before admission")
         parked, self._preempted = self._preempted, []
         for slot in parked:
+            self.pool.release(slot.pages)
+            slot.pages = []
+            if slot.prefix_keys and self.prefix_cache is not None:
+                self.prefix_cache.release_stream(slot.prefix_keys)
+                slot.prefix_keys = []
             self._finish_cb(slot.request, [], "error",
                             error="generator closed mid-stream",
                             n_tokens=slot.generated)
         self._fail_all_active("generator closed mid-stream")
+        # leak accounting: drop the cache's own page references so a closed
+        # batcher's pool sums back to capacity
+        if self.prefix_cache is not None:
+            self.prefix_cache.invalidate()
 
     # ------------------------------------------------------------------- client
 
@@ -614,6 +688,9 @@ class ContinuousBatcher:
             with self._lock:
                 self.pool.release(parked.pages)
                 parked.pages = []
+                if parked.prefix_keys and self.prefix_cache is not None:
+                    self.prefix_cache.release_stream(parked.prefix_keys)
+                    parked.prefix_keys = []
             self._finish_cb(parked.request, [], "cancelled")
             return
         with self._lock:
@@ -686,45 +763,147 @@ class ContinuousBatcher:
                         self._preempted.remove(parked)
                     self._resume_slot(parked)
                 return
+            except WorkerKilled:
+                # chaos kill mid-prefill: the request lost nothing (every
+                # page/cache reference was handed back above) — requeue it
+                # at the backlog head so the respawned loop re-admits it,
+                # then let the kill reach the supervisor
+                self._backlog.insert(0, req)
+                raise
             except Exception as e:   # a bad request must not kill the loop
                 logger.exception("prefill failed for %s", req.uri)
                 self._finish_cb(req, [], "error", error=str(e))
+
+    def _alloc_pages(self, n: int) -> List[int]:
+        """``pool.alloc`` with the prefix cache as a pressure valve: a dry
+        pool first LRU-evicts cache-held-but-unreferenced entries (that HBM
+        is reclaimable, not occupied) before :class:`OutOfPages` ever
+        reaches a stream."""
+        try:
+            return self.pool.alloc(n)
+        except OutOfPages:
+            if self.prefix_cache is None:
+                raise
+            freed = self.prefix_cache.reclaim_pages(n)
+            if not freed:
+                raise
+            _GEN_PREFIX_EVICTED.inc(freed)
+            _events.emit("gen.prefix.evicted", severity="info",
+                         reason="pool_pressure", pages=freed)
+            return self.pool.alloc(n)
+
+    def _note_pool_peak(self) -> None:
+        used = self.pool.capacity - self.pool.free_count()
+        if used > self.peak_pages_in_use:
+            self.peak_pages_in_use = used
 
     def _prefill_into_slot(self, req: _Request):
         slot_idx = self._slots.index(None)
         cfg = self.cfg
         n_prompt = int(req.prompt.size)
         n_pg = -(-n_prompt // cfg.page_size)
-        pages = self.pool.alloc(n_pg)            # raises OutOfPages
-        bucket = min(max(_next_pow2(n_prompt), cfg.page_size),
-                     cfg.max_seq_len)
-        if bucket % cfg.page_size:
-            bucket = -(-bucket // cfg.page_size) * cfg.page_size
+        # shared-prefix lookup FIRST: matched blocks arrive as read-only
+        # pages (lookup already took this stream's pool references on them)
+        match = None
+        if self.prefix_cache is not None:
+            match = self.prefix_cache.lookup(req.prompt)
+            if match is None:
+                _GEN_PREFIX_MISSES.inc()
+            else:
+                _GEN_PREFIX_HITS.inc()
+        keys: List[str] = [] if match is None else match.keys
+        row: List[int] = [] if match is None else list(match.pages)
+        held: List[int] = list(row)     # pages this stream holds refs on
+        start = 0 if match is None else match.n_tokens
         try:
+            if match is not None and start >= n_prompt:
+                # the WHOLE (block-aligned) prompt is cached, but sampling
+                # token 0 still needs the last position's logits — recompute
+                # just that token, copy-on-writing the boundary page so its
+                # K/V write never lands in a shared page
+                start = n_prompt - 1
+                bp = start // cfg.page_size
+                (cow,) = self._alloc_pages(1)
+                held.append(cow)
+                self.cache = self._copy_page(
+                    self.cache, np.int32(row[bp]), np.int32(cow))
+                self.pool.release([row[bp]])
+                held.remove(row[bp])
+                row[bp] = cow
+            if len(row) < n_pg:
+                fresh = self._alloc_pages(n_pg - len(row))
+                row.extend(fresh)
+                held.extend(fresh)
+            self._note_pool_peak()
+            n_suffix = n_prompt - start
+            bucket = min(max(_next_pow2(n_suffix), cfg.page_size),
+                         cfg.max_seq_len)
+            if bucket % cfg.page_size:
+                bucket = -(-bucket // cfg.page_size) * cfg.page_size
+            if start:
+                # refcount-aliasing write isolation: every page the suffix
+                # dispatch can write must be exclusively this stream's
+                from ..analysis.rules.decode import lint_prefix_write_isolation
+
+                findings = lint_prefix_write_isolation(
+                    self.pool, row, start, page_size=cfg.page_size)
+                if findings:
+                    raise RuntimeError(
+                        "prefix-share write isolation violated: "
+                        + "; ".join(f.message for f in findings))
             with _tm.span("serving.gen.prefill", remote=req.ctx, uri=req.uri,
-                          bucket=bucket):
+                          bucket=bucket, cached_tokens=start):
                 ids = np.zeros((1, bucket), np.int32)
-                ids[0, :n_prompt] = req.prompt
+                ids[0, :n_suffix] = req.prompt[start:]
                 table = np.full((1, cfg.pages_per_slot), SCRATCH_PAGE,
                                 np.int32)
-                table[0, :n_pg] = pages
-                logits, self.cache = self._prefill(
-                    self.params, self.cache, ids,
-                    np.array([n_prompt], np.int32), table)
+                table[0, :len(row)] = row
+                if start:
+                    logits, self.cache = self._prefill_from(
+                        self.params, self.cache, ids,
+                        np.array([start], np.int32),
+                        np.array([n_prompt], np.int32), table)
+                else:
+                    logits, self.cache = self._prefill(
+                        self.params, self.cache, ids,
+                        np.array([n_prompt], np.int32), table)
                 first = self._sample(
                     logits, np.array([req.seed], np.uint32),
                     np.array([0], np.uint32),
                     np.array([req.temperature], np.float32))
                 tok = int(np.asarray(first)[0])
+            if self.prefix_cache is not None:
+                # deterministic fault site: the chaos drill kills the loop
+                # HERE — after compute, before publish. The handler below
+                # releases every reference this stream took; publish itself
+                # is all-or-nothing under the cache lock, so a respawn can
+                # never observe a torn chain
+                chaos_point("prefix.publish")
+                self.prefix_cache.publish(req.prompt, n_prompt, row)
+                sweep = self.prefix_cache.evict_to_budget()
+                if sweep["pages"]:
+                    _GEN_PREFIX_EVICTED.inc(sweep["pages"])
+                    _events.emit("gen.prefix.evicted", severity="info",
+                                 reason="budget", entries=sweep["entries"],
+                                 pages=sweep["pages"],
+                                 held_pages=sweep["held_pages"])
         except BaseException:
-            # a failed prefill must hand its pages back — repeated failures
-            # would otherwise drain the pool permanently
-            self.pool.release(pages)
+            # a failed prefill must hand back EVERYTHING it acquired —
+            # shared-page references included — or repeated failures would
+            # drain the pool permanently
+            if keys and self.prefix_cache is not None:
+                self.prefix_cache.release_stream(keys)
+            self.pool.release(held)
             raise
         self.prefill_buckets.add(bucket)
-        _GEN_TOKENS.labels(phase="prefill").inc(n_prompt)
-        slot = _Slot(req, n_prompt, tok, list(pages),
-                     history=req.prompt.tolist() + [tok])
+        _GEN_TOKENS.labels(phase="prefill").inc(n_suffix)
+        if start:
+            req.cached_prefix_tokens = start
+            self.prefix_tokens_saved += start
+            _GEN_PREFIX_TOKENS_SAVED.inc(start)
+        slot = _Slot(req, n_prompt, tok, list(row),
+                     history=req.prompt.tolist() + [tok],
+                     prefix_keys=keys)
         if self.spec_k >= 2:
             from ..ops.speculative import propose_kgram
 
@@ -732,7 +911,7 @@ class ContinuousBatcher:
                 slot.history, self.spec_k - 1, self.spec_ngram)
         with self._lock:
             self._table[slot_idx, :] = SCRATCH_PAGE
-            self._table[slot_idx, :n_pg] = pages
+            self._table[slot_idx, :n_pg] = row
             self._slots[slot_idx] = slot
         self._emit(slot, [tok])
         self._maybe_finish(slot_idx)
@@ -776,6 +955,15 @@ class ContinuousBatcher:
                 # proposals drafted under the OLD target die with it; the
                 # k-gram corpus (history) is model-independent and survives
                 slot.pending_drafts = None
+        if self.prefix_cache is not None:
+            # published K/V was computed under the OLD weights — one atomic
+            # invalidate between steps. In-flight warm streams keep their
+            # own page references and stay token-exact; only the index dies
+            dropped = self.prefix_cache.invalidate()
+            if dropped:
+                _events.emit("gen.prefix.invalidated", severity="info",
+                             reason="hot_swap", pages=dropped,
+                             version=str(version))
         self.swaps += 1
         _GEN_SWAPS.inc()
         logger.info("generation batcher swapped to version=%s spec_k=%d",
@@ -815,13 +1003,14 @@ class ContinuousBatcher:
                 p = slot.length // cfg.page_size
                 if self._table[i, p] == SCRATCH_PAGE:
                     try:
-                        (pg,) = self.pool.alloc(1)
+                        (pg,) = self._alloc_pages(1)
                     except OutOfPages:
                         finishes.append(self._retire_locked(
                             i, "truncated", error="kv page pool exhausted"))
                         continue
                     self._table[i, p] = pg
                     slot.pages.append(pg)
+                    self._note_pool_peak()
                 ids[i] = slot.last_token
                 lengths[i] = slot.length
                 seeds[i] = slot.request.seed
@@ -912,13 +1101,14 @@ class ContinuousBatcher:
                     if self._table[i, p] != SCRATCH_PAGE:
                         continue
                     try:
-                        (pg,) = self.pool.alloc(1)
+                        (pg,) = self._alloc_pages(1)
                     except OutOfPages:
                         tail.append(i)
                         dry = True
                         break
                     self._table[i, p] = pg
                     slot.pages.append(pg)
+                    self._note_pool_peak()
                 if dry:
                     continue
                 drafts = slot.pending_drafts
@@ -1048,8 +1238,14 @@ class ContinuousBatcher:
         slot = self._slots[slot_idx]
         self._slots[slot_idx] = None
         self._table[slot_idx, :] = SCRATCH_PAGE
+        # refcounted release: exclusively-owned pages return to the free
+        # list; shared prefix pages just drop this stream's reference (the
+        # cache and/or sibling streams keep them alive)
         self.pool.release(slot.pages)
         slot.pages = []
+        if slot.prefix_keys and self.prefix_cache is not None:
+            self.prefix_cache.release_stream(slot.prefix_keys)
+            slot.prefix_keys = []
         return (slot.request, [], outcome, error, slot.generated)
 
     def _finish_cb(self, req: _Request, tokens: List[int], outcome: str,
@@ -1216,7 +1412,14 @@ class ContinuousBatcher:
             if self._occupied_slot_steps else 0.0,
             "model_version": self.version,
             "swaps": self.swaps,
+            # high-water mark of allocated (non-free) pool pages — the
+            # sublinearity evidence for prefix sharing in the bench
+            "peak_pages_in_use": self.peak_pages_in_use,
         }
+        if self.prefix_cache is not None:
+            out["prefix"] = dict(self.prefix_cache.stats(),
+                                 tokens_saved=self.prefix_tokens_saved,
+                                 shared_pages=self.pool.shared_count())
         if self.spec_k >= 2 or self.spec_steps:
             out["spec"] = {
                 "k": self.spec_k,
@@ -1279,6 +1482,9 @@ class GenerationEngine:
                 n_pages=cfg.gen_pages or None, top_k=cfg.gen_top_k,
                 spec_k=getattr(cfg, "gen_spec_k", 0),
                 spec_ngram=getattr(cfg, "gen_spec_ngram", 3),
+                prefix_cache_pages=getattr(cfg, "gen_prefix_cache_pages", 0),
+                prefix_block_tokens=getattr(cfg, "gen_prefix_block_tokens",
+                                            0),
                 hbm_budget_bytes=int(budget_mb * 2 ** 20) if budget_mb
                 else None,
                 graph_checks=None, autostart=False)
@@ -1338,9 +1544,18 @@ class GenerationEngine:
     def _source_loop(self):
         conn = self._connect("gen.source")
         hb = self.registry.register("serving.gen.source")
+        stats_pub = 0.0
         try:
             while not self._stop.is_set():
                 hb.beat()
+                now = time.time()
+                if now - stats_pub >= 1.0:
+                    stats_pub = now
+                    try:
+                        conn.call("HSET", GEN_STATS_PREFIX + self.group,
+                                  dict(self.stats(), ts=now))
+                    except RetryAbortedError:
+                        break
                 try:
                     entries = conn.call("XREADGROUP", self.stream, self.group,
                                         8, 200)
@@ -1566,4 +1781,5 @@ class GenerationClient:
 
 
 __all__ = ["ContinuousBatcher", "GenerationClient", "GenerationEngine",
-           "GEN_OUT_PREFIX", "GEN_STREAM", "StreamHandle"]
+           "GEN_OUT_PREFIX", "GEN_STATS_PREFIX", "GEN_STREAM",
+           "StreamHandle"]

@@ -8,6 +8,14 @@
 # publish — and the overload/QoS drills marked `overload` — per-tier
 # deadline shedding, bulk-slot preemption, kill-during-autoscale-scale-up)
 # on the CPU backend with a hard wall-clock cap, independently of tier-1.
+# The ISSUE-17 shared-prefix drills ride the `prefix` marker
+# (tests/test_prefix_cache.py — compile-heavy, so kept out of the
+# wall-clock-capped tier-1): warm/cold bit-identity with spec decode and
+# through a hot-swap, refcount conservation under a random stream
+# workload, and the kill-mid-publish chaos drill — the decode loop is
+# killed between a publishing stream's prefill and its cache publish; the
+# respawn must re-admit the stream, publish an intact (never torn) chain,
+# leak zero pages.
 #
 #   scripts/run_chaos_suite.sh            # chaos + fleet + hotswap markers
 #   scripts/run_chaos_suite.sh -k broker  # usual pytest filters pass through
@@ -44,7 +52,8 @@ echo "[chaos-suite] memory witness: $MEM_WITNESS" >&2
 timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
     ZOO_TPU_TRACE_LOCKS=1 ZOO_TPU_LOCK_WITNESS="$WITNESS" \
     ZOO_TPU_MEM_WITNESS="$MEM_WITNESS" \
-    python -m pytest tests -q -m "chaos or fleet or hotswap or overload" \
+    python -m pytest tests -q \
+    -m "chaos or fleet or hotswap or overload or prefix" \
     -p no:cacheprovider "$@"
 
 # gates: witnessed ∪ static lock-order graph must be cycle-free (and leaf

@@ -1,0 +1,398 @@
+"""Shared-prefix KV cache tests (ISSUE 17): refcounted page pool
+conservation, content-hashed prefix chains, COW boundary-page semantics,
+LRU eviction, warm/cold bit-identity (every temperature, spec on/off,
+through a mid-stream hot-swap), the refcount-aliasing write-isolation lint,
+and the kill-mid-publish chaos drill. Pure-logic tests run in tier-1;
+the compile-heavy live-batcher drills are marked `slow` + `prefix` and ride
+`scripts/run_chaos_suite.sh` (tier-1 sits against a hard wall-clock cap).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from analytics_zoo_tpu.models.transformer import TransformerLM
+from analytics_zoo_tpu.ops.kv_cache import (KVCacheConfig, OutOfPages,
+                                            PagePool, PrefixCache,
+                                            prefix_block_key)
+from analytics_zoo_tpu.serving import ServingConfig
+from analytics_zoo_tpu.serving.generation import ContinuousBatcher
+
+pytestmark = pytest.mark.generation
+
+VOCAB, HIDDEN, BLOCKS, HEADS, SEQ = 64, 32, 2, 2, 256
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    m = TransformerLM(vocab=VOCAB, hidden_size=HIDDEN, n_block=BLOCKS,
+                      n_head=HEADS, seq_len=SEQ)
+    params, _ = m.build(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _mk(model_and_params, **kw):
+    m, params = model_and_params
+    kw.setdefault("n_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_seq_len", 128)
+    return ContinuousBatcher(m, params, **kw)
+
+
+def _pool(n_slots=2, pages_per_slot=4, page_size=4):
+    cfg = KVCacheConfig(n_layers=1, n_heads=1, head_dim=4, n_slots=n_slots,
+                        page_size=page_size, pages_per_slot=pages_per_slot)
+    return PagePool(cfg)
+
+
+# ------------------------------------------------------------- refcounting
+
+def test_pagepool_refcount_semantics():
+    pool = _pool()
+    (a, b) = pool.alloc(2)
+    assert pool.ref_count(a) == 1 and pool.ref_count(b) == 1
+    pool.incref([a])
+    assert pool.ref_count(a) == 2
+    assert pool.shared_count() == 1
+    free_before = pool.free_count()
+    pool.release([a])                       # decref: still held
+    assert pool.ref_count(a) == 1
+    assert pool.free_count() == free_before
+    pool.release([a])                       # last ref: reclaimed
+    assert pool.ref_count(a) == 0
+    assert pool.free_count() == free_before + 1
+    with pytest.raises(ValueError, match="double free"):
+        pool.release([a])
+    with pytest.raises(ValueError, match="use-after-free"):
+        pool.incref([a])
+    pool.release([b])
+    pool.check_conservation()
+    assert pool.free_count() == pool.capacity
+
+
+def test_pagepool_conservation_property():
+    """Random alloc/incref/release sequences: every page stays exactly one
+    of free or held, partitions sum to capacity, and a referenced page is
+    never reclaimed (its ref_count never hits 0 while a holder remains)."""
+    rng = np.random.default_rng(17)
+    pool = _pool(n_slots=4, pages_per_slot=4)
+    holders = []                           # one entry per outstanding ref
+    for _ in range(600):
+        op = rng.integers(0, 3)
+        if op == 0:
+            n = int(rng.integers(1, 4))
+            try:
+                pages = pool.alloc(n)
+            except OutOfPages:
+                continue
+            holders.extend(pages)
+        elif op == 1 and holders:
+            p = holders[int(rng.integers(0, len(holders)))]
+            pool.incref([p])
+            holders.append(p)
+        elif op == 2 and holders:
+            i = int(rng.integers(0, len(holders)))
+            p = holders.pop(i)
+            pool.release([p])
+            # no reclaim of a still-referenced page
+            if p in holders:
+                assert pool.ref_count(p) == holders.count(p)
+        pool.check_conservation()
+        assert pool.free_count() + pool.held_count() == pool.capacity
+    pool.release(holders)
+    pool.check_conservation()
+    assert pool.free_count() == pool.capacity
+
+
+def test_prefix_cache_property_random_admit_retire_evict():
+    """The ISSUE-17 property drill at the cache level: random streams
+    lookup/publish/retire against a small pool with a tight cache budget
+    (constant evictions); refcount conservation holds after every op."""
+    rng = np.random.default_rng(23)
+    pool = _pool(n_slots=8, pages_per_slot=8, page_size=4)
+    cache = PrefixCache(pool, block_tokens=4, page_size=4, max_pages=10)
+    prefixes = [list(rng.integers(1, 50, size=12)) for _ in range(4)]
+    streams = []                    # (row_pages, keys)
+    for _ in range(250):
+        op = rng.integers(0, 3)
+        if op == 0 and len(streams) < 6:   # admit
+            prompt = (prefixes[int(rng.integers(0, 4))]
+                      + list(rng.integers(50, 60,
+                                          size=int(rng.integers(1, 5)))))
+            n_pg = -(-len(prompt) // 4)
+            match = cache.lookup(prompt)
+            row = list(match.pages) if match else []
+            keys = match.keys if match else []
+            try:
+                row += pool.alloc(n_pg - len(row))
+            except OutOfPages:
+                freed = cache.reclaim_pages(n_pg - len(row))
+                if keys:
+                    cache.release_stream(keys)
+                pool.release(row)
+                pool.check_conservation()
+                continue
+            cache.publish(np.asarray(prompt, np.int32), len(prompt), row)
+            cache.evict_to_budget()
+            streams.append((row, keys))
+        elif op == 1 and streams:          # retire
+            row, keys = streams.pop(int(rng.integers(0, len(streams))))
+            pool.release(row)
+            cache.release_stream(keys)
+        elif op == 2:                      # eviction sweep / invalidate
+            if rng.integers(0, 10) == 0:
+                cache.invalidate()
+            else:
+                cache.evict_to_budget()
+        pool.check_conservation()
+        # every cache-held page is genuinely allocated
+        assert cache.held_pages() <= pool.held_count()
+    for row, keys in streams:
+        pool.release(row)
+        cache.release_stream(keys)
+    cache.invalidate()
+    pool.check_conservation()
+    assert pool.free_count() == pool.capacity
+
+
+# -------------------------------------------------- chain hashing / lookup
+
+def test_prefix_chain_hash_no_positional_collision():
+    """Identical block tokens under different prefixes must key differently
+    (chain hash), and lookup is longest-prefix."""
+    pool = _pool(n_slots=4, pages_per_slot=8, page_size=4)
+    cache = PrefixCache(pool, block_tokens=4, page_size=4, max_pages=64)
+    blk = [9, 9, 9, 9]
+    a = prefix_block_key(None, np.asarray(blk, np.int32))
+    parent = prefix_block_key(None, np.asarray([1, 2, 3, 4], np.int32))
+    b = prefix_block_key(parent, np.asarray(blk, np.int32))
+    assert a != b
+
+    p1 = pool.alloc(2)
+    cache.publish(np.asarray([1, 2, 3, 4, 9, 9, 9, 9], np.int32), 8, p1)
+    assert cache.lookup([9, 9, 9, 9, 7]) is None        # root block differs
+    m = cache.lookup([1, 2, 3, 4, 9, 9, 9, 9, 7])
+    assert m is not None and m.n_tokens == 8 and m.pages == [int(x) for x
+                                                             in p1]
+    cache.release_stream(m.keys)
+    pool.release(m.pages)
+    m2 = cache.lookup([1, 2, 3, 4, 5, 5, 5, 5, 7])      # only first block
+    assert m2 is not None and m2.n_tokens == 4
+    cache.release_stream(m2.keys)
+    pool.release(m2.pages)
+    cache.invalidate()
+    pool.release(p1)
+    pool.check_conservation()
+
+
+def test_prefix_cache_lru_eviction_and_active_pin():
+    pool = _pool(n_slots=4, pages_per_slot=8, page_size=4)
+    cache = PrefixCache(pool, block_tokens=4, page_size=4, max_pages=2)
+    rows = [pool.alloc(1) for _ in range(3)]
+    for i, row in enumerate(rows):
+        cache.publish(np.asarray([i, i, i, i], np.int32), 4, row)
+    assert cache.held_pages() == 3
+    # entry 0 is stream-active: the sweep must skip it even though it is LRU
+    m = cache.lookup([0, 0, 0, 0, 7])
+    assert m is not None
+    sweep = cache.evict_to_budget()
+    assert cache.held_pages() <= 2 and sweep["pages"] >= 1
+    m2 = cache.lookup([0, 0, 0, 0, 7])   # pinned survivor still matchable
+    assert m2 is not None
+    for match in (m, m2):                # each lookup took its own refs
+        cache.release_stream(match.keys)
+        pool.release(match.pages)
+    cache.invalidate()
+    for row in rows:
+        pool.release(row)
+    pool.check_conservation()
+    assert pool.free_count() == pool.capacity
+
+
+def test_prefix_write_isolation_lint_polarity():
+    from analytics_zoo_tpu.analysis.rules.decode import \
+        lint_prefix_write_isolation
+
+    pool = _pool(n_slots=2, pages_per_slot=4, page_size=4)
+    shared = pool.alloc(1)
+    pool.incref(shared)                     # simulated second holder
+    own = pool.alloc(1)
+    # clean: shared page is read-only (below start), written page exclusive
+    assert lint_prefix_write_isolation(pool, shared + own, 4,
+                                       page_size=4) == []
+    # violation: the suffix would write into the shared page
+    bad = lint_prefix_write_isolation(pool, shared + own, 0, page_size=4)
+    assert len(bad) == 1 and bad[0].rule == "prefix-share-isolation"
+    assert bad[0].severity == "error"
+    pool.release(shared + shared + own)
+    pool.check_conservation()
+
+
+# -------------------------------------------------------- serving-config
+
+def test_serving_config_prefix_yaml_and_typo_rejection(tmp_path):
+    good = tmp_path / "good.yaml"
+    good.write_text("generation:\n  slots: 2\n  prefix_cache_pages: 24\n"
+                    "  prefix_block_tokens: 32\n")
+    cfg = ServingConfig.from_yaml(str(good))
+    assert cfg.gen_prefix_cache_pages == 24
+    assert cfg.gen_prefix_block_tokens == 32
+
+    typo = tmp_path / "typo.yaml"
+    typo.write_text("generation:\n  prefix_cache_page: 24\n")
+    with pytest.raises(ValueError, match="unknown generation key"):
+        ServingConfig.from_yaml(str(typo))
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("generation:\n  page_size: 16\n  prefix_block_tokens: 9\n")
+    with pytest.raises(ValueError, match="prefix_block_tokens"):
+        ServingConfig.from_yaml(str(bad))
+
+
+# ------------------------------------------------------------ bit identity
+
+PREFIX = list(range(1, 41))     # 40 tokens, page-aligned at page_size=8
+
+
+@pytest.mark.slow
+@pytest.mark.prefix
+@pytest.mark.parametrize("spec_k", [0, 3])
+def test_warm_streams_bit_identical_to_cold(model_and_params, spec_k):
+    """A warm-prefix stream's tokens are identical to its cold run: both
+    temperatures (greedy + sampled, against ONE shared batcher pair — the
+    executables are what's expensive, not the streams), spec decode on and
+    off, including the full-prompt COW case (page-aligned prompt == a
+    published chain)."""
+    cold = _mk(model_and_params, spec_k=spec_k)
+    warm = _mk(model_and_params, spec_k=spec_k, prefix_cache_pages=32)
+    try:
+        prompts = [PREFIX + [50 + u, 51 + u] for u in range(3)]
+        prompts.append(PREFIX)              # block-aligned: COW boundary
+        for temperature in (0.0, 0.8):
+            cold_out = [cold.generate(p, max_new_tokens=8,
+                                      temperature=temperature, seed=11 + i)
+                        for i, p in enumerate(prompts)]
+            warm_out = [warm.generate(p, max_new_tokens=8,
+                                      temperature=temperature, seed=11 + i)
+                        for i, p in enumerate(prompts)]
+            assert cold_out == warm_out
+        st = warm.stats()["prefix"]
+        # pass 1: 3 hits + 1 publishing miss; pass 2: all 4 prompts hit
+        assert st["hits"] >= 7 and st["tokens_saved"] >= 6 * len(PREFIX)
+    finally:
+        cold.close()
+        warm.close()
+    warm.pool.check_conservation()
+    assert warm.pool.free_count() == warm.pool.capacity
+
+
+@pytest.mark.slow
+@pytest.mark.prefix
+def test_warm_stream_token_exact_through_hot_swap(model_and_params):
+    """A version hot-swap mid-stream invalidates the prefix cache
+    atomically; the in-flight warm stream stays token-exact (same weights
+    republished under a new version ⇒ swap timing cannot matter), and
+    post-swap warm hits rebuild from fresh publishes."""
+    m, params = model_and_params
+    warm = _mk(model_and_params, prefix_cache_pages=32)
+    try:
+        baseline = warm.generate(PREFIX + [55], max_new_tokens=16,
+                                 temperature=0.8, seed=9)
+        assert warm.prefix_cache.stats()["entries"] > 0
+        h = warm.submit(PREFIX + [55], max_new_tokens=16, temperature=0.8,
+                        seed=9)
+        got = []
+        it = h.tokens(timeout_s=60)
+        got.extend(next(it))                # stream is live
+        warm.swap_params(params, version="v2")   # same weights, new version
+        for chunk in it:
+            got.extend(chunk)
+        assert got == baseline              # token-exact through the swap
+        deadline = time.time() + 5
+        while warm.swaps == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert warm.swaps == 1 and warm.version == "v2"
+        assert warm.prefix_cache.stats()["entries"] == 0   # invalidated
+        # post-swap: republish + warm hit, still exact
+        again = warm.generate(PREFIX + [55], max_new_tokens=16,
+                              temperature=0.8, seed=9)
+        assert again == baseline
+        assert warm.prefix_cache.stats()["entries"] > 0
+    finally:
+        warm.close()
+    warm.pool.check_conservation()
+    assert warm.pool.free_count() == warm.pool.capacity
+
+
+@pytest.mark.slow
+@pytest.mark.prefix
+def test_batcher_random_workload_refcount_conservation(model_and_params):
+    """End-to-end property drill: concurrent warm/cold/preempting streams
+    over a small pool + tight cache budget; after the dust settles the pool
+    sums to capacity minus cache-held pages and conservation holds."""
+    rng = np.random.default_rng(5)
+    b = _mk(model_and_params, n_slots=2, prefix_cache_pages=8,
+            prefix_block_tokens=8)
+    try:
+        handles = []
+        for i in range(12):
+            pre = PREFIX[:16] if rng.integers(0, 2) else PREFIX[:24]
+            prompt = pre + list(rng.integers(50, 60,
+                                             size=int(rng.integers(1, 4))))
+            handles.append(b.submit(
+                prompt, max_new_tokens=int(rng.integers(2, 8)),
+                temperature=float(rng.choice([0.0, 0.7])), seed=i,
+                priority=str(rng.choice(["critical", "normal", "bulk"]))))
+        for h in handles:
+            h.result(timeout_s=120)
+        b.pool.check_conservation()
+        held = b.prefix_cache.held_pages()
+        assert held <= 8                      # budget respected
+        assert b.pool.free_count() == b.pool.capacity - held
+        assert b.prefix_cache.reclaimable_pages() == held
+    finally:
+        b.close()
+    b.pool.check_conservation()
+    assert b.pool.free_count() == b.pool.capacity
+
+
+# ------------------------------------------------------------ chaos drill
+
+@pytest.mark.slow
+@pytest.mark.prefix
+@pytest.mark.chaos
+def test_chaos_kill_mid_prefill_no_torn_publish_no_leak(model_and_params):
+    """Kill the decode loop between a publishing stream's prefill compute
+    and its cache publish (``prefix.publish`` site): the respawned loop
+    re-admits the request (re-queued at the backlog head), the stream
+    completes with its full token count, the cache holds no torn chain, and
+    zero pages leak."""
+    from analytics_zoo_tpu.common.chaos import ChaosSchedule
+
+    sched = ChaosSchedule(seed=3).kill("prefix.publish", at=1)
+    with sched:
+        b = _mk(model_and_params, prefix_cache_pages=32)
+        try:
+            out = b.generate(PREFIX + [55], max_new_tokens=6,
+                             temperature=0.0, seed=1, timeout_s=120)
+            assert len(out) == 6
+            assert sched.occurrences("prefix.publish") >= 1
+            assert b.loop_respawns >= 1
+            # the retry published an intact chain: every entry's pages are
+            # live allocations and the chain is re-matchable end to end
+            st = b.prefix_cache.stats()
+            assert st["entries"] == 5        # 40 prefix tokens / 8 per page
+            m = b.prefix_cache.lookup(PREFIX + [99])
+            assert m is not None and m.n_tokens == 40
+            b.prefix_cache.release_stream(m.keys)
+            b.pool.release(m.pages)
+            b.pool.check_conservation()
+            held = b.prefix_cache.held_pages()
+            assert b.pool.free_count() == b.pool.capacity - held
+        finally:
+            b.close()
+    b.pool.check_conservation()
+    assert b.pool.free_count() == b.pool.capacity
