@@ -144,6 +144,16 @@ class ChaosSchedule:
         with self._lock:
             return self._counts.get((site, tag), 0)
 
+    def counts(self) -> List[Dict[str, Any]]:
+        """Every chaos site this schedule has fired, with occurrence counts
+        — the flight recorder folds this into its dump so a postmortem shows
+        which faults were injected before the artifact was cut."""
+        with self._lock:
+            items = sorted(self._counts.items(),
+                           key=lambda kv: (kv[0][0], str(kv[0][1])))
+        return [{"site": site, "tag": tag, "fired": n}
+                for (site, tag), n in items]
+
     # -- pickling: counters/lock are process-local ---------------------------
     def __getstate__(self):
         return {"seed": self.seed, "_rules": self._rules}

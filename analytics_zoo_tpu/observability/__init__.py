@@ -20,6 +20,13 @@ pieces, composable and individually importable:
 * :mod:`.traces` — spans rendered as Chrome/Perfetto trace-event JSON, with
   tail-based retention in the recorder (errored + slowest-k traces kept
   whole) and OpenMetrics exemplars linking histogram buckets to trace ids.
+* :mod:`.recorder` — the always-on flight recorder: a bounded ring of
+  control-input records behind every consequential serving decision,
+  dumped with events/traces/SLO verdicts/metric windows as ONE versioned
+  artifact on fault, fast burn, chaos kill, or operator request.
+* :mod:`.replay` — deterministic decision replay of a flight recording
+  under a virtual clock against the incumbent or a candidate policy;
+  incumbent replay reproduces the recorded decisions exactly.
 
 :class:`ObservabilityPlane` bundles history + SLO engine for the serving
 stack; :class:`~.debug.DebugSurface` serves it all at ``/debug``.
@@ -29,18 +36,23 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-from . import events, history, slo, traces
+from . import events, history, recorder, replay, slo, traces
 from .debug import DebugSurface
 from .events import attach_broker, attach_jsonl, emit, reset_events
 from .history import DEFAULT_RESOLUTIONS, MetricsHistory
+from .recorder import FlightRecorder
+from .replay import (IncumbentPolicy, VirtualClock,
+                     WatermarkAdmissionPolicy, verify_incumbent)
 from .slo import Objective, SLOEngine, parse_objectives
 from .traces import export_trace, trace_summaries
 
 __all__ = [
-    "DebugSurface", "MetricsHistory", "Objective", "ObservabilityPlane",
-    "SLOEngine", "DEFAULT_RESOLUTIONS", "attach_broker", "attach_jsonl",
-    "emit", "events", "export_trace", "history", "parse_objectives",
-    "reset_events", "slo", "trace_summaries", "traces",
+    "DebugSurface", "FlightRecorder", "IncumbentPolicy", "MetricsHistory",
+    "Objective", "ObservabilityPlane", "SLOEngine", "VirtualClock",
+    "WatermarkAdmissionPolicy", "DEFAULT_RESOLUTIONS", "attach_broker",
+    "attach_jsonl", "emit", "events", "export_trace", "history",
+    "parse_objectives", "recorder", "replay", "reset_events", "slo",
+    "trace_summaries", "traces", "verify_incumbent",
 ]
 
 

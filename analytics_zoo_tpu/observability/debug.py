@@ -15,6 +15,9 @@ Routes (all GET):
     /debug/traces/<id>   one trace as Chrome/Perfetto trace-event JSON
                          (Content-Disposition: attachment — drop the file
                          onto ui.perfetto.dev)
+    /debug/flight        complete flight-recorder dump as JSON
+                         (Content-Disposition: attachment — feed it to
+                         `cli postmortem` or the replay harness)
 """
 
 from __future__ import annotations
@@ -105,6 +108,20 @@ class DebugSurface:
                 return self._json({"traces":
                                    _traces.interesting_traces(
                                        int(query.get("n", "20")))})
+            if route == "/debug/flight":
+                from . import recorder as _flight
+                rec = _flight.get()
+                if rec is None:
+                    return self._json(
+                        {"error": "flight recorder not installed "
+                                  "(the serving stack installs it; see "
+                                  "docs/observability.md)"}, code=503)
+                snap = rec.snapshot(trigger="debug")
+                code, ctype, body, _hdr = self._json(snap)
+                stamp = int(snap["created"])
+                return code, ctype, body, {
+                    "Content-Disposition":
+                        f'attachment; filename="flight-{stamp}.json"'}
             if route.startswith("/debug/traces/"):
                 tid = route[len("/debug/traces/"):]
                 trace = _traces.export_trace(tid)
@@ -193,6 +210,7 @@ class DebugSurface:
                     f'<a href="/debug/slo">slo</a> · '
                     f'<a href="/debug/events">events</a> · '
                     f'<a href="/debug/traces">traces</a> · '
+                    f'<a href="/debug/flight">flight</a> · '
                     f'<a href="/metrics">metrics</a></p>')
 
         # SLO table

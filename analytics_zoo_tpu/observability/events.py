@@ -202,6 +202,16 @@ class EventLog:
         if start is not None:
             start.start()
 
+    def remove_sink(self, fn: Callable[[Event], None]) -> None:
+        """Detach ONE sink (the flight recorder uninstalls its dump trigger
+        this way without disturbing jsonl/broker sinks). Unknown fns are
+        ignored; the drain thread stays up — it is harmless idle."""
+        with self._lock:
+            try:
+                self._sinks.remove(fn)
+            except ValueError:
+                pass
+
     def detach_sinks(self) -> None:
         with self._lock:
             sinks, self._sinks = self._sinks, []

@@ -29,6 +29,14 @@ the frontend's ``/debug`` ops surface over HTTP.
     python -m ... cli events     --port 6380 [--kind autoscale] [--count 50]
     python -m ... cli slo-status --http 127.0.0.1:8080
     python -m ... cli trace      --http 127.0.0.1:8080 --trace <id> --out t.json
+    python -m ... cli dump       --http 127.0.0.1:8080 --out flight.json
+    python -m ... cli postmortem flight.json
+
+``dump`` pulls the flight recorder's black-box artifact off a LIVE stack
+(``/debug/flight``); ``postmortem`` pretty-prints any flight dump offline —
+including one a crashed process left behind (signal/atexit hook) or one a
+chaos kill auto-cut — as a timeline of decision events with SLO verdicts,
+chaos firings, and the trace each decision pins.
 
 ``info`` prints the broker's data-plane gauges (wire protocol version,
 per-stream depths, bytes on wire by frame kind, shm attachment) as JSON —
@@ -398,6 +406,100 @@ def do_trace(args) -> int:
     return 0
 
 
+def do_dump(args) -> int:
+    """Pull a complete flight-recorder dump from the frontend's
+    ``/debug/flight`` and write it to disk — the black-box artifact for a
+    live stack, on operator request."""
+    import time
+
+    try:
+        payload = _http_get(args.http, "/debug/flight", timeout=15.0)
+    except Exception as e:
+        print(f"frontend on {args.http} unreachable or no flight recorder "
+              f"installed: {e}", file=sys.stderr)
+        return 3
+    if payload.get("schema") != "zoo-flight-v1":
+        print(f"unexpected flight payload: {payload.get('error', payload)}",
+              file=sys.stderr)
+        return 1
+    out = args.out or f"flight-{int(time.time())}.json"
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+    print(f"wrote flight dump to {out} ({payload.get('records_held', 0)} "
+          f"control records, {len(payload.get('events') or [])} events, "
+          f"trigger={payload.get('trigger')})")
+    return 0
+
+
+def do_postmortem(args) -> int:
+    """Pretty-print a flight dump offline: header, SLO verdicts, chaos
+    firings, decision-record summary, and a merged timeline of the decision
+    events with the trace each one pins (marked when the dump carries the
+    full trace export)."""
+    if not args.target:
+        print("postmortem needs a dump file: cli postmortem <dump.json>",
+              file=sys.stderr)
+        return 2
+    try:
+        with open(args.target, encoding="utf-8") as f:
+            dump = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot load {args.target}: {e}", file=sys.stderr)
+        return 1
+    if not isinstance(dump, dict) or dump.get("schema") != "zoo-flight-v1":
+        print(f"{args.target} is not a zoo-flight-v1 dump", file=sys.stderr)
+        return 1
+    import time
+
+    created = float(dump.get("created", 0.0))
+    print(f"flight dump {args.target}")
+    print(f"  schema   {dump['schema']}   trigger {dump.get('trigger')}")
+    print(f"  cut      {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(created))}"
+          f"   host {dump.get('host')}   pid {dump.get('pid')}")
+    print(f"  records  {dump.get('records_held', 0)} held / "
+          f"{dump.get('records_total', 0)} total "
+          f"({dump.get('records_dropped', 0)} overwritten)")
+    slo = dump.get("slo")
+    if isinstance(slo, dict) and slo.get("objectives"):
+        print("SLO verdicts:")
+        for o in slo["objectives"]:
+            print(f"  {o.get('name'):<28} {o.get('state'):<9} "
+                  f"burn fast {o.get('burn_fast')} / slow "
+                  f"{o.get('burn_slow')}  fired {o.get('fired_count')}x")
+    chaos = dump.get("chaos") or []
+    if chaos:
+        print("chaos firings:")
+        for c in chaos:
+            print(f"  {c.get('site')}[{c.get('tag')}] x{c.get('fired')}")
+    sites = {}
+    for r in dump.get("records") or []:
+        d = r.get("decision") or {}
+        key = (r.get("site"), d.get("action"))
+        sites[key] = sites.get(key, 0) + 1
+    if sites:
+        print("decision records:")
+        for (site, action), n in sorted(sites.items(),
+                                        key=lambda kv: str(kv[0])):
+            print(f"  {site:<24} {str(action):<10} x{n}")
+    events = dump.get("events") or []
+    traces = dump.get("traces") or {}
+    if events:
+        t0 = float(events[0].get("ts", created))
+        print(f"timeline ({len(events)} events):")
+        for e in events:
+            tid = e.get("trace_id")
+            pin = ""
+            if tid:
+                pin = (f"  [trace {tid[:12]}"
+                       + (", exported]" if tid in traces else "]"))
+            fields = {k: v for k, v in (e.get("fields") or {}).items()}
+            print(f"  +{float(e.get('ts', t0)) - t0:8.3f}s "
+                  f"{e.get('severity', 'info'):<8} {e.get('kind'):<22} "
+                  f"{json.dumps(fields, sort_keys=True, default=str)}{pin}")
+    print(f"exported traces: {len(traces)}")
+    return 0
+
+
 def do_rolling_restart(args) -> int:
     """Ask the fleet supervisor for a rolling restart: each replica is
     drained, restarted and readmitted in turn — N-1 replicas keep serving
@@ -424,7 +526,10 @@ def main(argv=None) -> int:
                     choices=["start", "stop", "restart", "status", "info",
                              "fleet-status", "hosts", "drain",
                              "rolling-restart", "events", "slo-status",
-                             "trace"])
+                             "trace", "dump", "postmortem"])
+    ap.add_argument("target", nargs="?", default=None,
+                    help="`postmortem`: path to a flight dump JSON "
+                         "(from `cli dump`, /debug/flight, or a crash)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6380)
     ap.add_argument("--aof", default=None,
@@ -446,14 +551,17 @@ def main(argv=None) -> int:
                          "/debug/events or `cli events`)")
     ap.add_argument("--out", default=None,
                     help="`trace`: write the Perfetto-loadable JSON here "
-                         "instead of stdout")
+                         "instead of stdout; `dump`: the flight dump path "
+                         "(default flight-<ts>.json)")
     args = ap.parse_args(argv)
     return {"start": do_start, "stop": do_stop, "restart": do_restart,
             "status": do_status, "info": do_info,
             "fleet-status": do_fleet_status, "hosts": do_hosts,
             "drain": do_drain,
             "rolling-restart": do_rolling_restart, "events": do_events,
-            "slo-status": do_slo_status, "trace": do_trace}[args.action](args)
+            "slo-status": do_slo_status, "trace": do_trace,
+            "dump": do_dump,
+            "postmortem": do_postmortem}[args.action](args)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -83,6 +83,7 @@ from ..common.locks import traced_lock
 from ..common.resilience import (CircuitBreaker, HealthRegistry,
                                  RetryAbortedError, RetryPolicy)
 from ..observability import events as _ev
+from ..observability import recorder as _flight
 from . import qos as _qos
 from . import slo_metrics as _slo_metrics
 from .client import INPUT_STREAM, RESULT_PREFIX, _Conn
@@ -573,9 +574,28 @@ class ReplicaRouter:
         if dl is None:
             return False
         est, svc, total, eligible = self._wait_estimate()
+        rec = _flight.get()
         # skew_s loosens the verdict by the fleet's measured cross-host
-        # clock uncertainty: the deadline was stamped on the CLIENT's clock
-        if not _qos.cannot_meet(dl, est, svc, skew_tolerance_s=self.skew_s):
+        # clock uncertainty: the deadline was stamped on the CLIENT's clock.
+        # With no recorder installed the admit case (the per-wave hot path —
+        # each held entry is re-judged every claim wave) answers on the bare
+        # predicate; the shed path and any recorded decision go through the
+        # full pure function, so live and replay semantics stay identical
+        # (cannot_meet is monotone in `now`: an admit here is an admit there)
+        if rec is None and not _qos.cannot_meet(
+                dl, est, svc, skew_tolerance_s=self.skew_s):
+            return False
+        pri = payload_priority(payload)
+        inputs = {"now": time.time(), "deadline": dl, "est_wait_s": est,
+                  "service_ema_s": svc, "skew_tolerance_s": self.skew_s,
+                  "depth": total, "concurrency": max(1, eligible),
+                  "eligible": eligible, "priority": pri}
+        decision = _qos.admission_decision(inputs)
+        if rec is not None:
+            # admits are recorded too: a candidate policy replayed offline
+            # may shed what the incumbent admitted — the diff needs both
+            rec.record("admission.router", inputs, decision)
+        if decision["action"] != "shed":
             return False
         chaos_point("overload.shed", tag="router")
         uri = payload.get("uri") if isinstance(payload, dict) else None
@@ -583,17 +603,15 @@ class ReplicaRouter:
             conn.call("HSETNX", RESULT_PREFIX + uri, _qos.shed_payload(
                 "deadline cannot be met at the routing tier "
                 f"(est wait {est + svc:.3f}s)",
-                _qos.retry_after_s(total, svc, max(1, eligible)),
-                reason="deadline"))
+                decision["retry_after_s"], reason="deadline"))
         self.shed += 1
-        pri = payload_priority(payload)
         _ROUTER_SHED.labels(reason="deadline").inc()
         _REQ_OUTCOMES.labels(priority=pri, outcome="shed").inc()
         # audit-rate, not request-rate: under sustained overload this fires
         # per request, so repeats within the window fold into `suppressed`
         _ev.emit("shed.router", severity="warning", throttle_s=1.0,
                  reason="deadline", priority=pri,
-                 est_wait_s=round(est + svc, 4), eligible=eligible)
+                 est_wait_s=decision["est_wait_s"], eligible=eligible)
         return True
 
     def _note_dispatched(self, rid: str) -> None:
@@ -864,9 +882,13 @@ class FleetSupervisor:
         # XTRANSFER — zero-loss by construction) when idle down to
         # min_replicas
         self.autoscale_enabled = bool(getattr(config, "autoscale", False))
-        self._as_pressure_since: Optional[float] = None
-        self._as_idle_since: Optional[float] = None
-        self._as_last_event_t = 0.0
+        # debounce memory owned by the PURE decision function
+        # (qos.autoscale_decision) — the flight recorder snapshots it into
+        # every autoscale.tick record, which is what makes the recorded
+        # decision stream exactly replayable offline
+        self._as_state: Dict[str, Any] = {"pressure_since": None,
+                                          "idle_since": None,
+                                          "last_event_t": 0.0}
         self._as_last_routed = 0
         self._as_last_shed = 0
         self._as_busy = False          # a scale-down drain is in flight
@@ -1343,6 +1365,17 @@ class FleetSupervisor:
         slot = self._hosts[hid]
         t0 = time.perf_counter()
         rids = sorted(slot.replicas)
+        # black-box the control inputs behind the verdict: how stale the
+        # heartbeat was (on OUR clock, after skew translation) vs the budget
+        now_w = time.time()
+        _flight.record(
+            "fleet.host_check",
+            {"now": now_w, "host": hid,
+             "hb_age_s": round(now_w - slot.last_hb_wall, 4),
+             "timeout_s": self.config.fleet_failover_timeout_s,
+             "clock_offset_s": round(slot.clock_offset_s, 6),
+             "replicas": rids},
+            {"action": "failover", "replicas": rids})
         with _tm.span("fleet.host_failover", host=host_identity(),
                       failed_host=hid, replicas=len(rids)) as sp:
             # fail fast from now on: dials/routes to this host short-circuit
@@ -1498,38 +1531,31 @@ class FleetSupervisor:
                 or self._stop.is_set():
             return
         cfg = self.config
-        now = time.monotonic()
-        n = len(self._handles)
-        eligible = len(self.router.eligible_ids())
-        owed = self._owed_work()
-        if owed is None:
-            self._as_idle_since = None
-            return
-        total_owed = owed
         shed_delta = self.router.shed - self._as_last_shed
         self._as_last_shed = self.router.shed
         routed_delta = self.router.routed - self._as_last_routed
         self._as_last_routed = self.router.routed
-        load = (total_owed + 2.0 * shed_delta) / max(1, eligible)
-        if load > cfg.autoscale_up_depth:
-            if self._as_pressure_since is None:
-                self._as_pressure_since = now
-        else:
-            self._as_pressure_since = None
-        if total_owed == 0 and routed_delta == 0 and shed_delta == 0:
-            if self._as_idle_since is None:
-                self._as_idle_since = now
-        else:
-            self._as_idle_since = None
-        if now - self._as_last_event_t < cfg.autoscale_cooldown_s:
-            return
-        if (self._as_pressure_since is not None
-                and now - self._as_pressure_since >= cfg.autoscale_sustain_s
-                and n < cfg.max_replicas):
+        obs = {"now": time.monotonic(),
+               "n": len(self._handles),
+               "eligible": len(self.router.eligible_ids()),
+               "owed": self._owed_work(),
+               "shed_delta": shed_delta,
+               "routed_delta": routed_delta,
+               "up_depth": cfg.autoscale_up_depth,
+               "sustain_s": cfg.autoscale_sustain_s,
+               "idle_s": cfg.autoscale_idle_s,
+               "cooldown_s": cfg.autoscale_cooldown_s,
+               "min_replicas": cfg.min_replicas,
+               "max_replicas": cfg.max_replicas}
+        # the pre-decision debounce snapshot rides in the record, so every
+        # recorded tick replays as a pure function of its own inputs
+        state_before = dict(self._as_state)
+        decision = _qos.autoscale_decision(obs, self._as_state)
+        _flight.record("autoscale.tick", {**obs, "state": state_before},
+                       decision)
+        if decision["action"] == "up":
             self._scale_up()
-        elif (self._as_idle_since is not None
-                and now - self._as_idle_since >= cfg.autoscale_idle_s
-                and n > cfg.min_replicas):
+        elif decision["action"] == "down":
             self._scale_down()
 
     def _scale_up(self) -> None:
@@ -1541,8 +1567,6 @@ class FleetSupervisor:
         scope = "host" if self._host_mode else "replica"
         with _tm.span("fleet.autoscale", direction="up", replica=rid) as sp:
             self._spawn_replica(rid)
-            self._as_last_event_t = time.monotonic()
-            self._as_pressure_since = None
             self.scale_events.append(("up", len(self._handles)))
             _AUTOSCALE.labels(direction="up", scope=scope).inc()
             extra = {}
@@ -1573,8 +1597,6 @@ class FleetSupervisor:
         handle = self._handles[rid]
         handle.restarting = True     # monitor hands off this lifecycle
         self._as_busy = True
-        self._as_last_event_t = time.monotonic()
-        self._as_idle_since = None
         chaos_point("autoscale.scale", tag="down")
 
         def run():
@@ -1638,8 +1660,6 @@ class FleetSupervisor:
             h.restarting = True      # monitor hands off these lifecycles
         victim.retiring = True
         self._as_busy = True
-        self._as_last_event_t = time.monotonic()
-        self._as_idle_since = None
         chaos_point("autoscale.scale", tag="down")
 
         def run():

@@ -45,6 +45,7 @@ from ..common.chaos import WorkerKilled, chaos_point
 from ..common.locks import traced_lock
 from ..common.resilience import HealthRegistry, RetryAbortedError, RetryPolicy
 from ..observability import events as _events
+from ..observability import recorder as _flight
 from ..ops.kv_cache import (OutOfPages, PagePool, PrefixCache, SCRATCH_PAGE,
                             copy_page)
 from . import qos as _qos
@@ -628,14 +629,30 @@ class ContinuousBatcher:
             if req.cancelled:
                 self._finish_cb(req, [], "cancelled")
                 continue
-            if _qos.cannot_meet(req.deadline, 0.0, ema, now=now):
+            rec = _flight.get()
+            # no recorder (the common case): bare predicate on the admit
+            # hot path — every backlog entry is re-judged each decode step.
+            # Recorded decisions go through the full pure function so live
+            # and replay stay identical; the predicates agree by definition
+            if rec is None and not _qos.cannot_meet(req.deadline, 0.0, ema,
+                                                    now=now):
+                keep.append(req)
+                continue
+            inputs = {"now": now, "deadline": req.deadline,
+                      "est_wait_s": 0.0, "service_ema_s": ema,
+                      "depth": len(self._backlog),
+                      "concurrency": self.n_slots,
+                      "priority": req.priority}
+            decision = _qos.admission_decision(inputs)
+            if rec is not None:
+                rec.record("admission.generation", inputs, decision)
+            if decision["action"] == "shed":
                 chaos_point("overload.shed", tag="generation")
                 _GEN_SHED.labels(reason="deadline").inc()
                 self._finish_cb(
                     req, [], "shed",
                     error="deadline cannot be met by the decode loop",
-                    retry_after_s=_qos.retry_after_s(
-                        len(self._backlog), ema, self.n_slots))
+                    retry_after_s=decision["retry_after_s"])
                 continue
             keep.append(req)
         self._backlog = keep

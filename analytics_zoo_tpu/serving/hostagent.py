@@ -52,6 +52,7 @@ from typing import Any, Callable, Dict, Optional
 
 from ..common.chaos import chaos_point
 from ..common.resilience import RetryAbortedError, RetryPolicy
+from ..observability import recorder as _flight
 from .client import _Conn
 from .config import ServingConfig
 from .engine import ClusterServing
@@ -173,6 +174,10 @@ class HostAgent:
             want = {str(r): g for r, g in desired.items()}
         else:
             want = {str(r): None for r in desired}
+        running_before = sorted(self._engines)
+        removed: list = []
+        spawned: list = []
+        refused: list = []
         for rid in list(self._engines):
             gen = want.get(rid)
             if rid in want and (gen is None or gen == self._gens.get(rid)):
@@ -188,6 +193,7 @@ class HostAgent:
             except Exception:
                 logger.exception("hostagent %s: stop of %s failed",
                                  self.hid, rid)
+            removed.append(rid)
             logger.info("hostagent %s: removed replica %s%s", self.hid, rid,
                         " (generation bump)" if rid in want else "")
         for rid, gen in want.items():
@@ -196,9 +202,21 @@ class HostAgent:
             if len(self._engines) >= self.capacity:
                 logger.warning("hostagent %s: at capacity (%d), refusing "
                                "replica %s", self.hid, self.capacity, rid)
+                refused.append(rid)
                 continue
             self._spawn(rid)
             self._gens[rid] = gen
+            spawned.append(rid)
+        if removed or spawned or refused:
+            # reconcile runs every heartbeat round — only CHANGES are flight
+            # records (a converged no-op would flood the ring with noise)
+            _flight.record(
+                "host.reconcile",
+                {"now": time.time(), "host": self.hid,
+                 "desired": sorted(want), "running": running_before,
+                 "capacity": self.capacity},
+                {"action": "reconcile", "spawn": spawned,
+                 "remove": removed, "refused": refused})
 
     def _spawn(self, rid: str):
         model = self.model_factory() if self.model_factory else None

@@ -195,8 +195,110 @@ def retry_after_s(queue_depth: int, service_ema_s: float,
                estimated_wait_s(queue_depth, service_ema_s, concurrency))
 
 
+# -- pure decision functions (shared by live sites and offline replay) -------
+#
+# Every consequential serving decision routes through ONE of these pure
+# functions: the live tier builds an observation dict, calls the function,
+# records (inputs, decision) on the flight recorder
+# (observability/recorder.py), then ACTS on the decision. Offline replay
+# (observability/replay.py) re-runs the same function over the recorded
+# inputs — determinism is by construction, not by careful reimplementation.
+# Neither function may read clocks, randomness, or globals: everything the
+# verdict depends on must arrive in the inputs.
+
+def admission_decision(inputs: Dict[str, Any]) -> Dict[str, Any]:
+    """One admission verdict (router hold-queue or decode-loop backlog).
+
+    ``inputs``: ``now`` (epoch s), ``deadline`` (epoch s or None),
+    ``est_wait_s`` (queue wait ahead of this request), ``service_ema_s``,
+    ``skew_tolerance_s``, ``depth`` (backlog the Retry-After is computed
+    over), ``concurrency`` (parallel servers draining it). Extra keys
+    (priority, eligible, site context) are ignored — recorded inputs may
+    carry more than the verdict needs.
+
+    Returns ``{"action": "admit"|"shed", "reason", "retry_after_s",
+    "est_wait_s"}`` — deterministic, timestamp-free, directly comparable
+    across replay runs.
+    """
+    est = max(0.0, float(inputs.get("est_wait_s", 0.0)))
+    svc = max(0.0, float(inputs.get("service_ema_s", 0.0)))
+    if cannot_meet(inputs.get("deadline"), est, svc,
+                   now=float(inputs["now"]),
+                   skew_tolerance_s=float(
+                       inputs.get("skew_tolerance_s", 0.0))):
+        return {"action": "shed", "reason": "deadline",
+                "retry_after_s": round(
+                    retry_after_s(int(inputs.get("depth", 0)), svc,
+                                  max(1, int(inputs.get("concurrency", 1)))),
+                    4),
+                "est_wait_s": round(est + svc, 4)}
+    return {"action": "admit", "reason": None, "retry_after_s": None,
+            "est_wait_s": round(est + svc, 4)}
+
+
+def autoscale_decision(obs: Dict[str, Any],
+                       state: Dict[str, Any]) -> Dict[str, Any]:
+    """One autoscaler evaluation: owed work per eligible replica (shed
+    traffic counting double — demand the fleet failed to serve), debounced
+    both directions and cooldown rate-limited.
+
+    ``obs``: ``now`` (monotonic s), ``n`` (replicas), ``eligible``, ``owed``
+    (broker-measured backlog; ``None`` = broker unreachable this poll),
+    ``shed_delta``/``routed_delta`` (router counter deltas since the last
+    tick), plus the config knobs ``up_depth``, ``sustain_s``, ``idle_s``,
+    ``cooldown_s``, ``min_replicas``, ``max_replicas``.
+
+    ``state`` is the debounce memory ``{"pressure_since", "idle_since",
+    "last_event_t"}`` — mutated IN PLACE, and only here, so the live
+    autoscaler and an offline replay evolve it identically. The flight
+    recorder snapshots the pre-call state into each record, which makes
+    every tick independently replayable even after ring truncation.
+
+    Returns ``{"action": "up"|"down"|"hold", "reason", "load"}``.
+    """
+    now = float(obs["now"])
+    owed = obs.get("owed")
+    if owed is None:
+        state["idle_since"] = None
+        return {"action": "hold", "reason": "broker_unreachable",
+                "load": None}
+    owed = int(owed)
+    shed_delta = int(obs.get("shed_delta", 0))
+    load = ((owed + 2.0 * shed_delta)
+            / max(1, int(obs.get("eligible", 0))))
+    load = round(load, 4)
+    if load > float(obs["up_depth"]):
+        if state.get("pressure_since") is None:
+            state["pressure_since"] = now
+    else:
+        state["pressure_since"] = None
+    if owed == 0 and int(obs.get("routed_delta", 0)) == 0 \
+            and shed_delta == 0:
+        if state.get("idle_since") is None:
+            state["idle_since"] = now
+    else:
+        state["idle_since"] = None
+    if now - float(state.get("last_event_t", 0.0)) < float(obs["cooldown_s"]):
+        return {"action": "hold", "reason": "cooldown", "load": load}
+    n = int(obs["n"])
+    if (state.get("pressure_since") is not None
+            and now - state["pressure_since"] >= float(obs["sustain_s"])
+            and n < int(obs["max_replicas"])):
+        state["last_event_t"] = now
+        state["pressure_since"] = None
+        return {"action": "up", "reason": "pressure", "load": load}
+    if (state.get("idle_since") is not None
+            and now - state["idle_since"] >= float(obs["idle_s"])
+            and n > int(obs["min_replicas"])):
+        state["last_event_t"] = now
+        state["idle_since"] = None
+        return {"action": "down", "reason": "idle", "load": load}
+    return {"action": "hold", "reason": "steady", "load": load}
+
+
 __all__ = ["DEFAULT_PRIORITY", "MIN_RETRY_AFTER_S", "PRIORITIES",
-           "PRIORITY_RANK", "ServiceTimeEMA", "ShedError", "cannot_meet",
+           "PRIORITY_RANK", "ServiceTimeEMA", "ShedError",
+           "admission_decision", "autoscale_decision", "cannot_meet",
            "deadline_from_ms", "estimated_wait_s", "normalize_deadline",
            "normalize_priority", "order_key", "priority_rank",
            "retry_after_s", "shed_error_from_payload", "shed_payload"]

@@ -22,6 +22,7 @@ from ..common import telemetry as _tm
 from ..common.resilience import HealthRegistry
 from ..observability import ObservabilityPlane
 from ..observability import events as _events
+from ..observability import recorder as _recorder
 from .broker import start_broker
 from .config import ServingConfig
 from .engine import ClusterServing
@@ -148,6 +149,12 @@ def main(argv=None) -> int:
                          "(autoscale, failover, rollout, breaker, shed, "
                          "chaos, slo) to this JSONL file; events also ride "
                          "the broker `events` stream for `cli events`")
+    ap.add_argument("--flight-dir", default=None,
+                    help="directory for flight-recorder dumps (default "
+                         "$ZOO_FLIGHT_DIR or the system temp dir); the "
+                         "recorder is always on — dumps are cut on "
+                         "SIGTERM/atexit, fast-burn SLO pages, chaos "
+                         "kills, `cli dump`, and GET /debug/flight")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     if args.no_shm:
@@ -232,6 +239,11 @@ def main(argv=None) -> int:
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
+    # black box: always-on flight recorder. Installed AFTER the stop
+    # handlers so its chained SIGTERM handler dumps FIRST, then triggers
+    # the graceful shutdown above; atexit covers plain exits
+    _recorder.install(dump_dir=args.flight_dir, plane=plane,
+                      signals=(signal.SIGTERM,))
     threading.Thread(target=app.serve, daemon=True,
                      name="zoo-http-frontend").start()
     if args.metrics_jsonl:

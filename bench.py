@@ -2339,6 +2339,147 @@ def run_overload_bench(quick: bool = False) -> dict:
 
 
 # --------------------------------------------------------------------------
+# flight-recorder replay bench (ISSUE 18): record an overload trace with the
+# always-on flight recorder, then score two admission policies OFFLINE on
+# the identical input stream — with the determinism gate that the incumbent
+# replay reproduces the live decision sequence exactly
+# --------------------------------------------------------------------------
+
+def run_replay_bench(quick: bool = False) -> dict:
+    """Replay-bench artifact (REPLAY_BENCH.json): bulk flood at ~2.2x fleet
+    capacity with the flight recorder installed, dump the trace, then (a)
+    verify the incumbent policy replays it bit-exactly, (b) replay a
+    candidate watermark policy twice (must be deterministic) and diff it
+    against the incumbent on the same recorded inputs."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from analytics_zoo_tpu.observability import recorder as _flight
+    from analytics_zoo_tpu.observability import replay as _replay
+    from analytics_zoo_tpu.serving import (FleetSupervisor, InputQueue,
+                                           OutputQueue, ServingConfig,
+                                           ShedError, start_broker)
+
+    n_replicas = 2
+    service_s = 0.04
+    duration_s = 2.0 if quick else 5.0
+    bulk_deadline_ms = 400.0
+    capacity = n_replicas * FLEET_BATCH / service_s
+    bulk_rate = 2.2 * capacity
+    dump_dir = tempfile.mkdtemp(prefix="zoo-flight-bench-")
+    rec = _flight.install(dump_dir=dump_dir, capacity=65536, signals=())
+    broker = start_broker()
+    stop = threading.Event()
+    uris: list = []
+    uris_lock = threading.Lock()
+    dump_path = None
+    try:
+        cfg = ServingConfig(queue_port=broker.port, batch_size=FLEET_BATCH,
+                            batch_timeout_ms=2, replicas=n_replicas,
+                            fleet_heartbeat_s=0.1,
+                            fleet_failover_timeout_s=1.5,
+                            fleet_spawn_grace_s=10.0)
+        fleet = FleetSupervisor(
+            cfg, model_factory=lambda: _fleet_stub_model(service_s))
+        fleet.start()
+        try:
+            assert fleet.wait_eligible(n_replicas, timeout_s=15), \
+                fleet.router.stats()
+
+            def flood(idx: int, n_threads: int):
+                iq = InputQueue(port=broker.port)
+                interval = n_threads / bulk_rate
+                next_t = time.monotonic() + idx * interval / n_threads
+                try:
+                    while not stop.is_set():
+                        now = time.monotonic()
+                        if now < next_t:
+                            time.sleep(min(0.005, next_t - now))
+                            continue
+                        next_t += interval
+                        u = iq.enqueue(None, priority="bulk",
+                                       deadline_ms=bulk_deadline_ms,
+                                       input=np.full((4,), 1.0,
+                                                     np.float32))
+                        with uris_lock:
+                            uris.append(u)
+                finally:
+                    iq.close()
+
+            n_threads = 4
+            threads = [threading.Thread(target=flood, args=(i, n_threads),
+                                        daemon=True)
+                       for i in range(n_threads)]
+            for t in threads:
+                t.start()
+            time.sleep(duration_s)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            served = shed = unanswered = 0
+            oq = OutputQueue(port=broker.port)
+            try:
+                for u in uris:
+                    try:
+                        oq.query(u, timeout_s=30)
+                        served += 1
+                    except ShedError:
+                        shed += 1
+                    except Exception:
+                        unanswered += 1
+            finally:
+                oq.close()
+        finally:
+            stop.set()
+            fleet.stop(drain_s=2.0)
+        dump_path = rec.dump(trigger="bench")
+    finally:
+        _flight.uninstall()
+        broker.shutdown()
+
+    records = _replay.load_records(dump_path)
+    admission_records = [r for r in records
+                         if r["site"].startswith("admission.")]
+    # gate 1: the incumbent replays the recorded trace bit-exactly
+    verify = _replay.verify_incumbent(records)
+    incumbent = _replay.replay(records, _replay.IncumbentPolicy())
+    # gate 2: a candidate policy is deterministic across replays of the
+    # same recording (same virtual clock, same inputs -> same signature)
+    cand_a = _replay.replay(
+        records, _replay.WatermarkAdmissionPolicy(watermark_s=0.05))
+    cand_b = _replay.replay(
+        records, _replay.WatermarkAdmissionPolicy(watermark_s=0.05))
+    deterministic = cand_a.signature() == cand_b.signature()
+    divergences = _replay.diff_runs(incumbent, cand_a)
+    out = {
+        "metric": "offline policy bench on a recorded overload trace "
+                  "(incumbent exact-replay + candidate watermark diff)",
+        "service_time_ms": service_s * 1e3,
+        "batch_size": FLEET_BATCH,
+        "capacity_req_per_s": round(capacity, 1),
+        "offered_over_capacity": 2.2,
+        "duration_s": duration_s,
+        "live": {"offered": len(uris), "served": served, "shed": shed,
+                 "unanswered": unanswered},
+        "dump": {"path": dump_path, "records": len(records),
+                 "admission_records": len(admission_records)},
+        "incumbent_exact": verify["exact"],
+        "incumbent_divergences": verify["divergences"],
+        "candidate_deterministic": deterministic,
+        "policy_divergences": len(divergences),
+        "scores": {
+            "incumbent": _replay.score_admission(incumbent),
+            "candidate": _replay.score_admission(cand_a),
+        },
+        "value": len(divergences),
+        "unit": "decision divergences (incumbent vs watermark candidate)",
+    }
+    return out
+
+
+# --------------------------------------------------------------------------
 # model hot-swap bench (ISSUE 10): trainer→fleet checkpoint streaming with
 # canary rollout, sustained load through consecutive swaps + chaos
 # --------------------------------------------------------------------------
@@ -2898,6 +3039,48 @@ if __name__ == "__main__":
               f"{bulk['retry_after_s']['max']}s; autoscale 1->"
               f"{asc['replica_peak']}->1 over {asc['requests']} requests, "
               f"0 lost, 0 duplicated", file=sys.stderr)
+        sys.exit(0)
+    if "--replay" in sys.argv:
+        # flight-recorder replay bench (ISSUE 18): record an overload trace,
+        # then score two admission policies offline on the same recording.
+        # THE determinism gate: the incumbent policy replayed against the
+        # recorded control inputs must reproduce the live decision sequence
+        # exactly (kinds, order, fields — timestamps excluded).
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        quick = "--quick" in sys.argv
+        rb = run_replay_bench(quick=quick)
+        if not quick:
+            # quick is the CI gate and never touches the committed artifact
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "REPLAY_BENCH.json"), "w") as f:
+                json.dump(rb, f, indent=1)
+        print(json.dumps(rb))
+        # gates (quick AND full)
+        assert rb["dump"]["admission_records"] >= 50, (
+            f"overload trace too thin to bench policies on: "
+            f"{rb['dump']['admission_records']} admission records")
+        assert rb["incumbent_exact"], (
+            f"incumbent replay DIVERGED from the recorded decision "
+            f"sequence: {rb['incumbent_divergences'][:3]}")
+        assert rb["candidate_deterministic"], (
+            "candidate policy produced different decisions across two "
+            "replays of the same recording")
+        assert rb["policy_divergences"] >= 1, (
+            "watermark candidate never disagreed with the incumbent on an "
+            "overload trace — the diff harness is not discriminating")
+        sc = rb["scores"]
+        assert sc["candidate"]["shed"] >= sc["incumbent"]["shed"], (
+            f"tighter watermark shed LESS than the incumbent: {sc}")
+        assert sc["incumbent"]["considered"] == \
+            sc["candidate"]["considered"], sc
+        print(f"[bench] replay gate OK: {rb['dump']['records']} records "
+              f"({rb['dump']['admission_records']} admission), incumbent "
+              f"replay exact, candidate deterministic, "
+              f"{rb['policy_divergences']} divergences "
+              f"(incumbent shed {sc['incumbent']['shed']} vs candidate "
+              f"{sc['candidate']['shed']})", file=sys.stderr)
         sys.exit(0)
     if "--hotswap" in sys.argv:
         # model hot-swap drill (ISSUE 10): sustained load through >=3

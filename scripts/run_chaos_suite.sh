@@ -44,17 +44,53 @@ cd "$(dirname "$0")/.."
 TIMEOUT="${CHAOS_SUITE_TIMEOUT:-600}"
 WITNESS="${ZOO_TPU_LOCK_WITNESS:-$(mktemp -t zoo_lock_witness.XXXXXX.jsonl)}"
 MEM_WITNESS="${ZOO_TPU_MEM_WITNESS:-$(mktemp -t zoo_mem_witness.XXXXXX.jsonl)}"
+# Flight recorder (ISSUE 18): the kill drills install the flight recorder
+# with this dump dir; every SIGKILL-class drill must leave behind a
+# complete, loadable zoo-flight-v1 dump (checked below) — a crash that
+# produces no black box is itself a failure.
+FLIGHT_DIR="${ZOO_FLIGHT_DIR:-$(mktemp -d -t zoo_flight.XXXXXX)}"
 : > "$WITNESS"
 : > "$MEM_WITNESS"
 echo "[chaos-suite] lock witness: $WITNESS" >&2
 echo "[chaos-suite] memory witness: $MEM_WITNESS" >&2
+echo "[chaos-suite] flight dumps: $FLIGHT_DIR" >&2
 
 timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
     ZOO_TPU_TRACE_LOCKS=1 ZOO_TPU_LOCK_WITNESS="$WITNESS" \
     ZOO_TPU_MEM_WITNESS="$MEM_WITNESS" \
+    ZOO_FLIGHT_DIR="$FLIGHT_DIR" \
     python -m pytest tests -q \
     -m "chaos or fleet or hotswap or overload or prefix" \
     -p no:cacheprovider "$@"
+
+# gate: every kill drill must have produced a flight dump, and every dump
+# in the dir must load as a complete versioned artifact (schema + the
+# decision-record and event sections present) — missing or torn black
+# boxes fail the suite
+timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$FLIGHT_DIR" <<'EOF'
+import glob, json, sys
+
+flight_dir = sys.argv[1]
+paths = sorted(glob.glob(flight_dir + "/flight-*.json"))
+if not paths:
+    sys.exit(f"[chaos-suite] NO flight dumps in {flight_dir} — the kill "
+             f"drills ran without leaving a black box")
+bad = []
+for p in paths:
+    try:
+        with open(p) as f:
+            d = json.load(f)
+        if d.get("schema") != "zoo-flight-v1":
+            bad.append((p, f"schema={d.get('schema')!r}"))
+        elif not all(k in d for k in ("records", "events", "trigger")):
+            bad.append((p, f"missing sections, keys={sorted(d)}"))
+    except (OSError, ValueError) as e:
+        bad.append((p, repr(e)))
+if bad:
+    sys.exit(f"[chaos-suite] unloadable/incomplete flight dumps: {bad}")
+print(f"[chaos-suite] flight dumps OK: {len(paths)} complete "
+      f"zoo-flight-v1 artifacts")
+EOF
 
 # gates: witnessed ∪ static lock-order graph must be cycle-free (and leaf
 # declarations must hold against the witnessed edges); witnessed device

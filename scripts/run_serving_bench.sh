@@ -108,6 +108,14 @@ if [[ "${1:-}" == "--quick" ]]; then
     # fleet converged on the last good version, bounded p95 inflation
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
         python bench.py --hotswap --quick
+    # flight-recorder replay determinism gate (ISSUE 18): record an
+    # overload trace with the always-on flight recorder, then replay it —
+    # the incumbent policy must reproduce the live decision sequence
+    # EXACTLY (kinds, order, fields modulo timestamps), a candidate
+    # watermark policy must be deterministic across two replays of the
+    # same recording and must diverge from the incumbent on >= 1 decision
+    timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+        python bench.py --replay --quick
     # int8 kernel-tier structural gate (writes KERNEL_BENCH.json for the
     # CPU leg; the TPU run overwrites it with real ratios + MFU)
     exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \

@@ -16,10 +16,13 @@ import time
 import urllib.request
 import urllib.error
 
+import os
+
 import numpy as np
 import pytest
 
 from analytics_zoo_tpu.inference import InferenceModel
+from analytics_zoo_tpu.observability import recorder as _flight
 from analytics_zoo_tpu.serving import (ClusterServing, FleetSupervisor,
                                        InputQueue, OutputQueue, ReplicaRouter,
                                        ServingConfig, start_broker)
@@ -27,6 +30,29 @@ from analytics_zoo_tpu.serving.broker import _Store
 from analytics_zoo_tpu.serving.fleet import REPLICA_STREAM_PREFIX
 
 pytestmark = [pytest.mark.serving, pytest.mark.fleet]
+
+
+def _install_flight(tmp_path):
+    """Kill drills run under an installed flight recorder (like the real
+    stack): the failover event must auto-cut a complete dump. The chaos
+    suite points ZOO_FLIGHT_DIR at a shared dir it verifies afterwards."""
+    return _flight.install(
+        dump_dir=os.environ.get("ZOO_FLIGHT_DIR") or str(tmp_path))
+
+
+def _await_flight_dump(rec, timeout_s=10.0):
+    """Wait for the auto-cut dump a kill drill must produce, then load it
+    — missing or unloadable (torn) artifacts fail the drill."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline and rec.last_dump_path is None:
+        time.sleep(0.05)
+    assert rec.last_dump_path is not None, "kill drill auto-cut no dump"
+    with open(rec.last_dump_path) as f:
+        dump = json.load(f)
+    assert dump["schema"] == "zoo-flight-v1"
+    for section in ("records", "events", "metrics", "chaos"):
+        assert section in dump
+    return dump
 
 
 class StubModel(InferenceModel):
@@ -141,16 +167,18 @@ def test_router_least_pending_prefers_unloaded_replica():
 # ---------------------------------------------------------------------------
 
 @pytest.mark.chaos
-def test_kill_one_of_four_midburst_zero_loss(zoo_ctx):
+def test_kill_one_of_four_midburst_zero_loss(zoo_ctx, tmp_path):
     """The headline drill: 4 replicas under a burst, one hard-killed
     mid-run. Every submitted uri gets exactly one successful response (the
     dead replica's claimed work is claim-transferred back and re-served;
-    duplicate answers are dropped broker-side), and the fleet re-converges
-    to 4 eligible replicas."""
+    duplicate answers are dropped broker-side), the fleet re-converges to 4
+    eligible replicas, and the failover auto-cuts a complete, loadable
+    flight dump (the black-box postmortem artifact)."""
     from analytics_zoo_tpu.serving.broker import _DUP_DROPPED
 
     broker = start_broker()
     fleet = None
+    rec = _install_flight(tmp_path)
     try:
         cfg = _cfg(broker, replicas=4)
         fleet = FleetSupervisor(
@@ -179,19 +207,25 @@ def test_kill_one_of_four_midburst_zero_loss(zoo_ctx):
         assert fleet.respawns == 1
         assert fleet.wait_eligible(4, timeout_s=10), fleet.router.stats()
         assert _DUP_DROPPED.value() >= dups_before  # counted, never served
+        dump = _await_flight_dump(rec)
+        assert dump["trigger"] == "failover"
+        assert any(e["kind"] == "fleet.failover" for e in dump["events"])
     finally:
+        _flight.uninstall()
         if fleet is not None:
             fleet.stop(drain_s=2.0)
         broker.shutdown()
 
 
 @pytest.mark.chaos
-def test_kill_during_drain_requeues_without_respawn(zoo_ctx):
+def test_kill_during_drain_requeues_without_respawn(zoo_ctx, tmp_path):
     """A replica killed while draining: its unfinished claimed work is still
     requeued (zero loss), but the supervisor honors the drain decision and
-    does NOT bring it back."""
+    does NOT bring it back. The kill still auto-cuts a loadable flight
+    dump."""
     broker = start_broker()
     fleet = None
+    rec = _install_flight(tmp_path)
     try:
         cfg = _cfg(broker, replicas=2)
         fleet = FleetSupervisor(
@@ -214,7 +248,9 @@ def test_kill_during_drain_requeues_without_respawn(zoo_ctx):
         assert fleet.respawns == 0          # drained replicas stay down
         assert fleet.router.eligible_ids() == ["r1"]
         iq.close()
+        _await_flight_dump(rec)
     finally:
+        _flight.uninstall()
         if fleet is not None:
             fleet.stop(drain_s=2.0)
         broker.shutdown()

@@ -1,0 +1,422 @@
+"""Flight recorder + deterministic decision replay (ISSUE 18): the bounded
+control-record ring (overwrite/truncation accounting), dump-under-concurrent-
+emit (no deadlock, no torn artifact), the auto dump triggers (chaos kill /
+SLO fast burn, throttled), virtual-clock monotonicity, incumbent-replay
+exactness, candidate-policy divergence + the divergence counter, the
+/debug/flight endpoint and the `cli dump` / `cli postmortem` tooling."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from analytics_zoo_tpu.common import telemetry as tm
+from analytics_zoo_tpu.observability import events as ev
+from analytics_zoo_tpu.observability import recorder as flight
+from analytics_zoo_tpu.observability import replay as rp
+from analytics_zoo_tpu.observability.recorder import FlightRecorder
+from analytics_zoo_tpu.observability.replay import (IncumbentPolicy,
+                                                    VirtualClock,
+                                                    WatermarkAdmissionPolicy)
+from analytics_zoo_tpu.serving import qos
+
+pytestmark = pytest.mark.observability
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    flight.uninstall()
+    tm.reset_telemetry()
+    ev.reset_events()
+    yield
+    flight.uninstall()
+    ev.reset_events()
+    tm.reset_telemetry()
+
+
+def _admission_inputs(now=1000.0, deadline=None, est=0.0, svc=0.05,
+                      depth=3, concurrency=2, priority="bulk"):
+    return {"now": now, "deadline": deadline, "est_wait_s": est,
+            "service_ema_s": svc, "skew_tolerance_s": 0.0, "depth": depth,
+            "concurrency": concurrency, "priority": priority}
+
+
+def _record_admission(rec, mono, **kw):
+    """Record the way the live tap does: the pure function's own verdict."""
+    inputs = _admission_inputs(**kw)
+    decision = qos.admission_decision(inputs)
+    rec.record("admission.router", inputs, decision)
+    # pin deterministic replay ordering stamps onto the freshest record
+    with rec._lock:
+        rec._ring[-1]["mono"] = mono
+    return decision
+
+
+# ---------------------------------------------------------------------------
+# ring semantics: bounded overwrite + truncation accounting
+# ---------------------------------------------------------------------------
+
+def test_ring_overwrite_keeps_newest_and_counts_dropped():
+    rec = FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record("admission.router", {"i": i}, {"action": "admit"})
+    held, total = rec.occupancy()
+    assert (held, total) == (8, 20)
+    recs = rec.records()
+    # oldest-first, newest 8 survive, seq is the capture order
+    assert [r["seq"] for r in recs] == list(range(13, 21))
+    assert [r["inputs"]["i"] for r in recs] == list(range(12, 20))
+    snap = rec.snapshot(trigger="manual")
+    assert snap["records_held"] == 8
+    assert snap["records_total"] == 20
+    assert snap["records_dropped"] == 12
+    assert snap["schema"] == flight.FLIGHT_SCHEMA
+
+
+def test_record_is_torn_proof_and_site_filter_matches_families():
+    rec = FlightRecorder(capacity=16)
+    inputs = {"depth": 1}
+    rec.record("admission.router", inputs, {"action": "admit"})
+    inputs["depth"] = 99          # caller mutates after the fact
+    assert rec.records()[0]["inputs"]["depth"] == 1
+    rec.record("admission.generation", {}, {"action": "shed"})
+    rec.record("autoscale.tick", {}, {"action": "hold"})
+    assert len(rec.records("admission")) == 2
+    assert len(rec.records("autoscale.tick")) == 1
+    assert rec.records("admission.router")[0]["site"] == "admission.router"
+
+
+def test_ring_occupancy_rides_the_collector_metric():
+    rec = FlightRecorder(capacity=4)
+    for _ in range(6):
+        rec.record("admission.router", {}, {"action": "admit"})
+    snap = tm.snapshot()
+    assert snap["zoo_flight_ring_records"]["samples"][""] >= 4.0
+    del rec
+
+
+# ---------------------------------------------------------------------------
+# dump under concurrent emit: no deadlock, no torn artifact
+# ---------------------------------------------------------------------------
+
+def test_dump_under_concurrent_emit_never_blocks_or_tears(tmp_path):
+    rec = FlightRecorder(capacity=512, dump_dir=str(tmp_path))
+    ev.default_log().add_sink(rec._event_sink)   # the real wiring
+    stop = threading.Event()
+    errors = []
+
+    def hammer(idx):
+        i = 0
+        try:
+            while not stop.is_set():
+                i += 1
+                rec.record("admission.router",
+                           {"i": i, "thread": idx}, {"action": "admit"})
+                ev.emit("flight.test", thread=idx, i=i)
+        except Exception as e:          # pragma: no cover - the failure
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=hammer, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    paths = []
+    try:
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            paths.append(rec.dump(trigger="manual"))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not errors
+    assert not any(t.is_alive() for t in threads), \
+        "emitters wedged behind a dump"
+    assert len(paths) >= 2
+    for p in paths:
+        dump = json.load(open(p))     # every artifact complete + loadable
+        assert dump["schema"] == "zoo-flight-v1"
+        assert dump["records_held"] == len(dump["records"])
+    assert rec.dumps == len(paths)
+    # dumps counted per trigger on the metric family
+    assert flight._DUMPS.labels(trigger="manual").value() >= len(paths)
+    # no stray tmp files: every write was renamed into place
+    assert not [f for f in tmp_path.iterdir() if ".tmp." in f.name]
+
+
+# ---------------------------------------------------------------------------
+# auto triggers: chaos kill + slo fast burn, throttled
+# ---------------------------------------------------------------------------
+
+def _await_dump(rec, n=1, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if rec.dumps >= n:
+            return True
+        time.sleep(0.02)
+    return rec.dumps >= n
+
+
+def test_auto_dump_on_chaos_kill_and_throttle(tmp_path):
+    rec = flight.install(dump_dir=str(tmp_path),
+                         min_auto_dump_interval_s=60.0)
+    ev.emit("chaos.injected", severity="warning", site="engine.step",
+            action="kill")
+    assert _await_dump(rec, 1), "chaos kill did not cut a flight dump"
+    dump = json.load(open(rec.last_dump_path))
+    assert dump["trigger"] == "chaos_kill"
+    assert any(e["kind"] == "chaos.injected" for e in dump["events"])
+    # a kill storm inside the throttle window produces ONE artifact
+    for _ in range(5):
+        ev.emit("slo.firing", severity="error", name="bulk-availability")
+    ev.default_log().flush()
+    time.sleep(0.1)
+    assert rec.dumps == 1
+    # non-kill chaos actions never trigger
+    rec2 = flight.install(dump_dir=str(tmp_path),
+                          min_auto_dump_interval_s=0.0)
+    ev.emit("chaos.injected", severity="warning", site="engine.step",
+            action="delay")
+    ev.emit("checkpoint.saved", step=3)
+    ev.default_log().flush()
+    time.sleep(0.1)
+    assert rec2.dumps == 0
+    # slo fast burn triggers once the window reopens
+    ev.emit("slo.firing", severity="error", name="bulk-availability")
+    assert _await_dump(rec2, 1), "slo.firing did not cut a flight dump"
+    assert json.load(open(rec2.last_dump_path))["trigger"] == "slo_fast_burn"
+
+
+def test_uninstall_detaches_trigger_and_module_tap_noops(tmp_path):
+    rec = flight.install(dump_dir=str(tmp_path),
+                         min_auto_dump_interval_s=0.0)
+    flight.record("admission.router", {"depth": 1}, {"action": "admit"})
+    assert rec.occupancy() == (1, 1)
+    flight.uninstall()
+    assert flight.get() is None
+    flight.record("admission.router", {"depth": 2}, {"action": "admit"})
+    assert rec.occupancy() == (1, 1)      # tap no-ops with none installed
+    ev.emit("fleet.host_failed", severity="error", host="h9")
+    ev.default_log().flush()
+    time.sleep(0.1)
+    assert rec.dumps == 0                 # trigger sink detached
+
+
+# ---------------------------------------------------------------------------
+# virtual clock + replay ordering
+# ---------------------------------------------------------------------------
+
+def test_virtual_clock_is_monotonic_and_loud_on_corrupt_streams():
+    clock = VirtualClock(start=5.0)
+    assert clock.now == 5.0
+    clock.advance_to(5.0)                 # equal stamps are fine
+    clock.advance_to(7.25)
+    assert clock.now == 7.25 and clock.steps == 2
+    with pytest.raises(ValueError):
+        clock.advance_to(7.0)
+    assert clock.now == 7.25              # a refused step changes nothing
+
+
+def test_replay_sorts_records_and_steps_once_per_record():
+    rec = FlightRecorder(capacity=32)
+    for mono, est in ((30.0, 0.0), (10.0, 5.0), (20.0, 0.0)):
+        _record_admission(rec, mono, est=est, deadline=1000.2)
+    shuffled = rec.records()
+    clock = VirtualClock(start=0.0)
+    run = rp.replay(shuffled, IncumbentPolicy(), clock=clock)
+    assert clock.steps == 3
+    assert [d["vts"] for d in run.decisions] == [10.0, 20.0, 30.0]
+    # the est=5.0 record (recorded at mono 10) sheds; order follows stamps
+    assert [d["decision"]["action"] for d in run.decisions] \
+        == ["shed", "admit", "admit"]
+
+
+# ---------------------------------------------------------------------------
+# incumbent exactness + candidate divergence
+# ---------------------------------------------------------------------------
+
+def test_incumbent_replay_reproduces_recording_exactly():
+    rec = FlightRecorder(capacity=256)
+    mono = 0.0
+    # admission mix: no deadline, meetable deadline, hopeless deadline
+    for deadline, est in ((None, 0.3), (1000.4, 0.1), (1000.1, 0.5),
+                          (999.0, 0.0), (1002.0, 0.05)):
+        mono += 1.0
+        _record_admission(rec, mono, deadline=deadline, est=est)
+    # autoscale ticks recorded the way the live tap does: pre-call state
+    # snapshot embedded, state threaded across ticks
+    state = {"pressure_since": None, "idle_since": None, "last_event_t": 0.0}
+    knobs = {"eligible": 1, "up_depth": 4, "sustain_s": 1.0, "idle_s": 5.0,
+             "cooldown_s": 0.5, "min_replicas": 1, "max_replicas": 4,
+             "routed_delta": 0, "shed_delta": 0}
+    for t, owed, n in ((1.0, 8, 1), (2.5, 9, 1), (3.0, 9, 2),
+                       (3.2, None, 2), (9.5, 0, 2), (15.0, 0, 2)):
+        obs = {"now": t, "n": n, "owed": owed, **knobs}
+        before = dict(state)
+        decision = qos.autoscale_decision(obs, state)
+        rec.record("autoscale.tick", {**obs, "state": before}, decision)
+        with rec._lock:
+            rec._ring[-1]["mono"] = 100.0 + t
+    # pass-through context records replay unchanged (policy returns None)
+    rec.record("fleet.host_check",
+               {"now": 200.0, "host": "h0", "hb_age_s": 2.0,
+                "replicas": ["r0"]},
+               {"action": "failover", "replicas": ["r0"]})
+    verdict = rp.verify_incumbent(rec.records())
+    assert verdict["exact"], verdict["divergences"]
+    assert verdict["decisions"] == 12
+    # the recorded stream contains real ups/downs, not just holds
+    run = rp.replay(rec.records(), IncumbentPolicy())
+    counts = run.counts()
+    assert counts.get("autoscale.up", 0) >= 1
+    assert counts.get("fleet.host_failed") == 1
+    assert counts.get("shed.router", 0) >= 2
+
+
+def test_tampered_recording_fails_exactness_and_counts_divergence():
+    rec = FlightRecorder(capacity=32)
+    _record_admission(rec, 1.0, deadline=1000.4, est=0.1)
+    records = rec.records()
+    records[0]["decision"] = {"action": "shed", "reason": "deadline",
+                              "retry_after_s": 1.0, "est_wait_s": 0.15}
+    before = rp._DIVERGENCE.value()
+    verdict = rp.verify_incumbent(records)
+    assert not verdict["exact"]
+    assert verdict["divergences"][0]["site"] == "admission.router"
+    assert verdict["divergences"][0]["replayed"]["action"] == "admit"
+    assert rp._DIVERGENCE.value() == before + 1
+
+
+def test_candidate_policy_diverges_deterministically():
+    rec = FlightRecorder(capacity=64)
+    mono = 0.0
+    # deadline generous (incumbent admits) but est above the watermark:
+    # exactly the band where the two policies disagree
+    for est in (0.0, 0.1, 0.4, 0.6, 0.05):
+        mono += 1.0
+        _record_admission(rec, mono, deadline=1010.0, est=est)
+    # a protected-priority request above the watermark stays admitted
+    mono += 1.0
+    _record_admission(rec, mono, deadline=1010.0, est=0.9,
+                      priority="critical")
+    records = rec.records()
+    inc = rp.replay(records, IncumbentPolicy())
+    cand_a = rp.replay(records, WatermarkAdmissionPolicy(watermark_s=0.25))
+    cand_b = rp.replay(records, WatermarkAdmissionPolicy(watermark_s=0.25))
+    assert cand_a.signature() == cand_b.signature()   # deterministic
+    before = rp._DIVERGENCE.value()
+    div = rp.diff_runs(inc, cand_a)
+    # est+svc > 0.25 and not protected: 0.4 and 0.6 diverge, critical not
+    assert [d["seq"] for d in div] == [3, 4]
+    assert all(d["watermark"]["action"] == "shed" for d in div)
+    assert rp._DIVERGENCE.value() == before + len(div)
+    sa, sc = rp.score_admission(inc), rp.score_admission(cand_a)
+    assert sa["considered"] == sc["considered"] == 6
+    assert sc["shed"] == sa["shed"] + 2
+    assert sc["shed_by_priority"] == {"bulk": 2}
+    # replay never pollutes the process event log
+    assert ev.events(kind="shed") == []
+
+
+def test_load_records_refuses_unknown_schema(tmp_path):
+    rec = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+    rec.record("admission.router", {}, {"action": "admit"})
+    path = rec.dump(trigger="manual")
+    assert len(rp.load_records(path)) == 1
+    assert len(rp.load_records(json.load(open(path)))) == 1
+    assert rp.load_records([{"site": "x"}]) == [{"site": "x"}]
+    with pytest.raises(ValueError, match="schema"):
+        rp.load_records({"schema": "zoo-flight-v99", "records": []})
+    with pytest.raises(ValueError):
+        rp.load_records(42)
+
+
+def test_admission_decision_agrees_with_cannot_meet_grid():
+    for deadline in (None, 999.0, 1000.05, 1000.4, 1003.0):
+        for est in (0.0, 0.2, 1.0):
+            for skew in (0.0, 0.5):
+                inputs = _admission_inputs(deadline=deadline, est=est)
+                inputs["skew_tolerance_s"] = skew
+                d = qos.admission_decision(inputs)
+                expect = qos.cannot_meet(deadline, est, 0.05, now=1000.0,
+                                         skew_tolerance_s=skew)
+                assert (d["action"] == "shed") is expect, (inputs, d)
+                if d["action"] == "shed":
+                    assert d["retry_after_s"] >= qos.MIN_RETRY_AFTER_S
+                else:
+                    assert d["retry_after_s"] is None
+                assert d["est_wait_s"] == round(est + 0.05, 4)
+
+
+# ---------------------------------------------------------------------------
+# /debug/flight + cli dump / cli postmortem
+# ---------------------------------------------------------------------------
+
+def test_debug_flight_endpoint_and_cli_roundtrip(tmp_path, capsys):
+    from analytics_zoo_tpu.serving import FrontEndApp, ServingConfig
+    from analytics_zoo_tpu.serving.cli import main as cli_main
+
+    cfg = ServingConfig(slo_objectives=(
+        {"name": "avail", "type": "availability", "priority": "bulk",
+         "target": 0.9},), slo_fast_window_s=2.0, slo_slow_window_s=8.0)
+    from analytics_zoo_tpu.observability import ObservabilityPlane
+    plane = ObservabilityPlane.from_config(cfg)
+    app = FrontEndApp(cfg, port=0, plane=plane).start()
+    try:
+        # no recorder installed: the endpoint reports, never 500s
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/debug/flight", timeout=10)
+        assert ei.value.code == 503
+        rec = flight.install(dump_dir=str(tmp_path), plane=plane)
+        inputs = _admission_inputs(deadline=1000.1, est=0.5)
+        rec.record("admission.router", inputs,
+                   qos.admission_decision(inputs))
+        ev.emit("shed.router", severity="warning", reason="deadline",
+                priority="bulk")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/debug/flight", timeout=10) as r:
+            assert "attachment" in r.headers.get("Content-Disposition", "")
+            payload = json.loads(r.read())
+        assert payload["schema"] == "zoo-flight-v1"
+        assert payload["trigger"] == "debug"
+        assert payload["records"][0]["site"] == "admission.router"
+        assert payload["slo"]["objectives"][0]["name"] == "avail"
+        assert flight._DUMPS.labels(trigger="debug").value() == 1.0
+        # cli dump pulls the same artifact over HTTP
+        dest = str(tmp_path / "pulled.json")
+        rc = cli_main(["dump", "--http", f"127.0.0.1:{app.port}",
+                       "--out", dest])
+        assert rc == 0
+        saved = json.load(open(dest))
+        assert saved["schema"] == "zoo-flight-v1"
+        capsys.readouterr()
+        # cli postmortem pretty-prints it offline
+        rc = cli_main(["postmortem", dest])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "zoo-flight-v1" in out
+        assert "admission.router" in out and "shed" in out
+        assert "shed.router" in out          # the event timeline
+    finally:
+        app.stop()
+    # unreachable frontend: distinct exit code, no traceback
+    assert cli_main(["dump", "--http", "127.0.0.1:9", "--out",
+                     str(tmp_path / "no.json")]) == 3
+
+
+def test_cli_postmortem_rejects_garbage(tmp_path, capsys):
+    from analytics_zoo_tpu.serving.cli import main as cli_main
+
+    assert cli_main(["postmortem"]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert cli_main(["postmortem", str(bad)]) == 1
+    notflight = tmp_path / "notflight.json"
+    notflight.write_text(json.dumps({"schema": "something-else"}))
+    assert cli_main(["postmortem", str(notflight)]) == 1
+    assert cli_main(["postmortem", str(tmp_path / "missing.json")]) == 1
+    capsys.readouterr()

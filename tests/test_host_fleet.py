@@ -27,6 +27,7 @@ import pytest
 from analytics_zoo_tpu.common import resilience as _res
 from analytics_zoo_tpu.inference import InferenceModel
 from analytics_zoo_tpu.observability import events as _ev
+from analytics_zoo_tpu.observability import recorder as _flight
 from analytics_zoo_tpu.observability import traces as _traces
 from analytics_zoo_tpu.serving import (FleetSupervisor, InputQueue,
                                        OutputQueue, ServingConfig,
@@ -209,11 +210,15 @@ def test_host_placement_spreads_and_respects_capacity():
 # ---------------------------------------------------------------------------
 
 @pytest.mark.chaos
-def test_whole_host_kill_zero_loss_single_decision():
+def test_whole_host_kill_zero_loss_single_decision(tmp_path):
     """SIGKILL-equivalent death of one entire host mid-burst: every request
     is answered exactly once, the failover is ONE ``fleet.host_failed``
-    decision, and its exported trace carries spans from both hosts."""
+    decision, its exported trace carries spans from both hosts, and the
+    kill auto-cuts a complete, loadable flight dump whose control records
+    capture the host-heartbeat-age inputs behind the verdict."""
     broker = start_broker()
+    rec = _flight.install(
+        dump_dir=os.environ.get("ZOO_FLIGHT_DIR") or str(tmp_path))
     try:
         cfg = _cfg(broker, replicas=4, fleet_hosts=2)
         fleet = FleetSupervisor(
@@ -257,7 +262,25 @@ def test_whole_host_kill_zero_loss_single_decision():
             assert all("clock_offset_s" in e["args"] for e in evict)
             # survivors keep serving
             _check_exactly_once(broker, _submit(broker, 8, start=100))
+            # the SIGKILL drill must leave a black box behind: one complete
+            # versioned dump, auto-cut on the fleet.host_failed event
+            import json as _json
+
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and rec.last_dump_path is None:
+                time.sleep(0.05)
+            assert rec.last_dump_path is not None, "host kill cut no dump"
+            with open(rec.last_dump_path) as f:
+                dump = _json.load(f)
+            assert dump["schema"] == "zoo-flight-v1"
+            assert any(e["kind"] == "fleet.host_failed"
+                       for e in dump["events"])
+            checks = [r for r in dump["records"]
+                      if r["site"] == "fleet.host_check"]
+            assert checks and checks[-1]["inputs"]["host"] == "h0"
+            assert checks[-1]["inputs"]["hb_age_s"] >= 0.0
         finally:
+            _flight.uninstall()
             fleet.stop(drain_s=1.0)
     finally:
         broker.shutdown()
