@@ -25,12 +25,14 @@ from .collectives import collective_counts, jaxpr_collective_counts
 from .decode import lint_decode_stability
 from .docs import check_metric_doc_drift, render_metric_table
 from .fused_int8 import fused_dispatch_report, fused_structure_counts
-from .memory import flatten_donation, lint_donation, lint_memory
+from .memory import (flatten_donation, lint_donation, lint_memory,
+                     lint_sharded_gather)
 
 __all__ = [
     "check_metric_doc_drift", "collective_counts", "collectives",
     "concurrency", "decode", "docs", "flatten_donation",
     "fused_dispatch_report", "fused_int8", "fused_structure_counts",
     "graph_hygiene", "jaxpr_collective_counts", "lint_decode_stability",
-    "lint_donation", "lint_memory", "memory", "render_metric_table",
+    "lint_donation", "lint_memory", "lint_sharded_gather", "memory",
+    "render_metric_table",
 ]

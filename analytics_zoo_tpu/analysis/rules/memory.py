@@ -37,6 +37,7 @@ from ..memory import aval_nbytes, profile_jaxpr
 __all__ = [
     "CacheAliasRule", "DonationMissedRule", "HbmBudgetRule",
     "PeakTemporaryRule", "flatten_donation", "lint_donation", "lint_memory",
+    "lint_sharded_gather",
 ]
 
 
@@ -252,6 +253,48 @@ def lint_memory(closed_jaxpr, ctx: Optional[RuleContext] = None,
         else ["hbm-budget", "peak-temporary", "cache-alias"])
     findings += report(lint_donation(closed_jaxpr, ctx))
     return findings
+
+
+def lint_sharded_gather(rows: int, width: int, batch: int, n_shards: int,
+                        *, dtype="float32",
+                        hbm_budget_bytes: Optional[int] = None,
+                        where: str = "sharded_gather") -> List[Finding]:
+    """``hbm-budget`` gate for one row-sharded embedding lookup
+    (:func:`analytics_zoo_tpu.parallel.sharded_gather`).
+
+    A global-shape trace of the sharded model would show the FULL
+    ``(rows, width)`` table and always bust a per-device budget — the whole
+    point of row sharding is that no device ever holds it. So this traces
+    the SHARD-LOCAL block one device actually executes: the ``rows/n``-row
+    table shard, the all-gathered ``(batch,)`` id vector, the masked owner
+    gather's ``(batch, width)`` partial, and the reduce-scatter emulated as
+    a reshape-sum down to the ``(batch/n, width)`` output — byte-for-byte
+    the per-device live set of the real exchange, minus the collective
+    itself (which the collective-budget tier owns). Findings list empty ⇔
+    the per-device budget holds."""
+    import jax
+    import jax.numpy as jnp
+
+    if rows % n_shards or batch % n_shards:
+        raise ValueError(f"rows={rows} and batch={batch} must divide "
+                         f"n_shards={n_shards} (pad first)")
+    local_rows = rows // n_shards
+    dt = jnp.dtype(dtype)
+
+    def shard_block(local_table, all_ids):
+        loc = all_ids - local_rows          # any fixed shard offset
+        ok = (loc >= 0) & (loc < local_rows)
+        part = jnp.take(local_table, jnp.where(ok, loc, 0), axis=0)
+        part = jnp.where(ok[:, None], part, jnp.zeros((), dt))
+        return part.reshape(n_shards, batch // n_shards, width).sum(0)
+
+    jaxpr = jax.make_jaxpr(shard_block)(
+        jax.ShapeDtypeStruct((local_rows, width), dt),
+        jax.ShapeDtypeStruct((batch,), jnp.int32))
+    return lint_memory(
+        jaxpr, ctx=RuleContext(where=where,
+                               hbm_budget_bytes=hbm_budget_bytes),
+        rules=["hbm-budget", "peak-temporary"])
 
 
 # ---------------------------------------------------------------------------

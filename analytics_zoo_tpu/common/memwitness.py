@@ -40,8 +40,9 @@ from typing import Any, Dict, Optional, Tuple
 from . import telemetry as _tm
 
 __all__ = [
-    "dump_witness", "enabled", "load_witness", "note_static", "reset_witness",
-    "sample", "witness_samples", "witness_statics", "witness_path",
+    "dump_witness", "enabled", "load_witness", "note_static", "record_bytes",
+    "reset_witness", "sample", "witness_samples", "witness_statics",
+    "witness_path",
 ]
 
 _SAMPLES = _tm.counter(
@@ -163,6 +164,22 @@ def sample(site: str) -> None:
         return
     live, in_use = _measure()
     _WITNESS.record(site, live, in_use)
+    _SAMPLES.labels(site=site).inc()
+    _arm_atexit_dump()
+
+
+def record_bytes(site: str, live_bytes: int) -> None:
+    """Record an explicitly measured byte count for ``site``.
+
+    The host-tier escape hatch: ``jax.live_arrays()`` cannot see
+    host-resident allocations (the serving hot-row cache's DRAM tier, a
+    memmap's resident pages), so components that know their own footprint
+    report it here and the same per-site budget gate
+    (:func:`~analytics_zoo_tpu.analysis.memory.check_memory_witness`)
+    applies. No-op unless enabled."""
+    if not enabled():
+        return
+    _WITNESS.record(site, int(live_bytes), None)
     _SAMPLES.labels(site=site).inc()
     _arm_atexit_dump()
 

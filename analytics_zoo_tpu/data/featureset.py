@@ -394,6 +394,33 @@ class FeatureSet:
             return gather_rows(a, sel)
         return np.ascontiguousarray(a[sel])
 
+    def row_slice(self, indices) -> ArrayTree:
+        """Random-access row read: the rows at ``indices`` (any order, repeats
+        allowed), in batch order, as plain in-DRAM arrays.
+
+        On the memmap tiers (``DISK_AND_DRAM``/``PMEM``) this reads ONLY the
+        requested rows — sorted-index order against the memmap, page-cache
+        friendly — instead of materializing an epoch slice and copying whole
+        row ranges. This is the miss path of the serving hot-row cache
+        (:mod:`analytics_zoo_tpu.serving.rowcache`): a cache fill touches the
+        bytes of the missed rows and nothing else. Bit-identical to gathering
+        from the same data held in DRAM.
+        """
+        sel = np.asarray(indices)
+        if sel.ndim != 1:
+            raise ValueError(f"row_slice wants a 1-D index array, got "
+                             f"shape {sel.shape}")
+        if not np.issubdtype(sel.dtype, np.integer):
+            raise ValueError(f"row_slice wants integer indices, got {sel.dtype}")
+        if sel.size and (sel.min() < 0 or sel.max() >= self._n_total):
+            raise IndexError(
+                f"row_slice indices out of range [0, {self._n_total}): "
+                f"min={sel.min()} max={sel.max()}")
+        t0 = time.perf_counter()
+        out = _tree_map(lambda a: self._gather(a, sel), self.data)
+        _DATA_GATHER.observe(time.perf_counter() - t0)
+        return out
+
     def slices(self, num_slices: Optional[int] = None) -> List["FeatureSet"]:
         """Epoch slicing: split into sub-epoch FeatureSets (DiskFeatureSet's
         DISK_AND_DRAM numSlice semantics, FeatureSet.scala:546)."""

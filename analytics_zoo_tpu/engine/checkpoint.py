@@ -49,8 +49,14 @@ from ..common import telemetry as _tm
 from ..common.chaos import chaos_point
 
 _CKPT_RE = re.compile(r"^checkpoint_(\d+)$")
+_DELTA_RE = re.compile(r"^rowdelta_(\d+)$")
 
 MANIFEST_NAME = "manifest.json"
+
+#: a 2-D leaf publishes as a row delta only while the touched rows (plus
+#: index bytes) stay under this fraction of the full leaf — past it, one
+#: contiguous full-leaf write beats a scattered row apply
+ROW_DELTA_THRESHOLD = 0.5
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -300,6 +306,197 @@ def save_checkpoint(directory: str, state: Any, *, iteration: int, epoch: int,
                            on_durable=on_durable)
 
 
+def _as_leaf_dtype(raw: np.ndarray, want: np.dtype) -> np.ndarray:
+    """Undo the npz void-bytes round-trip for ml_dtypes customs (bf16/fp8)."""
+    if raw.dtype != want and raw.dtype.kind == "V" \
+            and raw.dtype.itemsize == want.itemsize:
+        return raw.view(want)
+    return raw
+
+
+def _shard_checksums(idx: np.ndarray, rows: np.ndarray, rows_total: int,
+                     n_shards: int) -> List[Dict]:
+    """Per-owner-shard ``{shard, count, checksum}`` for a row delta under
+    contiguous row sharding (rows ``[s*per, (s+1)*per)`` belong to shard
+    ``s``): each serving shard can verify exactly the slice it will apply."""
+    n_shards = max(1, int(n_shards))
+    per = max(1, rows_total // n_shards)
+    out: List[Dict] = []
+    for s in range(n_shards):
+        lo = s * per
+        hi = (s + 1) * per if s < n_shards - 1 else rows_total
+        m = (idx >= lo) & (idx < hi)
+        if not m.any():
+            continue
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(idx[m]).tobytes())
+        h.update(np.ascontiguousarray(rows[m]).tobytes())
+        out.append({"shard": s, "count": int(m.sum()),
+                    "checksum": h.hexdigest()[:16]})
+    return out
+
+
+def _select_base_params(base_manifest: Dict, n_params: int) -> List[int]:
+    """Indices of the params leaves inside the base checkpoint's flat leaf
+    list — identity for a params-only snapshot, the ``['params']`` subtree
+    (via the manifest's leaf paths) for a full train-state snapshot."""
+    n_base = int(base_manifest["n_leaves"])
+    if n_base == n_params:
+        return list(range(n_base))
+    paths = base_manifest.get("leaf_paths") or []
+    if len(paths) == n_base:
+        sel = [i for i, p in enumerate(paths)
+               if str(p).startswith("['params']")]
+        if len(sel) == n_params:
+            return sel
+    raise ValueError(
+        f"base checkpoint has {n_base} leaves and no params subtree "
+        f"matching the {n_params}-leaf publish tree")
+
+
+def save_row_delta(directory: str, params: Any, base_path: str, *,
+                   iteration: int, epoch: int = 0, n_shards: int = 1,
+                   keep: int = 5,
+                   rows_threshold: float = ROW_DELTA_THRESHOLD,
+                   on_durable: Optional[Callable[[str, Dict], None]] = None
+                   ) -> str:
+    """Publish only the rows of ``params`` that changed since ``base_path``.
+
+    The incremental half of the million-row embedding loop: a training step
+    touches the handful of rows its batch looked up
+    (:mod:`~..parallel.embedding_sharding` keeps the update shard-local and
+    sparse), so shipping the whole multi-GiB table per publish is almost all
+    redundant bytes. This diffs the host snapshot of ``params`` against the
+    durable base checkpoint and writes a ``rowdelta_<iteration>`` dir whose
+    ``state.npz`` holds, per leaf: nothing (untouched), ``idx_<k>`` +
+    ``rows_<k>`` (2-D leaf, touched rows under ``rows_threshold`` of the
+    leaf), or ``full_<k>`` (dense fallback). The manifest sidecar carries
+    the usual version/checksum/state_bytes (so :func:`verify_checkpoint`
+    applies unchanged) PLUS a ``row_delta`` record — base version, shard
+    count, and per-owner-shard row counts + checksums under contiguous
+    ``rows/n_shards`` ownership — which is what the serving-side
+    :class:`~...serving.hotswap.ModelSwapper` validates before applying the
+    delta in place. The manifest ``signature``/``n_leaves`` describe the
+    FULL params tree, so signature-compatibility checks against the live
+    executable work exactly as for a full checkpoint.
+
+    Same durability discipline as :func:`save_checkpoint`: staged under
+    ``*.tmp``, fsync'd, atomically renamed; ``on_durable(path, manifest)``
+    fires only after publication. Raises ``ValueError`` when the base's
+    params tree is not signature-identical to ``params`` — a delta against
+    the wrong base is unrecoverable garbage, better refused at source.
+    """
+    os.makedirs(directory, exist_ok=True)
+    base_manifest = verify_checkpoint(base_path)
+    if base_manifest is None:
+        raise ValueError(f"{base_path} has no manifest — row deltas need a "
+                         "manifest-carrying base checkpoint")
+    host_leaves = snapshot_state(params)
+    try:
+        pairs = jax.tree_util.tree_flatten_with_path(params)[0]
+        leaf_paths = [jax.tree_util.keystr(p) for p, _ in pairs]
+    except Exception:
+        leaf_paths = []
+    base_idx = _select_base_params(base_manifest, len(host_leaves))
+    base_data = np.load(os.path.join(base_path, "state.npz"))
+
+    arrays: Dict[str, np.ndarray] = {}
+    delta_leaves: List[Dict] = []
+    rows_touched = 0
+    for k, (leaf, bi) in enumerate(zip(host_leaves, base_idx)):
+        base_leaf = _as_leaf_dtype(base_data[f"leaf_{bi}"], leaf.dtype)
+        if tuple(base_leaf.shape) != tuple(leaf.shape) \
+                or base_leaf.dtype != leaf.dtype:
+            raise ValueError(
+                f"leaf {k}: publish {leaf.shape}/{leaf.dtype} vs base "
+                f"{base_leaf.shape}/{base_leaf.dtype} — row deltas need a "
+                "signature-identical base")
+        # bytewise row comparison: dtype-agnostic (bf16 safe) and treats a
+        # NaN-poisoned row as touched, so the swapper's NaN scan sees it
+        a = leaf.reshape(leaf.shape[0], -1).view(np.uint8) if leaf.ndim == 2 \
+            else np.ascontiguousarray(leaf).view(np.uint8).reshape(1, -1)
+        b = base_leaf.reshape(base_leaf.shape[0], -1).view(np.uint8) \
+            if leaf.ndim == 2 \
+            else np.ascontiguousarray(base_leaf).view(np.uint8).reshape(1, -1)
+        touched = np.flatnonzero((a != b).any(axis=1))
+        if touched.size == 0:
+            delta_leaves.append({"leaf": k, "mode": "same"})
+            continue
+        if leaf.ndim == 2:
+            idx = touched.astype(np.int64)
+            rows = np.ascontiguousarray(leaf[idx])
+            if idx.size * (rows[0].nbytes + idx.itemsize) \
+                    < rows_threshold * leaf.nbytes:
+                arrays[f"idx_{k}"] = idx
+                arrays[f"rows_{k}"] = rows
+                rows_touched += int(idx.size)
+                delta_leaves.append({
+                    "leaf": k, "mode": "rows", "count": int(idx.size),
+                    "rows_total": int(leaf.shape[0]),
+                    "shards": _shard_checksums(idx, rows, leaf.shape[0],
+                                               n_shards)})
+                continue
+        arrays[f"full_{k}"] = leaf
+        delta_leaves.append({
+            "leaf": k, "mode": "full",
+            "checksum": hashlib.sha256(
+                np.ascontiguousarray(leaf).tobytes()).hexdigest()[:16]})
+
+    path = os.path.join(directory, f"rowdelta_{iteration}")
+    tmp = path + ".tmp"
+    t0 = time.perf_counter()
+    try:
+        os.makedirs(tmp, exist_ok=True)
+        state_path = os.path.join(tmp, "state.npz")
+        np.savez(state_path, **arrays)
+        meta = {"iteration": iteration, "epoch": epoch, "time": time.time(),
+                "n_leaves": len(host_leaves), "leaf_paths": leaf_paths,
+                "base_version": base_manifest["version"]}
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync(state_path)
+        manifest = _build_manifest(state_path, host_leaves, meta)
+        manifest["row_delta"] = {
+            "base_version": base_manifest["version"],
+            "base_path": os.path.abspath(base_path),
+            "n_shards": int(max(1, n_shards)),
+            "rows_touched": rows_touched,
+            "leaves": delta_leaves,
+        }
+        with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        chaos_point("ckpt.write")
+        _fsync(tmp)
+        old = None
+        if os.path.exists(path):
+            old = path + ".old"
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(path, old)
+        os.rename(tmp, path)
+        _fsync(directory)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    finally:
+        _WRITE_TIME.observe(time.perf_counter() - t0)
+    _gc(directory, keep)
+    if on_durable is not None:
+        try:
+            on_durable(path, manifest)
+        except Exception:
+            import logging
+
+            logging.getLogger("analytics_zoo_tpu.checkpoint").exception(
+                "on_durable hook failed for %s", path)
+    return path
+
+
 class CheckpointWriter:
     """At-most-one-in-flight background checkpoint writer.
 
@@ -361,13 +558,15 @@ class CheckpointWriter:
 
 def _gc(directory: str, keep: int) -> None:
     names = os.listdir(directory)
-    ckpts = sorted(
-        (int(m.group(1)), name) for name in names
-        if (m := _CKPT_RE.match(name)))
-    for _, name in ckpts[:-keep]:
-        shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
+    for rx in (_CKPT_RE, _DELTA_RE):
+        ckpts = sorted(
+            (int(m.group(1)), name) for name in names
+            if (m := rx.match(name)))
+        for _, name in ckpts[:-keep]:
+            shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
     for name in names:        # .old dirs stranded by a crash mid-replace
-        if name.endswith(".old") and _CKPT_RE.match(name[:-4]):
+        if name.endswith(".old") and (_CKPT_RE.match(name[:-4])
+                                      or _DELTA_RE.match(name[:-4])):
             shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
 
 

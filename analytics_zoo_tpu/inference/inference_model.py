@@ -65,6 +65,13 @@ def _pad_to(arr: np.ndarray, n: int) -> np.ndarray:
     return np.pad(arr, pad)
 
 
+@jax.jit
+def _scatter_rows(table, idx, rows):
+    # specializes per (leaf aval, touched-row count) — the count varies per
+    # publish, but embedding deltas dominate and the scatter itself is tiny
+    return table.at[idx].set(rows)
+
+
 def _quantize_leaf(w: np.ndarray) -> Dict[str, np.ndarray]:
     """Per-output-channel symmetric int8 (channels = last dim)."""
     scale = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)), keepdims=True)
@@ -415,6 +422,47 @@ class InferenceModel:
             if clear:
                 self._compiled.clear()
             self.version = version
+        return self
+
+    def apply_row_delta(self, entries, *, version: Optional[str] = None
+                        ) -> "InferenceModel":
+        """Patch the live params IN PLACE from a row-delta publish: scatter
+        only the touched rows into each affected leaf instead of staging a
+        full replacement tree. ``entries`` is ``[(leaf_index, idx, rows)]``
+        in the load-time flatten order — ``idx=None`` means ``rows`` is a
+        whole-leaf replacement (the delta's dense fallback).
+
+        Only the touched rows cross host→device; each patched leaf keeps its
+        aval, so the compiled executables keep serving with zero recompiles
+        (params are call arguments, not captures). The scatter runs on an
+        undonated copy — the pre-flip leaf may still be mid-``predict`` on
+        another slot, so its buffer must stay valid until the gated flip.
+        Quantized models reject the patch: rows can't be scattered into
+        int8-packed kernels, so they take the full-checkpoint path."""
+        if self._plain_apply is None:
+            raise RuntimeError("apply_row_delta needs a load-time template "
+                               "(use load/load_fn)")
+        if self._quantized:
+            raise RuntimeError(
+                "row deltas cannot patch int8-packed params — publish a "
+                "full checkpoint for quantized serving")
+        if self._params is None:
+            raise RuntimeError("no model loaded")
+        leaves, treedef = jax.tree_util.tree_flatten(self._params)
+        for leaf_idx, idx, rows in entries:
+            cur = leaves[leaf_idx]
+            if idx is None:
+                leaves[leaf_idx] = jax.device_put(
+                    jnp.asarray(rows, cur.dtype))
+            else:
+                leaves[leaf_idx] = _scatter_rows(
+                    cur, jnp.asarray(np.asarray(idx, np.int32)),
+                    jnp.asarray(rows, cur.dtype))
+        new_params = jax.tree_util.tree_unflatten(treedef, leaves)
+        with self._hold_all_slots():
+            self._params = new_params
+            if version is not None:
+                self.version = version
         return self
 
     # ---------------------------------------------------------------- predicting

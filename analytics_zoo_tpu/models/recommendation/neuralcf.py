@@ -84,6 +84,27 @@ class NeuralCF(Recommender):
                     item_embed=self.item_embed, hidden_layers=self.hidden_layers,
                     include_mf=self.include_mf, mf_embed=self.mf_embed)
 
+    @property
+    def table_rows(self) -> int:
+        """Rows of the fused pair table: ``(user_count+1) + (item_count+1)``
+        (+1s are the 1-based-id convention). Row sharding needs this to
+        divide the mesh axis — size the counts with
+        :func:`analytics_zoo_tpu.parallel.pad_rows` in mind."""
+        return self.user_count + 1 + self.item_count + 1
+
+    def shard_tables(self, mesh, *, axis: str = "dp", min_rows: int = 0,
+                     shard_batch: bool = True):
+        """Row-shard the fused user/item table over ``mesh[axis]`` and return
+        the Estimator ``param_sharding`` rule (the million-user path: the
+        table never replicates, lookups go through the model-parallel gather,
+        Adam moments land 1/n per device). No-op marking — and a replicated
+        rule — when :attr:`table_rows` doesn't divide the axis."""
+        from ...parallel.embedding_sharding import shard_embedding_tables
+
+        return shard_embedding_tables(self, mesh, axis=axis,
+                                      min_rows=min_rows,
+                                      shard_batch=shard_batch)
+
     def save_model(self, path: str):
         from ..common.zoo_model import save_model_bundle
 

@@ -17,6 +17,22 @@ import numpy as np
 from ..module import Layer, Shape, get_initializer, param_dtype
 
 
+def _lookup(layer, table, ids):
+    """Table row lookup honouring an optional row-sharding mark.
+
+    ``shard_embedding_tables`` (parallel/embedding_sharding.py) sets
+    ``layer.table_sharding`` on instances whose table is row-sharded over a
+    mesh axis; those gather through the model-parallel exchange. Unmarked
+    instances — every serving copy, every single-device model — stay on the
+    plain HBM gather."""
+    ts = getattr(layer, "table_sharding", None)
+    if ts is None:
+        return jnp.take(table, ids, axis=0)
+    from ...parallel.embedding_sharding import sharded_gather
+    return sharded_gather(table, ids, ts.mesh, ts.axis,
+                          shard_batch=ts.shard_batch)
+
+
 class Embedding(Layer):
     """Lookup table ``(input_dim, output_dim)``; input is int ids ``(B, ...)``.
 
@@ -49,7 +65,7 @@ class Embedding(Layer):
     def apply(self, params, state, x, *, training=False, rng=None):
         table = params["embeddings"] if self.trainable else state["embeddings"]
         ids = jnp.asarray(x, jnp.int32)
-        return jnp.take(table, ids, axis=0), state
+        return _lookup(self, table, ids), state
 
     def compute_output_shape(self, input_shape):
         return tuple(input_shape) + (self.output_dim,)
@@ -95,7 +111,7 @@ class FusedPairEmbedding(Layer):
     def apply(self, params, state, x, *, training=False, rng=None):
         ids = jnp.asarray(x, jnp.int32)  # (B, 2): [user_id, item_id]
         flat = ids + jnp.asarray([0, self.user_count], jnp.int32)
-        rows = jnp.take(params["embeddings"], flat, axis=0)  # (B, 2, W)
+        rows = _lookup(self, params["embeddings"], flat)  # (B, 2, W)
         u, i = rows[:, 0, :], rows[:, 1, :]
         parts = [u[:, :self.user_mlp_dim], i[:, :self.item_mlp_dim]]
         if self.mf_dim:

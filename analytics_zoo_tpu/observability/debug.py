@@ -11,6 +11,8 @@ Routes (all GET):
                          decision events, tail-sampled trace index
     /debug/slo           SLO engine status as JSON (cli slo-status)
     /debug/events        recent decision events as JSON (?n=, ?kind=)
+    /debug/rowcache      host hot-row cache stats (per-tier hit rates,
+                         pinned rows, host/device bytes) as JSON
     /debug/traces        tail-sampled trace index as JSON
     /debug/traces/<id>   one trace as Chrome/Perfetto trace-event JSON
                          (Content-Disposition: attachment — drop the file
@@ -104,6 +106,9 @@ class DebugSurface:
                 return self._json(self._slo_payload())
             if route == "/debug/events":
                 return self._json(self._events_payload(query))
+            if route == "/debug/rowcache":
+                from ..serving import rowcache as _rc
+                return self._json({"caches": _rc.cache_stats()})
             if route == "/debug/traces":
                 return self._json({"traces":
                                    _traces.interesting_traces(

@@ -62,6 +62,12 @@ def shard_spec_over_axis(spec: P, shape: Sequence[int], mesh,
     to an existing dim's axis tuple when the combined product still divides;
     leaves the spec unchanged (replicated update for that leaf) when nothing
     divides — small biases/scalars are not worth a collective.
+
+    For 2-D leaves the *row* dim (dim 0) wins ties: embedding tables are
+    ``(vocab, embed)`` and row sharding is what the sharded-gather path and
+    row-delta publishing key on, so an oblong table with ``embed`` larger
+    than the per-shard vocab slice must still shard by rows, not columns.
+    Dims of other ranks keep the largest-first order (best bytes/shard).
     """
     size = mesh.shape.get(axis, 1)
     shape = tuple(shape)
@@ -83,7 +89,11 @@ def shard_spec_over_axis(spec: P, shape: Sequence[int], mesh,
             p *= mesh.shape[a]
         return p
 
-    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    if len(shape) == 2:
+        # (vocab, embed) tables: rows first, regardless of which dim is larger
+        order = [0, 1]
+    else:
+        order = sorted(range(len(shape)), key=lambda i: -shape[i])
     for i in order:
         if entries[i] is None and shape[i] % size == 0:
             entries[i] = axis

@@ -23,10 +23,11 @@ from .hotswap import (ModelPublisher, ModelSwapper, RolloutController,
                       SwapRejected)
 from .http_frontend import FrontEndApp
 from .qos import PRIORITIES, ShedError
+from .rowcache import HostRowCache
 
 __all__ = ["QueueBroker", "start_broker", "InputQueue", "OutputQueue",
            "ServingConfig", "ClusterServing", "ContinuousBatcher",
            "FleetSupervisor", "GenerationClient", "GenerationEngine",
-           "FrontEndApp", "ModelPublisher", "ModelSwapper", "PRIORITIES",
-           "ReplicaRouter", "RolloutController", "ShedError",
+           "FrontEndApp", "HostRowCache", "ModelPublisher", "ModelSwapper",
+           "PRIORITIES", "ReplicaRouter", "RolloutController", "ShedError",
            "SwapRejected"]

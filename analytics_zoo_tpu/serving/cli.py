@@ -382,6 +382,18 @@ def do_slo_status(args) -> int:
     return 1 if payload.get("firing") else 0
 
 
+def do_rowcache(args) -> int:
+    """Print host hot-row cache stats (per-tier hit rates, pinned rows,
+    host/device bytes) from the frontend's ``/debug/rowcache``."""
+    try:
+        payload = _http_get(args.http, "/debug/rowcache")
+    except Exception as e:
+        print(f"frontend on {args.http} unreachable: {e}", file=sys.stderr)
+        return 3
+    print(json.dumps(payload, indent=1, sort_keys=True))
+    return 0 if payload.get("caches") else 4
+
+
 def do_trace(args) -> int:
     """Fetch one trace as Chrome/Perfetto trace-event JSON from the
     frontend's ``/debug/traces/<id>`` (load the file at ui.perfetto.dev)."""
@@ -526,7 +538,7 @@ def main(argv=None) -> int:
                     choices=["start", "stop", "restart", "status", "info",
                              "fleet-status", "hosts", "drain",
                              "rolling-restart", "events", "slo-status",
-                             "trace", "dump", "postmortem"])
+                             "rowcache", "trace", "dump", "postmortem"])
     ap.add_argument("target", nargs="?", default=None,
                     help="`postmortem`: path to a flight dump JSON "
                          "(from `cli dump`, /debug/flight, or a crash)")
@@ -559,7 +571,8 @@ def main(argv=None) -> int:
             "fleet-status": do_fleet_status, "hosts": do_hosts,
             "drain": do_drain,
             "rolling-restart": do_rolling_restart, "events": do_events,
-            "slo-status": do_slo_status, "trace": do_trace,
+            "slo-status": do_slo_status, "rowcache": do_rowcache,
+            "trace": do_trace,
             "dump": do_dump,
             "postmortem": do_postmortem}[args.action](args)
 

@@ -105,12 +105,34 @@ _RECONCILES = _tm.counter(
 class SwapRejected(Exception):
     """A published checkpoint failed swap-side validation; the live model is
     untouched. ``reason`` is one of checksum/signature/shape/nan/io/
-    warmup/unsupported — the label on
-    ``zoo_swap_validation_failures_total``."""
+    warmup/unsupported/base — the label on
+    ``zoo_swap_validation_failures_total``. ``base`` is row-delta specific:
+    the delta's base version is not what the replica is serving, so the
+    patch cannot be applied (the forced reconcile path converges through
+    the base checkpoint instead)."""
 
     def __init__(self, reason: str, message: str):
         super().__init__(message)
         self.reason = reason
+
+
+class _StagedRowDelta:
+    """Validated row-delta publish, ready for the in-place flip.
+
+    ``entries`` is ``[(leaf_index, idx, rows)]`` in the live model's params
+    flatten order: ``idx=None`` marks a whole-leaf replacement, otherwise
+    ``rows[i]`` lands at row ``idx[i]``. Everything here already passed the
+    manifest/shape/NaN gauntlet — the swap step only scatters and flips."""
+
+    __slots__ = ("entries", "base_version", "rows_touched", "nbytes")
+
+    def __init__(self, entries: List[Tuple[int, Optional[np.ndarray],
+                                           np.ndarray]],
+                 base_version: str, rows_touched: int, nbytes: int):
+        self.entries = entries
+        self.base_version = base_version
+        self.rows_touched = rows_touched
+        self.nbytes = nbytes
 
 
 def _conn_policy() -> RetryPolicy:
@@ -125,13 +147,24 @@ def publish_record(path: str, manifest: Optional[Dict] = None) -> Dict:
     if manifest is None:
         raise ValueError(f"{path} has no manifest.json — only "
                          "manifest-carrying checkpoints can be published")
-    return {"version": manifest["version"],
-            "step": int(manifest["iteration"]),
-            "path": path,
-            "signature": manifest["signature"],
-            "checksum": manifest["checksum"],
-            "n_leaves": int(manifest["n_leaves"]),
-            "ts": time.time()}
+    record = {"version": manifest["version"],
+              "step": int(manifest["iteration"]),
+              "path": path,
+              "signature": manifest["signature"],
+              "checksum": manifest["checksum"],
+              "n_leaves": int(manifest["n_leaves"]),
+              "ts": time.time()}
+    rd = manifest.get("row_delta")
+    if rd:
+        # replicas already on base_version apply the delta in place; a
+        # replica on anything else (respawned, late-joining) force-converges
+        # through base_path first — both facts ride the stream record
+        record["delta"] = True
+        record["base_version"] = rd.get("base_version")
+        record["base_path"] = rd.get("base_path")
+        record["rows_touched"] = int(rd.get("rows_touched", 0))
+        record["delta_bytes"] = int(manifest.get("state_bytes", 0))
+    return record
 
 
 class ModelPublisher:
@@ -258,6 +291,8 @@ class ModelSwapper:
         # deterministic chaos site BETWEEN validation and the load: a drill
         # killing the swapper here models replica death mid-swap
         chaos_point("swap.stage")
+        if manifest.get("row_delta"):
+            return self._stage_delta(record, manifest, path)
         try:
             data = np.load(os.path.join(path, "state.npz"))
         except Exception as e:
@@ -304,6 +339,106 @@ class ModelSwapper:
         if self.warmup:
             self._probe(params)
         return params
+
+    def _stage_delta(self, record: Dict, manifest: Dict,
+                     path: str) -> "_StagedRowDelta":
+        """Validate an incremental row-delta publish against the LIVE model.
+
+        A delta is only applicable on top of the exact base it was diffed
+        against — the base check is first and its failure gets its own
+        reason (``base``) so the forced reconcile path can distinguish
+        "needs the base first" from a genuinely poisoned publish. The rest
+        mirrors the full-checkpoint gauntlet scaled down to the touched
+        rows: per-shard manifest checksums recomputed over the loaded
+        idx/row bytes, aval checks against the live template, NaN scan."""
+        rd = manifest["row_delta"]
+        live = getattr(self.model, "version", None)
+        base = rd.get("base_version")
+        if live != base:
+            raise SwapRejected(
+                "base", f"row delta {manifest['version']} applies on top of "
+                f"{base}, but this replica serves {live or 'boot params'}")
+        if getattr(self.model, "apply_row_delta", None) is None:
+            raise SwapRejected("unsupported",
+                               "model cannot apply row deltas in place")
+        try:
+            data = np.load(os.path.join(path, "state.npz"))
+        except Exception as e:
+            raise SwapRejected("io", f"cannot deserialize {path}: {e}")
+        avals = self.model.load_avals
+        if int(manifest["n_leaves"]) != len(avals):
+            raise SwapRejected(
+                "shape", f"delta describes {manifest['n_leaves']} param "
+                f"leaves, live model has {len(avals)}")
+        from ..engine.checkpoint import _shard_checksums
+
+        entries: List[Tuple[int, Optional[np.ndarray], np.ndarray]] = []
+        nbytes = 0
+        for leaf in rd.get("leaves", []):
+            k = int(leaf["leaf"])
+            mode = leaf.get("mode", "same")
+            if mode == "same":
+                continue
+            if k >= len(avals):
+                raise SwapRejected("shape", f"delta leaf {k} out of range")
+            shape, dtype = avals[k]
+            want = _dtype_from_name(dtype)
+
+            def _load(key):
+                try:
+                    raw = data[key]
+                except KeyError:
+                    raise SwapRejected(
+                        "io", f"delta file is missing array {key!r}")
+                if raw.dtype != want and raw.dtype.kind == "V" \
+                        and raw.dtype.itemsize == want.itemsize:
+                    raw = raw.view(want)
+                return raw
+
+            if mode == "rows":
+                try:
+                    idx = np.asarray(data[f"idx_{k}"])
+                except KeyError:
+                    raise SwapRejected(
+                        "io", f"delta file is missing array 'idx_{k}'")
+                rows = _load(f"rows_{k}")
+                if idx.ndim != 1 \
+                        or not np.issubdtype(idx.dtype, np.integer) \
+                        or rows.shape[:1] != idx.shape \
+                        or tuple(rows.shape[1:]) != tuple(shape[1:]) \
+                        or rows.dtype != want:
+                    raise SwapRejected(
+                        "shape", f"delta leaf {k}: rows "
+                        f"{rows.shape}/{rows.dtype} with {idx.shape} indices "
+                        f"vs live {tuple(shape)}/{want}")
+                if idx.size and (idx.min() < 0 or idx.max() >= shape[0]):
+                    raise SwapRejected(
+                        "shape", f"delta leaf {k}: row index out of range "
+                        f"for {shape[0]} rows")
+                got = _shard_checksums(idx, rows, int(shape[0]),
+                                       int(rd.get("n_shards", 1)))
+                if got != leaf.get("shards", []):
+                    raise SwapRejected(
+                        "checksum", f"delta leaf {k}: per-shard row "
+                        "checksums do not match the manifest")
+                arr, entry = rows, (k, idx, rows)
+            else:   # full-leaf fallback
+                full = _load(f"full_{k}")
+                if tuple(full.shape) != tuple(shape) or full.dtype != want:
+                    raise SwapRejected(
+                        "shape", f"delta leaf {k}: full replacement "
+                        f"{full.shape}/{full.dtype} vs live "
+                        f"{tuple(shape)}/{want}")
+                arr, entry = full, (k, None, full)
+            if np.issubdtype(want, np.floating) and \
+                    not np.all(np.isfinite(np.asarray(arr, np.float32))):
+                raise SwapRejected(
+                    "nan", f"delta leaf {k} carries NaN/Inf rows — poisoned "
+                    "publish")
+            nbytes += arr.nbytes
+            entries.append(entry)
+        return _StagedRowDelta(entries, base, int(rd.get("rows_touched", 0)),
+                               nbytes)
 
     def _select_param_leaves(self, manifest: Dict, n_model: int) -> List[int]:
         """Which checkpoint leaves are the MODEL PARAMS. A serving-oriented
@@ -372,6 +507,8 @@ class ModelSwapper:
         weights and draft schedule flip as one manifest pair, never
         observable half-applied. Models without a ``spec`` parameter
         (the one-shot :class:`~..inference.InferenceModel`) ignore it."""
+        if isinstance(params, _StagedRowDelta):
+            return self._swap_delta(params, record)
         prev_version = getattr(self.model, "version", None)
         prev_params = self.model.host_params()
         kw = {}
@@ -385,6 +522,27 @@ class ModelSwapper:
         self.model.swap_params(params, version=record["version"], **kw)
         self.prev = (prev_version, prev_params)
         self.current_step = int(record.get("step", 0))
+        return record["version"]
+
+    def _swap_delta(self, staged: "_StagedRowDelta", record: Dict) -> str:
+        """In-place incremental flip: only the touched rows move. Rollback
+        retention is unchanged — the FULL pre-patch params are snapshotted
+        host-side, so :meth:`rollback` undoes a bad delta exactly like a bad
+        full swap."""
+        prev_version = getattr(self.model, "version", None)
+        prev_params = self.model.host_params()
+        self.model.apply_row_delta(staged.entries, version=record["version"])
+        self.prev = (prev_version, prev_params)
+        self.current_step = int(record.get("step", 0))
+        # decision event: every incremental patch of live weights is
+        # auditable — which rows moved, from which base, and how few bytes
+        # crossed the wire relative to a full publish
+        _ev.emit("swap.row_delta", version=str(record["version"]),
+                 base=str(staged.base_version), rows=staged.rows_touched,
+                 leaves=len(staged.entries), bytes=staged.nbytes)
+        logger.info("applied row delta %s on top of %s (%d rows, %d leaves, "
+                    "%d bytes)", record["version"], staged.base_version,
+                    staged.rows_touched, len(staged.entries), staged.nbytes)
         return record["version"]
 
     def stage_and_swap(self, record: Dict, force: bool = False) -> str:
@@ -403,13 +561,45 @@ class ModelSwapper:
         try:
             params = self.stage(record)
         except SwapRejected as e:
-            _SWAPS.labels(outcome="rejected").inc()
-            _SWAP_REJECTS.labels(reason=e.reason).inc()
-            raise
+            if e.reason == "base" and force and record.get("base_path"):
+                # forced reconcile of a row-delta publish onto a replica
+                # that isn't serving the delta's base (respawned on boot
+                # params, joined late): full-swap the base checkpoint first,
+                # then re-stage the delta on top — the zero-loss convergence
+                # path for a replica killed mid-row-delta-rollout
+                logger.info("replica serves %s, not delta base %s — "
+                            "converging through base checkpoint %s",
+                            getattr(self.model, "version", None),
+                            record.get("base_version"), record["base_path"])
+                params = self._stage_through_base(record)
+            else:
+                _SWAPS.labels(outcome="rejected").inc()
+                _SWAP_REJECTS.labels(reason=e.reason).inc()
+                raise
         version = self.swap(params, record)
         _SWAPS.labels(outcome="ok").inc()
         logger.info("hot-swapped model to %s (step %d)", version, step)
         return version
+
+    def _stage_through_base(self, record: Dict) -> "_StagedRowDelta":
+        """Swap in the delta's base checkpoint (full pipeline: verify,
+        validate, probe, flip), then stage the delta against it. Any failure
+        along the way is a rejection of the DELTA record — counted and
+        raised like every other staging failure."""
+        try:
+            base_record = publish_record(record["base_path"])
+            base_params = self.stage(base_record)
+            self.swap(base_params, base_record)
+            return self.stage(record)
+        except SwapRejected as e:
+            _SWAPS.labels(outcome="rejected").inc()
+            _SWAP_REJECTS.labels(reason=e.reason).inc()
+            raise
+        except (OSError, ValueError) as e:
+            _SWAPS.labels(outcome="rejected").inc()
+            _SWAP_REJECTS.labels(reason="io").inc()
+            raise SwapRejected("io", f"cannot converge through delta base "
+                               f"{record.get('base_path')}: {e}")
 
     def rollback(self) -> Optional[str]:
         """Restore the retained pre-swap params (instant, no file needed —

@@ -1012,6 +1012,186 @@ def run_update_sharding(dp_sizes=(2, 4, 8), accum_steps=(1, 4),
     }
 
 
+def run_embedding(quick: bool = False) -> dict:
+    """Million-user embedding-scale bench (ISSUE 19) → EMBEDDING_BENCH.
+
+    Trains a NeuralCF-style fused-pair embedding whose table is 4× the
+    per-device HBM budget — only possible because the table is row-sharded
+    ``P("dp", None)`` over the mesh (each device holds rows/8) with the
+    model-parallel sharded gather moving ids to the owner shards. Records:
+
+    * ``train``: tokens(ids)/sec through the full sharded train step, the
+      table's per-device bytes (gated ≈ 1/8 of the full table), the
+      shard-local Adam moment bytes, and the compiled step's collective
+      counts — the all-gather(ids)/reduce-scatter(rows) pair must be
+      present in the HLO;
+    * ``gather_lint``: findings from the ``lint_sharded_gather`` memory
+      gate — the shard-LOCAL gather block traced and checked against the
+      per-device budget (must be empty: the sharded working set fits where
+      the dense table cannot);
+    * ``serving``: the host hot-row cache over the trained table under a
+      skewed id stream — lookups/sec, per-tier hit rate, host bytes;
+    * ``delta``: incremental row publishing — bytes of a 1%-rows-touched
+      ``save_row_delta`` vs the full checkpoint (gated ≤5%).
+
+    Always runs on a virtual 8-device CPU mesh: re-execs itself pinned via
+    ``--xla_force_host_platform_device_count`` like the update-sharding
+    bench (the parent may hold a different backend).
+    """
+    n = 8
+    if os.environ.get("_ZOO_EMBEDDING_CHILD") != "1":
+        env = dict(os.environ)
+        env["_ZOO_EMBEDDING_CHILD"] = "1"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={n}"])
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--embedding-child"]
+            + (["--quick"] if quick else []),
+            env=env, capture_output=True, text=True, timeout=1800)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"embedding child failed rc={r.returncode}:\n"
+                f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    import tempfile
+
+    import jax
+    from jax.sharding import Mesh
+
+    from analytics_zoo_tpu.analysis.rules import lint_sharded_gather
+    from analytics_zoo_tpu.common import TrainConfig
+    from analytics_zoo_tpu.engine import Estimator
+    from analytics_zoo_tpu.engine.checkpoint import (save_checkpoint,
+                                                     save_row_delta)
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn import layers as L
+    from analytics_zoo_tpu.nn.layers.embedding import FusedPairEmbedding
+    from analytics_zoo_tpu.parallel import (collective_counts,
+                                            embedding_sharding as es)
+    from analytics_zoo_tpu.serving.rowcache import HostRowCache
+
+    if quick:
+        # 131072 rows: big enough that the batch's gather temporaries sit
+        # well inside the table/8 headroom the memory gate leaves
+        users, items, dim, mf = 98304, 32768, 16, 8
+        B, steps, serve_batches = 1024, 6, 48
+    else:
+        users, items, dim, mf = 786432, 262144, 32, 16   # 1,048,576 rows
+        B, steps, serve_batches = 4096, 15, 128
+
+    axes = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape((n,) + (1,) * 5), axes)
+    model = Sequential([
+        FusedPairEmbedding(users, items, dim, dim, mf_dim=mf,
+                           input_shape=(2,)),
+        L.Dense(16, activation="relu"), L.Dense(1)])
+    rule = es.shard_embedding_tables(model, mesh)
+    cfg = TrainConfig(shuffle=False, log_every_n_steps=10 ** 9,
+                      update_sharding=True)
+    est = Estimator(model, optimizer="adam", loss="mse", config=cfg,
+                    mesh=mesh, param_sharding=rule)
+
+    rng = np.random.default_rng(0)
+    x = np.stack([rng.integers(0, users, B), rng.integers(0, items, B)],
+                 axis=1).astype(np.int32)
+    y = rng.integers(0, 2, (B, 1)).astype(np.float32)
+    batch_np = (x, y)
+    est.fit(batch_np, batch_size=B, epochs=1)       # placement + compile
+    t0 = time.perf_counter()
+    est.fit(batch_np, batch_size=B, epochs=1 + steps)   # `steps` more steps
+    dt = time.perf_counter() - t0
+    state = est.train_state
+
+    emb = state["params"]["0_fusedpairembedding"]["embeddings"]
+    rows, width = int(emb.shape[0]), int(emb.shape[1])
+    table_bytes = int(emb.nbytes)
+    # the scale claim: the FULL table is 4x what one device may hold, so a
+    # replicated table cannot train — only rows/8 per device fits
+    hbm_budget_bytes = table_bytes // 4
+    hlo = est._train_step.lower(state,
+                                est._to_global(batch_np)).compile().as_text()
+
+    def leaf_bytes(tree, match):
+        per_dev = full = 0
+        for p, l in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if match in jax.tree_util.keystr(p) and getattr(l, "ndim", 0) == 2:
+                shards = getattr(l, "addressable_shards", None)
+                per_dev += (shards[0].data.nbytes if shards
+                            else np.asarray(l).nbytes)
+                full += l.nbytes
+        return per_dev, full
+
+    table_per_dev, table_full = leaf_bytes(state["params"], "embeddings")
+    moment_per_dev, moment_full = leaf_bytes(state["opt_state"],
+                                             "embeddings")
+    out = {
+        "metric": "mesh-sharded embedding scale: train + serve + row delta",
+        "rows": rows, "width": width, "batch": B, "shards": n,
+        "table_bytes": table_bytes,
+        "hbm_budget_bytes": hbm_budget_bytes,
+        "table_over_budget": round(table_bytes / hbm_budget_bytes, 2),
+        "platform": str(jax.devices()[0].platform),
+        "train": {
+            "tokens_per_sec": round(steps * B * 2 / dt, 1),
+            "table_bytes_per_device": table_per_dev,
+            "table_shard_ratio": round(table_per_dev / max(1, table_full), 5),
+            "moment_bytes_per_device": moment_per_dev,
+            "moment_shard_ratio": round(
+                moment_per_dev / max(1, moment_full), 5),
+            "collectives": collective_counts(hlo),
+        },
+        "gather_lint": [f.as_dict() for f in lint_sharded_gather(
+            rows, width, B * 2, n, hbm_budget_bytes=hbm_budget_bytes,
+            where="embedding-bench.gather")],
+    }
+
+    # ---- serving arm: host hot-row cache over the trained table ----------
+    table_host = np.asarray(jax.device_get(
+        state["params"]["0_fusedpairembedding"]["embeddings"]))
+    cache = HostRowCache(table_host, hot_rows=max(256, rows // 64),
+                         budget_bytes=2 * table_bytes, name="bench")
+    # skewed traffic: a small hot head + a zipf-ish tail, the
+    # recommendation-serving shape the frequency-keyed admission targets
+    hot_head = rng.permutation(rows)[:max(64, rows // 256)]
+    serve_B = 256
+    t0 = time.perf_counter()
+    for i in range(serve_batches):
+        if i % 2 == 0:
+            ids = rng.choice(hot_head, serve_B)
+        else:
+            ids = rng.integers(0, rows, serve_B)
+        np.asarray(cache.gather(ids))
+    dt = time.perf_counter() - t0
+    s = cache.stats()
+    out["serving"] = {"lookups_per_sec": round(serve_batches * serve_B / dt,
+                                               1),
+                      **{k: s[k] for k in ("hit_rate", "hits", "misses",
+                                           "evictions", "hot_rows",
+                                           "hot_bytes", "host_bytes")}}
+
+    # ---- incremental publish: 1% of rows touched -------------------------
+    with tempfile.TemporaryDirectory() as d:
+        host_params = jax.device_get(state["params"])
+        base = save_checkpoint(d, host_params, iteration=1, epoch=0)
+        touched = rng.permutation(rows)[:max(1, rows // 100)]
+        host_params["0_fusedpairembedding"]["embeddings"] = \
+            table_host.copy()
+        host_params["0_fusedpairembedding"]["embeddings"][touched] += 0.1
+        delta = save_row_delta(d, host_params, base, iteration=2,
+                               n_shards=n)
+        full_b = os.path.getsize(os.path.join(base, "state.npz"))
+        delta_b = os.path.getsize(os.path.join(delta, "state.npz"))
+        out["delta"] = {"rows_touched": int(touched.size),
+                        "touched_fraction": round(touched.size / rows, 4),
+                        "full_bytes": full_b, "delta_bytes": delta_b,
+                        "bytes_ratio": round(delta_b / full_b, 4)}
+    return out
+
+
 def run_generation_bench(quick: bool = False) -> dict:
     """Autoregressive generation serving bench (ISSUE 8) → GENERATION_BENCH.
 
@@ -2807,6 +2987,55 @@ if __name__ == "__main__":
                   + ", ".join(
                       f"dp={e['dp']} opt-ratio {e['opt_state_ratio']}"
                       for e in us["entries"]), file=sys.stderr)
+        sys.exit(0)
+    if "--embedding-child" in sys.argv:
+        # re-exec target of run_embedding: prints ONE JSON line
+        print(json.dumps(run_embedding(quick="--quick" in sys.argv)))
+        sys.exit(0)
+    if "--embedding" in sys.argv:
+        quick = "--quick" in sys.argv
+        eb = run_embedding(quick=quick)
+        if not quick:
+            # quick is the CI gate and never touches the committed artifact
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "EMBEDDING_BENCH.json"), "w") as f:
+                json.dump(eb, f, indent=1)
+        print(json.dumps(eb))
+        if quick:
+            tr = eb["train"]
+            # the scale invariant: a table 4x the per-device budget holds
+            # rows/8 per device (within sharding padding)
+            assert eb["table_over_budget"] >= 4.0, eb
+            assert tr["table_shard_ratio"] <= 1.0 / eb["shards"] * 1.05, (
+                f"table not row-sharded: {tr['table_shard_ratio']} of the "
+                f"full table per device (expected ~1/{eb['shards']})")
+            assert tr["moment_shard_ratio"] <= 1.0 / eb["shards"] * 1.05, (
+                f"Adam moments not shard-local: {tr['moment_shard_ratio']}")
+            # the model-parallel gather's collective pair must be in the
+            # compiled step: ids all-gathered to owner shards, rows returned
+            # via reduce-scatter (psum_scatter lowers to reduce-scatter)
+            cc = tr["collectives"]
+            assert cc.get("all-gather", 0) >= 1 \
+                and cc.get("reduce-scatter", 0) >= 1, (
+                    f"sharded-gather collective pair missing from HLO: {cc}")
+            # the shard-local gather block must fit the per-device budget
+            # the dense table breaks (empty findings IS the invariant)
+            assert not eb["gather_lint"], (
+                "sharded-gather memory findings:\n" + "\n".join(
+                    f"  {f['location']}: {f['message']}"
+                    for f in eb["gather_lint"]))
+            # serving tier works and actually caches
+            assert eb["serving"]["hits"] > 0 \
+                and eb["serving"]["hit_rate"] > 0.1, eb["serving"]
+            # incremental publish: ~1% rows touched ships <=5% of the bytes
+            assert eb["delta"]["touched_fraction"] <= 0.011
+            assert eb["delta"]["bytes_ratio"] <= 0.05, (
+                f"row delta not incremental: {eb['delta']}")
+            print("[bench] embedding quick gate OK: "
+                  f"{eb['rows']} rows x{eb['table_over_budget']} budget, "
+                  f"shard ratio {tr['table_shard_ratio']}, "
+                  f"delta ratio {eb['delta']['bytes_ratio']}",
+                  file=sys.stderr)
         sys.exit(0)
     if "--int8-dispatch" in sys.argv:
         # fused-quantization kernel tier bench (ISSUE 6): raw vs dispatch

@@ -16,6 +16,14 @@
 # killed between a publishing stream's prefill and its cache publish; the
 # respawn must re-admit the stream, publish an intact (never torn) chain,
 # leak zero pages.
+# The ISSUE-19 embedding tier rides the `embedding` marker: the
+# kill-mid-row-delta-swap drill (tests/test_row_delta.py — a replica dies
+# inside staging an incremental publish; zero requests lost, the respawn
+# force-converges through the delta's base checkpoint) and the host
+# hot-row cache tests (tests/test_rowcache.py), which run here WITH the
+# memory witness enabled so every HostRowCache records its host-tier bytes
+# + budget into $ZOO_TPU_MEM_WITNESS and the --mem-witness gate below
+# checks the cache against its declared budget.
 #
 #   scripts/run_chaos_suite.sh            # chaos + fleet + hotswap markers
 #   scripts/run_chaos_suite.sh -k broker  # usual pytest filters pass through
@@ -60,7 +68,7 @@ timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
     ZOO_TPU_MEM_WITNESS="$MEM_WITNESS" \
     ZOO_FLIGHT_DIR="$FLIGHT_DIR" \
     python -m pytest tests -q \
-    -m "chaos or fleet or hotswap or overload or prefix" \
+    -m "chaos or fleet or hotswap or overload or prefix or embedding" \
     -p no:cacheprovider "$@"
 
 # gate: every kill drill must have produced a flight dump, and every dump

@@ -10,6 +10,14 @@
 #      sharded optimizer state ≈ replicated/dp, one grad reduce-scatter per
 #      global step with collective counts constant in grad_accum_steps, and
 #      sharded-update step HBM ≤ replicated-update HBM.
+#   3. bench.py --embedding --quick (ISSUE 19) — trains + serves an
+#      embedding table 4x the per-device HBM budget, row-sharded P("dp")
+#      over the 8-way mesh; gates on per-device table AND Adam-moment bytes
+#      ≈ 1/8 of the full table, the sharded-gather collective pair
+#      (all-gather ids / reduce-scatter rows) present in the compiled step
+#      HLO, empty lint_sharded_gather hbm-budget findings for the
+#      shard-local gather block, a working host hot-row cache, and a
+#      1%-rows-touched row-delta publish shipping ≤5% of the full bytes.
 #
 # Usage: scripts/run_multichip_bench.sh [--quick] [output.json]
 # (--quick is the default and currently the only mode; it is accepted for
@@ -45,3 +53,8 @@ grep -q "step OK" "$dryrun_log" || {
 echo "[run_multichip_bench] update-sharding bench (gated)" >&2
 python bench.py --update-sharding --quick | tee "$OUT"
 echo "[run_multichip_bench] wrote $OUT" >&2
+
+EMB_OUT="${OUT%.json}_EMBEDDING.json"
+echo "[run_multichip_bench] embedding-scale bench (gated)" >&2
+python bench.py --embedding --quick | tee "$EMB_OUT"
+echo "[run_multichip_bench] wrote $EMB_OUT" >&2

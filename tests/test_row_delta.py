@@ -1,0 +1,470 @@
+"""Incremental row-delta publishing (ISSUE 19 tentpole part 3).
+
+A publish that touched 1% of an embedding table must ship ~1% of the bytes,
+apply in place on the serving replica with zero recompiles, stay fully
+validated (base version, per-shard row checksums, NaN scan), and roll back
+exactly like a full swap. Forward compat both ways: a PR-10-era manifest
+(no ``row_delta``) still stages and swaps; a delta against the wrong base is
+rejected with its own reason — and force-converges through the base
+checkpoint, which is how a replica respawned mid-rollout catches up.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from analytics_zoo_tpu.common import telemetry as tm
+from analytics_zoo_tpu.engine.checkpoint import (latest_checkpoint,
+                                                 save_checkpoint,
+                                                 save_row_delta,
+                                                 verify_checkpoint)
+from analytics_zoo_tpu.inference import InferenceModel
+from analytics_zoo_tpu.observability import events as ev
+from analytics_zoo_tpu.serving import ModelSwapper, SwapRejected
+from analytics_zoo_tpu.serving.hotswap import publish_record
+
+pytestmark = [pytest.mark.embedding, pytest.mark.hotswap]
+
+ROWS, WIDTH = 1000, 16
+
+
+def _params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"emb": rng.standard_normal((ROWS, WIDTH)).astype(np.float32),
+            "w": rng.standard_normal((WIDTH, 1)).astype(np.float32)}
+
+
+def _touch(params, rows, bump=1.0):
+    out = {"emb": params["emb"].copy(), "w": params["w"]}
+    out["emb"][np.asarray(rows)] += bump
+    return out
+
+
+def _model(params):
+    im = InferenceModel(max_batch_size=8)
+    im.load_fn(lambda p, s, x: p["emb"][x.astype(np.int32).ravel()] @ p["w"],
+               params=params)
+    return im
+
+
+def _lookup(im, rows):
+    x = np.asarray(rows, np.float32).reshape(-1, 1)
+    return np.asarray(im.predict(x))
+
+
+# --------------------------------------------------------------- the format
+def test_row_delta_is_small_and_self_describing(tmp_path):
+    """The acceptance bound: <=1% rows touched => <=5% of the full bytes."""
+    p0 = _params()
+    base = save_checkpoint(str(tmp_path), p0, iteration=1, epoch=0)
+    touched = [3, 500, 999, 42, 7, 650, 128, 129, 130, 777]   # 1% of rows
+    p1 = _touch(p0, touched)
+    delta = save_row_delta(str(tmp_path), p1, base, iteration=2, n_shards=4)
+
+    full_bytes = os.path.getsize(os.path.join(base, "state.npz"))
+    delta_bytes = os.path.getsize(os.path.join(delta, "state.npz"))
+    assert delta_bytes <= 0.05 * full_bytes, (delta_bytes, full_bytes)
+
+    m = verify_checkpoint(delta)            # file checksum verifies as-is
+    rd = m["row_delta"]
+    assert rd["base_version"] == verify_checkpoint(base)["version"]
+    assert rd["rows_touched"] == len(touched)
+    modes = {l["leaf"]: l["mode"] for l in rd["leaves"]}
+    by_mode = sorted(modes.values())
+    assert by_mode == ["rows", "same"]      # emb as rows, w untouched
+    (rows_leaf,) = [l for l in rd["leaves"] if l["mode"] == "rows"]
+    assert rows_leaf["count"] == len(touched)
+    assert rows_leaf["rows_total"] == ROWS
+    assert sum(s["count"] for s in rows_leaf["shards"]) == len(touched)
+    # delta dirs never masquerade as resumable checkpoints
+    assert latest_checkpoint(str(tmp_path)) == base
+
+
+def test_row_delta_full_fallback_when_most_rows_touched(tmp_path):
+    p0 = _params()
+    base = save_checkpoint(str(tmp_path), p0, iteration=1, epoch=0)
+    p1 = _touch(p0, list(range(ROWS)))      # everything moved
+    delta = save_row_delta(str(tmp_path), p1, base, iteration=2)
+    modes = {l["leaf"]: l["mode"]
+             for l in verify_checkpoint(delta)["row_delta"]["leaves"]}
+    assert "full" in modes.values() and "rows" not in modes.values()
+
+
+def test_row_delta_refuses_mismatched_base(tmp_path):
+    p0 = _params()
+    base = save_checkpoint(str(tmp_path), p0, iteration=1, epoch=0)
+    bad = {"emb": np.zeros((10, WIDTH), np.float32), "w": p0["w"]}
+    with pytest.raises(ValueError, match="signature-identical"):
+        save_row_delta(str(tmp_path), bad, base, iteration=2)
+
+
+# ----------------------------------------------------------- swap in place
+def test_swapper_applies_delta_without_recompile(tmp_path, zoo_ctx):
+    p0 = _params()
+    base = save_checkpoint(str(tmp_path), p0, iteration=1, epoch=0)
+    im = _model(p0)
+    sw = ModelSwapper(im, warmup=False)
+    sw.stage_and_swap(publish_record(base))
+    _lookup(im, [7, 42, 3])                 # compile the batch bucket
+
+    p1 = _touch(p0, [7, 42])
+    delta = save_row_delta(str(tmp_path), p1, base, iteration=2)
+    rec = publish_record(delta)
+    assert rec["delta"] is True and rec["rows_touched"] == 2
+
+    compiles = tm.snapshot()["zoo_infer_compiles_total"]["samples"][""]
+    v2 = sw.stage_and_swap(rec)
+    assert im.version == v2
+    got = _lookup(im, [7, 42, 3])
+    np.testing.assert_allclose(got, p1["emb"][[7, 42, 3]] @ p1["w"],
+                               rtol=1e-6)
+    # the patched leaves kept their avals: same executable keeps serving
+    assert tm.snapshot()["zoo_infer_compiles_total"]["samples"][""] \
+        == compiles
+    # the in-place patch is an auditable decision event
+    evts = [e for e in ev.events(kind="swap.row_delta")
+            if e.fields.get("version") == v2]
+    assert evts and evts[-1].fields["rows"] == 2
+    assert evts[-1].fields["base"] == rec["base_version"]
+
+
+def test_swapper_rollback_undoes_delta(tmp_path, zoo_ctx):
+    p0 = _params()
+    base = save_checkpoint(str(tmp_path), p0, iteration=1, epoch=0)
+    im = _model(p0)
+    sw = ModelSwapper(im, warmup=False)
+    v1 = sw.stage_and_swap(publish_record(base))
+    p1 = _touch(p0, [11])
+    delta = save_row_delta(str(tmp_path), p1, base, iteration=2)
+    sw.stage_and_swap(publish_record(delta))
+    assert sw.rollback() == v1
+    np.testing.assert_allclose(_lookup(im, [11]), p0["emb"][[11]] @ p0["w"],
+                               rtol=1e-6)
+
+
+# ------------------------------------------------- forward compat + safety
+def test_pr10_era_manifest_still_stages_and_swaps(tmp_path, zoo_ctx):
+    """A manifest with no ``row_delta`` key (every checkpoint written before
+    this PR) takes the full-checkpoint path untouched, and its publish
+    record carries no delta fields."""
+    p0 = _params()
+    path = save_checkpoint(str(tmp_path), p0, iteration=1, epoch=0)
+    with open(os.path.join(path, "manifest.json")) as f:
+        assert "row_delta" not in json.load(f)
+    rec = publish_record(path)
+    assert "delta" not in rec and "base_version" not in rec
+    im = _model(_params(seed=9))            # different weights, same avals
+    sw = ModelSwapper(im, warmup=False)
+    sw.stage_and_swap(rec)
+    np.testing.assert_allclose(_lookup(im, [5]), p0["emb"][[5]] @ p0["w"],
+                               rtol=1e-6)
+
+
+def test_delta_against_wrong_base_rejected(tmp_path, zoo_ctx):
+    """Non-force polarity: a replica not serving the delta's base refuses
+    the patch with its own reason, live params untouched."""
+    p0 = _params()
+    base = save_checkpoint(str(tmp_path / "a"), p0, iteration=1, epoch=0)
+    other = save_checkpoint(str(tmp_path / "b"), _touch(p0, [1]),
+                            iteration=2, epoch=0)
+    im = _model(p0)
+    sw = ModelSwapper(im, warmup=False)
+    sw.stage_and_swap(publish_record(base))
+    v_base = im.version
+    p1 = _touch(p0, [4, 5])
+    delta = save_row_delta(str(tmp_path / "b"), p1, other, iteration=3)
+    with pytest.raises(SwapRejected) as ei:
+        sw.stage_and_swap(publish_record(delta))
+    assert ei.value.reason == "base"
+    assert im.version == v_base
+    rejects = tm.snapshot()["zoo_swap_validation_failures_total"]["samples"]
+    assert rejects.get("base", 0) >= 1
+
+
+def test_forced_delta_converges_through_base(tmp_path, zoo_ctx):
+    """Force polarity (the reconciler path): a replica on boot params
+    full-swaps the delta's base checkpoint, then applies the delta on top —
+    ending on the delta version with the delta's rows."""
+    p0 = _params()
+    base = save_checkpoint(str(tmp_path), p0, iteration=1, epoch=0)
+    p1 = _touch(p0, [0, 999])
+    delta = save_row_delta(str(tmp_path), p1, base, iteration=2)
+    im = _model(_params(seed=9))            # boot params, never saw base
+    sw = ModelSwapper(im, warmup=False)
+    rec = publish_record(delta)
+    with pytest.raises(SwapRejected):       # non-force: still a rejection
+        sw.stage_and_swap(rec)
+    v = sw.stage_and_swap(rec, force=True)
+    assert im.version == v and v == rec["version"]
+    np.testing.assert_allclose(_lookup(im, [0, 999, 50]),
+                               p1["emb"][[0, 999, 50]] @ p1["w"], rtol=1e-6)
+
+
+def test_delta_shard_checksum_tamper_rejected(tmp_path, zoo_ctx):
+    p0 = _params()
+    base = save_checkpoint(str(tmp_path), p0, iteration=1, epoch=0)
+    im = _model(p0)
+    sw = ModelSwapper(im, warmup=False)
+    sw.stage_and_swap(publish_record(base))
+    delta = save_row_delta(str(tmp_path), _touch(p0, [8]), base, iteration=2)
+    mpath = os.path.join(delta, "manifest.json")
+    with open(mpath) as f:
+        m = json.load(f)
+    for leaf in m["row_delta"]["leaves"]:
+        for s in leaf.get("shards", []):
+            s["checksum"] = "0" * 16
+    with open(mpath, "w") as f:
+        json.dump(m, f)
+    with pytest.raises(SwapRejected) as ei:
+        sw.stage_and_swap(publish_record(delta))
+    assert ei.value.reason == "checksum"
+
+
+def test_delta_with_nan_rows_rejected(tmp_path, zoo_ctx):
+    p0 = _params()
+    base = save_checkpoint(str(tmp_path), p0, iteration=1, epoch=0)
+    im = _model(p0)
+    sw = ModelSwapper(im, warmup=False)
+    sw.stage_and_swap(publish_record(base))
+    p1 = {"emb": p0["emb"].copy(), "w": p0["w"]}
+    p1["emb"][13] = np.nan                  # poisoned row IS a touched row
+    delta = save_row_delta(str(tmp_path), p1, base, iteration=2)
+    with pytest.raises(SwapRejected) as ei:
+        sw.stage_and_swap(publish_record(delta))
+    assert ei.value.reason == "nan"
+    np.testing.assert_allclose(_lookup(im, [13]), p0["emb"][[13]] @ p0["w"],
+                               rtol=1e-6)
+
+
+def test_base_mismatch_rejection_reaches_trainer_stream(tmp_path, zoo_ctx):
+    """Fleet-visible polarity: the serving engine rejects the mismatched
+    delta, keeps serving its current version, and the trainer reads the
+    rejection off ``model_rejections`` instead of believing it deployed."""
+    import time
+
+    from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                           ModelPublisher, OutputQueue,
+                                           ServingConfig, start_broker)
+
+    def _wait(pred, timeout_s=20.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.05)
+        return pred()
+
+    p0 = _params()
+    broker = start_broker()
+    eng = None
+    try:
+        cfg = ServingConfig(queue_port=broker.port, batch_size=4,
+                            batch_timeout_ms=2, warmup_shape=(1,),
+                            swap_warmup=False)
+        eng = ClusterServing(_model(p0), config=cfg).start()
+        pub = ModelPublisher(port=broker.port)
+        base = save_checkpoint(str(tmp_path / "a"), p0, iteration=1, epoch=0)
+        rec = pub.publish(base)
+        assert _wait(lambda: eng.model_version == rec["version"]), \
+            (eng.model_version, eng._swap_state, eng._swap_error)
+
+        other = save_checkpoint(str(tmp_path / "b"), _touch(p0, [1]),
+                                iteration=2, epoch=0)
+        delta = save_row_delta(str(tmp_path / "b"), _touch(p0, [1, 2]),
+                               other, iteration=3)
+        drec = pub.publish(delta)
+        assert _wait(lambda: eng._swap_state == "error")
+        assert "base" in eng._swap_error
+        assert eng.model_version == rec["version"]    # still on the good one
+        iq, oq = InputQueue(port=broker.port), OutputQueue(port=broker.port)
+        u = iq.enqueue(None, input=np.asarray([5.0], np.float32))
+        np.testing.assert_allclose(np.ravel(oq.query(u, timeout_s=15)),
+                                   np.ravel(p0["emb"][[5]] @ p0["w"]),
+                                   rtol=1e-5)
+        rej = pub.check_rejections()
+        assert any(r["version"] == drec["version"] and "base" in r["reason"]
+                   for r in rej), rej
+        iq.close()
+        oq.close()
+        pub.close()
+    finally:
+        if eng is not None:
+            eng.stop()
+        broker.shutdown()
+
+
+def test_quantized_model_refuses_delta(tmp_path, zoo_ctx):
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(64, 64)).astype(np.float32)
+    im = InferenceModel(max_batch_size=4)
+    im.load_fn(lambda p, s, x: x @ p["w"], params={"w": w})
+    im.quantize_int8(min_elements=1)
+    with pytest.raises(RuntimeError, match="int8"):
+        im.apply_row_delta([(0, np.asarray([0]), w[:1] * 2)])
+
+
+@pytest.mark.chaos
+def test_kill_replica_mid_row_delta_swap_zero_loss(tmp_path, zoo_ctx):
+    """The ISSUE-19 chaos drill. A 2-replica fleet converged on a full
+    checkpoint; a row-delta publish arrives and the canary is chaos-killed
+    INSIDE staging it (the swap.stage site). The rollout must abort with
+    zero lost requests and the fleet re-converge on the base. A later delta
+    then promotes normally, and a replica killed AFTER promotion respawns
+    on boot params and force-converges through the delta's base checkpoint
+    back onto the delta version — every response throughout attributable to
+    exactly one good (version, value) pair."""
+    import threading
+    import time
+
+    from analytics_zoo_tpu.common.chaos import ChaosSchedule
+    from analytics_zoo_tpu.serving import (FleetSupervisor, InputQueue,
+                                           ModelPublisher, OutputQueue,
+                                           ServingConfig, start_broker)
+
+    emb0 = _params()["emb"]
+    W4 = np.ones((4, 1), np.float32)
+
+    def mk_params(b, emb=emb0):
+        return {"w": W4, "b": np.array([b], np.float32), "emb": emb}
+
+    def factory(b=0.0):
+        im = InferenceModel(max_batch_size=8)
+        im.load_fn(lambda p, s, x: x @ p["w"] + p["b"], params=mk_params(b))
+        return im
+
+    def _wait(pred, timeout_s=30.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.05)
+        return pred()
+
+    def converged(fleet, version):
+        mv = fleet.model_versions()
+        return (mv and all(v == version for v in mv.values())
+                and fleet.rollout.state()["phase"] == "idle")
+
+    broker = start_broker()
+    fleet = None
+    results, stop = [], threading.Event()
+    lock = threading.Lock()
+
+    def loader(start):
+        iq, oq = InputQueue(port=broker.port), OutputQueue(port=broker.port)
+        i = start
+        try:
+            while not stop.is_set():
+                u = iq.enqueue(None, input=np.full((4,), float(i),
+                                                   np.float32))
+                try:
+                    v = oq.query(u, timeout_s=30)
+                    rec = (i, float(np.ravel(v)[0]), oq.last_model_version)
+                except Exception as e:
+                    rec = (i, None, repr(e))
+                with lock:
+                    results.append(rec)
+                i += 2
+        finally:
+            iq.close()
+            oq.close()
+
+    try:
+        cfg = ServingConfig(queue_port=broker.port, replicas=2, batch_size=4,
+                            batch_timeout_ms=2, fleet_heartbeat_s=0.1,
+                            fleet_failover_timeout_s=0.8,
+                            fleet_spawn_grace_s=10.0, warmup_shape=(4,),
+                            rollout_window_s=0.3, rollout_min_requests=3,
+                            rollout_canary_fraction=0.34, swap_timeout_s=10.0)
+        fleet = FleetSupervisor(cfg, model_factory=factory).start()
+        assert fleet.wait_eligible(2, timeout_s=15)
+        pub = ModelPublisher(port=broker.port)
+        base = save_checkpoint(str(tmp_path), mk_params(1000.0), iteration=1,
+                               epoch=0)
+        rec1 = pub.publish(base)
+        assert _wait(lambda: converged(fleet, rec1["version"]))
+
+        threads = [threading.Thread(target=loader, args=(k,), daemon=True)
+                   for k in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+
+        # ---- phase A: kill the canary INSIDE row-delta staging ----------
+        sched = ChaosSchedule(seed=3).kill("swap.stage", at=1)
+        with sched:
+            d2 = save_row_delta(str(tmp_path),
+                                mk_params(2000.0, _touch(
+                                    mk_params(1000.0), [5, 9])["emb"]),
+                                base, iteration=2)
+            rec2 = pub.publish(d2)
+            assert _wait(lambda: any(v == rec2["version"]
+                                     for v, _ in fleet.rollout.outcomes)), \
+                fleet.rollout.state()
+            outcome = dict(fleet.rollout.outcomes)[rec2["version"]]
+            assert outcome in ("aborted", "rolled_back")
+            assert _wait(lambda: fleet.respawns >= 1, timeout_s=20)
+            assert _wait(lambda: converged(fleet, rec1["version"])
+                         and len(fleet.router.eligible_ids()) == 2), \
+                (fleet.model_versions(), fleet.rollout.state())
+
+        # ---- phase B: a clean delta promotes fleet-wide -----------------
+        d3 = save_row_delta(str(tmp_path),
+                            mk_params(3000.0, _touch(
+                                mk_params(1000.0), [8, 70])["emb"]),
+                            base, iteration=3)
+        rec3 = pub.publish(d3)
+        assert _wait(lambda: converged(fleet, rec3["version"])), \
+            (fleet.model_versions(), fleet.rollout.state())
+        assert (rec3["version"], "promoted") in fleet.rollout.outcomes
+
+        # ---- phase C: kill after promotion; respawn converges THROUGH
+        # the delta's base checkpoint onto the delta version --------------
+        respawns = fleet.respawns
+        fleet.kill_replica(fleet.router.replica_ids()[0])
+        assert _wait(lambda: fleet.respawns > respawns, timeout_s=20)
+        assert _wait(lambda: converged(fleet, rec3["version"])
+                     and len(fleet.router.eligible_ids()) == 2), \
+            fleet.model_versions()
+        time.sleep(0.3)
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+
+        # ---- zero loss, every answer attributable -----------------------
+        offsets = {"initial": 0.0, rec1["version"]: 1000.0,
+                   rec3["version"]: 3000.0}
+        assert results, "load recorded nothing"
+        for i, val, ver in results:
+            assert val is not None, f"request {i} failed: {ver}"
+            assert ver in offsets, \
+                f"request {i} served by unexpected version {ver}"
+            assert val == 4.0 * i + offsets[ver], (i, val, ver)
+        # the killed delta never served a single response
+        assert all(ver != rec2["version"] for _, _, ver in results)
+        # the aborted delta is trainer-visible on the rejection stream
+        rej = pub.check_rejections()
+        assert any(r["version"] == rec2["version"] for r in rej), rej
+        pub.close()
+    finally:
+        stop.set()
+        if fleet is not None:
+            fleet.stop(drain_s=2.0)
+        broker.shutdown()
+
+
+def test_row_delta_dirs_garbage_collected(tmp_path):
+    p0 = _params()
+    base = save_checkpoint(str(tmp_path), p0, iteration=1, epoch=0)
+    paths = [save_row_delta(str(tmp_path), _touch(p0, [i]), base,
+                            iteration=10 + i, keep=2) for i in range(4)]
+    names = set(os.listdir(str(tmp_path)))
+    assert os.path.basename(paths[0]) not in names
+    assert os.path.basename(paths[1]) not in names
+    assert {os.path.basename(p) for p in paths[2:]} <= names
+    assert os.path.basename(base) in names  # full snapshots GC'd separately

@@ -1,0 +1,179 @@
+"""Host hot-row cache + FeatureSet.row_slice (ISSUE 19 tentpole part 2).
+
+The cache must be a pure view: any id sequence gathered through the two-tier
+store must come back byte-identical to a plain in-DRAM ``table[ids]``,
+whatever the hit/miss/eviction history — so every test asserts byte
+equality, then the tier behavior (frequency admission, eviction, metrics,
+witness budget) on top.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import memwitness as mw
+from analytics_zoo_tpu.common import telemetry as tm
+from analytics_zoo_tpu.data import FeatureSet, MemoryType
+from analytics_zoo_tpu.serving.rowcache import HostRowCache, cache_stats
+
+pytestmark = pytest.mark.embedding
+
+
+def _table(rows=64, width=8, seed=0):
+    return np.random.default_rng(seed).standard_normal(
+        (rows, width)).astype(np.float32)
+
+
+# ----------------------------------------------------- FeatureSet.row_slice
+def test_row_slice_memmap_byte_exact_vs_dram():
+    """Satellite: random-access memmap reads == the in-DRAM gather, bytes."""
+    x = _table(rows=128, width=16)
+    dram = FeatureSet({"x": x}, memory_type=MemoryType.DRAM)
+    disk = FeatureSet({"x": x}, memory_type=MemoryType.DISK_AND_DRAM(4))
+    idx = np.asarray([0, 127, 3, 3, 77, 1, 64, 63], np.int64)
+    a = dram.row_slice(idx)["x"]
+    b = disk.row_slice(idx)["x"]
+    np.testing.assert_array_equal(a, x[idx])
+    np.testing.assert_array_equal(a.tobytes(), b.tobytes())
+    assert b.flags["C_CONTIGUOUS"]
+
+
+def test_row_slice_validates_indices():
+    fs = FeatureSet({"x": _table(8, 2)})
+    with pytest.raises(ValueError, match="1-D"):
+        fs.row_slice(np.zeros((2, 2), np.int64))
+    with pytest.raises(ValueError, match="integer"):
+        fs.row_slice(np.asarray([0.5]))
+    with pytest.raises(IndexError, match="out of range"):
+        fs.row_slice(np.asarray([8]))
+    with pytest.raises(IndexError, match="out of range"):
+        fs.row_slice(np.asarray([-1]))
+
+
+# ----------------------------------------------------------- gather parity
+def test_cache_gather_byte_exact_through_any_history(zoo_ctx):
+    table = _table(rows=64, width=8)
+    cache = HostRowCache(table, hot_rows=8, name="t_parity")
+    rng = np.random.default_rng(1)
+    for _ in range(6):
+        ids = rng.integers(0, 64, rng.integers(1, 40))
+        got = np.asarray(cache.gather(ids))
+        np.testing.assert_array_equal(got.tobytes(), table[ids].tobytes())
+
+
+def test_cache_hot_tier_fills_and_hits(zoo_ctx):
+    table = _table(rows=32, width=4)
+    cache = HostRowCache(table, hot_rows=4, name="t_hot")
+    ids = np.asarray([1, 2, 3, 5])
+    cache.gather(ids)                       # all misses, all admitted
+    s = cache.stats()
+    assert s["misses"] == 4 and s["hot_rows"] == 4
+    cache.gather(ids)                       # pure hot pass
+    s = cache.stats()
+    assert s["hits"] == 4 and s["misses"] == 4
+    assert s["hit_rate"] == 0.5
+
+
+def test_cache_frequency_keyed_eviction(zoo_ctx):
+    """A row looked up often displaces a colder pinned row; a one-shot
+    tail id cannot flush a hot head row."""
+    table = _table(rows=32, width=4)
+    cache = HostRowCache(table, hot_rows=2, name="t_evict")
+    for _ in range(3):
+        cache.gather([7])                   # freq(7)=3, pinned
+    cache.gather([9, 11])                   # fills the second slot, evicts
+    before = cache.stats()["evictions"]
+    cache.gather([13])                      # freq 1: cannot displace 7
+    cache.gather([7])
+    assert cache.stats()["hits"] >= 3       # 7 stayed pinned throughout
+    for _ in range(5):
+        cache.gather([13])                  # now hotter than 9/11
+    assert cache.stats()["evictions"] > before
+    np.testing.assert_array_equal(
+        np.asarray(cache.gather([13]))[0], table[13])
+
+
+# -------------------------------------------------------------- row deltas
+def test_cache_apply_row_delta_updates_both_tiers(zoo_ctx):
+    table = _table(rows=32, width=4)
+    cache = HostRowCache(table, hot_rows=4, name="t_delta")
+    cache.gather([3, 8])                    # pin 3 and 8
+    new_rows = np.full((2, 4), 9.5, np.float32)
+    refreshed = cache.apply_row_delta([3, 20], new_rows)
+    assert refreshed == 1                   # only 3 was pinned
+    got = np.asarray(cache.gather([3, 20, 8]))
+    np.testing.assert_array_equal(got[0], new_rows[0])
+    np.testing.assert_array_equal(got[1], new_rows[1])
+    np.testing.assert_array_equal(got[2], table[8])
+
+
+def test_cache_rejects_bad_delta_shape(zoo_ctx):
+    cache = HostRowCache(_table(8, 4), hot_rows=2, name="t_badshape")
+    with pytest.raises(ValueError, match="row delta shape"):
+        cache.apply_row_delta([0, 1], np.zeros((2, 5), np.float32))
+
+
+# ------------------------------------------------------- metrics + witness
+def test_cache_metrics_and_debug_surface(zoo_ctx):
+    def lookups(tier):
+        return tm.snapshot()["zoo_embed_cache_lookups_total"][
+            "samples"].get(tier, 0)
+
+    before_hot, before_cold = lookups("hot"), lookups("cold")
+    cache = HostRowCache(_table(16, 4), hot_rows=4, name="t_metrics")
+    cache.gather([0, 1])
+    cache.gather([0, 1])
+    assert lookups("cold") == before_cold + 2
+    assert lookups("hot") == before_hot + 2
+    assert tm.snapshot()["zoo_embed_cache_hot_rows"]["samples"][
+        "t_metrics"] == 2
+    assert cache_stats()["t_metrics"]["hits"] == 2
+    from analytics_zoo_tpu.observability.debug import DebugSurface
+    code, ctype, body, _ = DebugSurface().handle("/debug/rowcache")
+    assert code == 200 and b"t_metrics" in body
+
+
+def test_cache_budget_gated_by_ambient_witness(zoo_ctx):
+    """Rides the chaos suite's ambient ZOO_TPU_MEM_WITNESS (no monkeypatch):
+    this cache's host-tier bytes AND its declared budget land in the suite's
+    witness dump, so the suite-level ``--mem-witness`` gate checks the cache
+    against its budget for real. Standalone it is a plain stats smoke."""
+    table = _table(rows=64, width=8)
+    cache = HostRowCache(table, hot_rows=8, name="t_suite_budget",
+                         budget_bytes=4 * table.nbytes)
+    cache.gather([1, 2, 3, 40])
+    s = cache.stats()
+    assert s["budget_bytes"] == 4 * table.nbytes
+    assert 0 < s["host_bytes"] <= s["budget_bytes"]
+    if os.environ.get("ZOO_TPU_MEM_WITNESS"):
+        statics = mw.witness_statics().get("serving.rowcache.host", {})
+        assert statics.get("budget_bytes")  # the suite gate will see it
+
+
+def test_cache_reports_host_bytes_to_memory_witness(zoo_ctx, tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_MEM_WITNESS", str(tmp_path / "w.jsonl"))
+    mw.reset_witness()
+    try:
+        table = _table(rows=64, width=8)
+        cache = HostRowCache(table, hot_rows=4, name="t_witness",
+                             budget_bytes=table.nbytes * 2)
+        cache.gather([0, 5])
+        statics = mw.witness_statics()["serving.rowcache.host"]
+        assert statics["budget_bytes"] == table.nbytes * 2
+        assert statics["peak_bytes"] >= table.nbytes
+        samples = mw.witness_samples()["serving.rowcache.host"]
+        assert samples["max_live_bytes"] >= table.nbytes
+        # replay through the analysis gate: in budget -> no findings
+        from analytics_zoo_tpu.analysis.memory import check_memory_witness
+        assert check_memory_witness(mw.witness_samples(),
+                                    mw.witness_statics()) == []
+        # over budget -> hbm-budget finding
+        mw.note_static("serving.rowcache.host", table.nbytes,
+                       budget_bytes=1)
+        findings = check_memory_witness(mw.witness_samples(),
+                                        mw.witness_statics())
+        assert any(f.rule == "hbm-budget" for f in findings)
+    finally:
+        mw.reset_witness()

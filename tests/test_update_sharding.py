@@ -294,7 +294,13 @@ def test_shard_spec_over_axis_rules(zoo_ctx):
         np.array(jax.devices()).reshape((2, 2, 2) + (1,) * 3), AXES)
     f = upd.shard_spec_over_axis
     assert f(P(), (64, 8), mesh, "dp") == P("dp", None)
-    assert f(P(), (8, 64), mesh, "dp") == P(None, "dp")
+    # 2-D row preference: the row dim wins even when the column dim is larger
+    # — an oblong (vocab, embed) table with embed > vocab/shards must still
+    # shard by rows so the sharded-gather/row-delta paths stay row-keyed
+    assert f(P(), (8, 64), mesh, "dp") == P("dp", None)
+    assert f(P(), (6, 4096), mesh, "dp") == P("dp", None)
+    # rows not divisible → falls back to the column dim
+    assert f(P(), (7, 64), mesh, "dp") == P(None, "dp")
     # composes: appends dp to an fsdp-sharded dim when it still divides
     assert f(P("fsdp", "tp"), (7, 64), mesh, "dp") == P("fsdp", ("tp", "dp"))
     # nothing divides → unchanged (replicated update for the leaf)
@@ -303,6 +309,8 @@ def test_shard_spec_over_axis_rules(zoo_ctx):
     assert f(P(), (), mesh, "dp") == P()
     # already dp-sharded → unchanged
     assert f(P("dp", None), (4, 4), mesh, "dp") == P("dp", None)
+    # 3-D and above keep largest-first selection
+    assert f(P(), (4, 64, 8), mesh, "dp") == P(None, "dp", None)
 
 
 # --------------------------------------------------------- sharding satellite
