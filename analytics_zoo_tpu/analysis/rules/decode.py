@@ -124,6 +124,7 @@ def _aval_nbytes(aval) -> Optional[int]:
 
 def lint_decode_stability(model, params, cache_cfg, cache, *,
                           top_k: int = 0, spec_k: int = 0,
+                          chunk_tokens: int = 0,
                           where: str = "serving.generation",
                           ctx: Optional[RuleContext] = None,
                           donate_cache: Optional[bool] = None,
@@ -141,6 +142,13 @@ def lint_decode_stability(model, params, cache_cfg, cache, *,
     through with identical (shape, dtype), no intermediate outgrows the
     cache, no host transfers, and exactly one compiled executable per
     (k, slot-count) since ids (B, k) is the only aval that varies with k.
+
+    ``chunk_tokens > 0`` ADDITIONALLY lints the chunked-prefill executable
+    (``model.prefill_chunk`` at B=1, chunk width ``chunk_tokens``, the wide
+    page table chunk dispatch uses) under the same invariants — the cache
+    threads through unchanged and the chunk donates the pool too (ONE
+    compiled chunk shape per (chunk_tokens, slot), no per-chunk copy of the
+    pool); its findings are appended to the decode/verify step's.
 
     ``donate_cache`` states whether the dispatch donates the cache argument;
     when given, the memory tier runs too — ``cache-alias`` (un-donated pool
@@ -189,6 +197,29 @@ def lint_decode_stability(model, params, cache_cfg, cache, *,
                                     else [])
     ctx = RuleContext(**{**ctx.__dict__, **updates})
     findings = lint_jaxpr(closed, ctx=ctx, rules=rules)
+    if chunk_tokens > 0:
+        # the chunked-prefill executable: B=1, fixed chunk width, and the
+        # WIDE table (pages_per_slot + chunk_tokens/page_size entries) the
+        # dispatch pads with scratch so the final chunk of a max-length
+        # prompt never indexes past the row
+        wide = (cache_cfg.pages_per_slot
+                + chunk_tokens // cache_cfg.page_size)
+        chunk_closed = jax.make_jaxpr(
+            lambda p, c, ids, nd, nv, tb: model.prefill_chunk(
+                p, c, ids, nd, nv, tb,
+                page_size=cache_cfg.page_size))(
+            params, cache, i32((1, chunk_tokens)), i32((1,)), i32((1,)),
+            i32((1, wide)))
+        chunk_updates = dict(updates)
+        if donate_cache is not None:
+            # flattened positional signature: params, cache, then 4 int rows
+            chunk_updates["donated_invars"] = (
+                [False] * len(jtu.tree_leaves(params))
+                + [donate_cache] * len(jtu.tree_leaves(cache))
+                + [False] * 4)
+        chunk_ctx = RuleContext(**{**ctx.__dict__, **chunk_updates})
+        findings = findings + lint_jaxpr(chunk_closed, ctx=chunk_ctx,
+                                         rules=rules)
     if note_static_site:
         from ...common import memwitness as _mw
 

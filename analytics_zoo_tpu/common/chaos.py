@@ -192,6 +192,9 @@ KNOWN_SITES = {
     "host.heartbeat",     # serving/hostagent.py agent hb/reconcile round
     "overload.shed",      # deadline/admission sheds at every serving tier
                           # (frontend, router, micro-batcher, gen batcher)
+    "prefill.chunk",      # serving/generation.py before each chunked-prefill
+                          # dispatch (kill-mid-chunk drill: pool conservation
+                          # + idempotent chunk re-dispatch after respawn)
     "prefix.publish",     # serving/generation.py between a stream's prefill
                           # compute and its prefix-cache publish (torn-entry
                           # / page-leak drill)

@@ -232,11 +232,38 @@ class TransformerLM(Layer, KerasNet):
         :meth:`prefill` (modulo write path); the warm/cold bit-identity
         tests pin that equivalence.
         """
-        ids = jnp.asarray(ids, jnp.int32)
         start = jnp.asarray(start, jnp.int32)
         lengths = jnp.asarray(lengths, jnp.int32)
+        return self.prefill_chunk(params, cache, ids, start, lengths - start,
+                                  table, page_size=page_size)
+
+    def prefill_chunk(self, params, cache, ids, n_done, n_valid, table, *,
+                      page_size: int):
+        """One fixed-shape prefill CHUNK: run ``ids`` against a cache that
+        already holds ``n_done`` tokens of the same prompt — the
+        :meth:`prefill_from` machinery generalized from "resume after a
+        cached prefix" to "resume after any boundary", so a long prompt is
+        many identical chunk dispatches instead of one whole-prompt bucket.
+
+        ``ids``: (B, chunk_tokens) int32 — tokens at positions ``n_done ..
+        n_done + chunk_tokens - 1``, right-padded past ``n_valid``;
+        ``n_done``: (B,) int32 — tokens already written to the cache (page
+        boundary NOT required: a chunk may start mid-page, the verify-step
+        write path scatters per position); ``n_valid``: (B,) int32 — true
+        tokens in this chunk (``<= chunk_tokens``; the final chunk of a
+        prompt is short). ``table`` must be wide enough for every position
+        this chunk writes (``(n_done + chunk_tokens - 1) // page_size + 1``
+        pages) with entries past the allocated rows pointing at scratch —
+        padding-lane K/V land in scratch and their keys read back masked,
+        so they contribute exactly 0.0 to every softmax (bit-neutral).
+        Returns ``(logits (B, V) f32 — at position ``n_done + n_valid - 1``,
+        cache)``; compiled ONCE per (chunk_tokens, B).
+        """
+        ids = jnp.asarray(ids, jnp.int32)
+        n_done = jnp.asarray(n_done, jnp.int32)
+        n_valid = jnp.asarray(n_valid, jnp.int32)
         t = ids.shape[1]
-        positions = start[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+        positions = n_done[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
         h = jnp.take(params["token_embeddings"], ids, axis=0)
         h = h + jnp.take(params["pos_embeddings"], positions, axis=0)
         h = as_compute(h)
@@ -244,11 +271,11 @@ class TransformerLM(Layer, KerasNet):
         for i, blk in enumerate(self.blocks):
             h, kp, vp = blk.verify_step(
                 params[f"block{i}"], h, k_cache[i], v_cache[i], table,
-                start, page_size=page_size)
+                n_done, page_size=page_size)
             k_cache = k_cache.at[i].set(kp)
             v_cache = v_cache.at[i].set(vp)
         h, _ = self.ln_f.apply(params["ln_f"], {}, h)
-        last_row = jnp.maximum(lengths - 1 - start, 0)
+        last_row = jnp.maximum(n_valid - 1, 0)
         last = jnp.take_along_axis(
             h, last_row[:, None, None].astype(jnp.int32), axis=1)[:, 0]
         logits = last @ jnp.asarray(params["logits_kernel"], last.dtype)

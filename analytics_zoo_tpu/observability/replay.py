@@ -82,7 +82,9 @@ class IncumbentPolicy(Policy):
     through :func:`~..serving.qos.autoscale_decision` seeded from the
     debounce-state snapshot embedded in each record — every tick is a pure
     function of its own recorded inputs, so exactness survives ring
-    truncation mid-stream."""
+    truncation mid-stream; prefill-budget records go back through
+    :func:`~..serving.qos.prefill_budget_decision` (the chunked-prefill
+    token budget the decode loop spends each iteration)."""
 
     name = "incumbent"
 
@@ -96,6 +98,8 @@ class IncumbentPolicy(Policy):
                          or {"pressure_since": None, "idle_since": None,
                              "last_event_t": 0.0})
             return _qos.autoscale_decision(inputs, state)
+        if site == "gen.prefill.budget":
+            return _qos.prefill_budget_decision(inputs)
         return None
 
 

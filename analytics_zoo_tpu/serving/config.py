@@ -71,6 +71,15 @@ class ServingConfig:
     gen_prefix_block_tokens: int = 0     # tokens per content-hashed prefix
                                          # block (0 = one page; must be a
                                          # positive multiple of page_size)
+    gen_prefill_chunk_tokens: int = 0    # chunked prefill: tokens per chunk
+                                         # (0 = whole-prompt prefill; must be
+                                         # a positive multiple of page_size —
+                                         # ONE compiled chunk executable)
+    gen_prefill_token_budget: int = 0    # max prefill tokens spent per decode
+                                         # loop iteration (0 = one chunk per
+                                         # iteration; overridden by an ITL
+                                         # SLO objective when one is declared
+                                         # — see qos.prefill_budget_from_slo)
     # --- replica fleet (serving/fleet.py) ---
     replicas: int = 1                    # engine replicas behind the router
                                          # (1 = classic single-engine stack)
@@ -238,7 +247,9 @@ class ServingConfig:
                        ("gen_spec_k", "spec_k"),
                        ("gen_spec_ngram", "spec_ngram"),
                        ("gen_prefix_cache_pages", "prefix_cache_pages"),
-                       ("gen_prefix_block_tokens", "prefix_block_tokens"))
+                       ("gen_prefix_block_tokens", "prefix_block_tokens"),
+                       ("gen_prefill_chunk_tokens", "prefill_chunk_tokens"),
+                       ("gen_prefill_token_budget", "prefill_token_budget"))
         # typo rejection (same contract as graph_checks/fleet/overload): a
         # misspelled generation knob must fail at config time, not silently
         # serve with the default (e.g. `prefix_cache_page:` quietly leaving
@@ -266,6 +277,24 @@ class ServingConfig:
                     f"generation prefix_block_tokens must be 0 (= one "
                     f"page) or a positive multiple of page_size {ps}, "
                     f"got {pbt}")
+        pct = flat.get("gen_prefill_chunk_tokens")
+        if pct is not None:
+            ps = flat.get("gen_page_size", cls.gen_page_size)
+            if pct < 0 or (pct and pct % ps):
+                raise ValueError(
+                    f"generation prefill_chunk_tokens must be 0 (= whole-"
+                    f"prompt prefill) or a positive multiple of page_size "
+                    f"{ps}, got {pct}")
+        ptb = flat.get("gen_prefill_token_budget")
+        if ptb is not None:
+            if ptb < 0:
+                raise ValueError(f"generation prefill_token_budget must be "
+                                 f">= 0, got {ptb}")
+            if ptb and not flat.get("gen_prefill_chunk_tokens"):
+                raise ValueError(
+                    "generation prefill_token_budget requires "
+                    "prefill_chunk_tokens > 0 (the budget is spent in "
+                    "whole chunks)")
         fleet = raw.get("fleet") or {}
         for key, alias in (("replicas", "replicas"),
                            ("fleet_policy", "policy"),

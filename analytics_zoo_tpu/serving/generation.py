@@ -77,6 +77,18 @@ _GEN_ITL = _tm.histogram("zoo_gen_inter_token_seconds",
                          "Per-stream time between consecutive emitted tokens",
                          buckets=(.001, .0025, .005, .01, .025, .05, .1,
                                   .25, .5, 1.0, 2.5))
+_GEN_TTFT = _tm.histogram(
+    "zoo_gen_ttft_seconds",
+    "Per-stream time from submit to the first emitted token, by priority "
+    "class — queue wait + prefill wait + prefill compute (chunked prefill "
+    "makes this a scheduling outcome: the budget trades running streams' "
+    "ITL against new streams' TTFT)",
+    labels=("priority",),
+    buckets=(.005, .01, .025, .05, .1, .25, .5, 1.0, 2.5, 5.0, 10.0))
+_GEN_PREFILL_CHUNKS = _tm.counter(
+    "zoo_gen_prefill_chunks_total",
+    "Chunked-prefill dispatches executed (each fills at most "
+    "prefill_chunk_tokens positions of one stream's prompt)")
 _GEN_SHED = _tm.counter("zoo_gen_shed_total",
                         "Generation requests shed by the continuous batcher "
                         "instead of decoded, by overload class",
@@ -243,7 +255,8 @@ class _Slot:
     """One decode slot's host-side state (device state lives in the cache)."""
 
     __slots__ = ("request", "length", "generated", "last_token", "pages",
-                 "handle", "history", "pending_drafts", "prefix_keys")
+                 "handle", "history", "pending_drafts", "prefix_keys",
+                 "prefilling", "prefill_done", "chunks", "admitted_t")
 
     def __init__(self, request: _Request, length: int, last_token: int,
                  pages: List[int], history: Optional[List[int]] = None,
@@ -253,6 +266,13 @@ class _Slot:
         self.generated = 1              # prefill samples token 0
         self.last_token = last_token    # sampled, not yet cached
         self.pages = pages              # owned page ids (freed on retire)
+        # chunked-prefill phase (ISSUE 20): a prefilling slot owns its pages
+        # and table row but is masked out of every decode/verify dispatch
+        # until _finalize_prefill samples token 0 and flips it live
+        self.prefilling = False
+        self.prefill_done = 0           # prompt tokens already in the cache
+        self.chunks = 0                 # chunk dispatches spent on this slot
+        self.admitted_t = time.perf_counter()
         # full token sequence (prompt + emitted) — the self-drafting k-gram
         # proposer's corpus; maintained in plain mode too so a hot-swap into
         # speculative mode can draft for in-flight streams immediately
@@ -292,6 +312,9 @@ class ContinuousBatcher:
                  batch_window_s: float = 0.05,
                  prefix_cache_pages: int = 0,
                  prefix_block_tokens: int = 0,
+                 prefill_chunk_tokens: int = 0,
+                 prefill_token_budget: int = 0,
+                 prefill_slo_itl_s: Optional[float] = None,
                  graph_checks: Optional[str] = None,
                  hbm_budget_bytes: Optional[int] = None,
                  donate_cache: bool = True,
@@ -305,6 +328,22 @@ class ContinuousBatcher:
             raise ValueError(f"page_size must be a power of two, got "
                              f"{page_size} (prefill buckets are pow2 and "
                              f"must tile by pages)")
+        if prefill_chunk_tokens < 0 or (prefill_chunk_tokens
+                                        and prefill_chunk_tokens % page_size):
+            raise ValueError(f"prefill_chunk_tokens must be 0 (whole-prompt "
+                             f"prefill) or a positive multiple of page_size "
+                             f"{page_size}, got {prefill_chunk_tokens}")
+        if prefill_token_budget < 0:
+            raise ValueError(f"prefill_token_budget must be >= 0, got "
+                             f"{prefill_token_budget}")
+        if prefill_token_budget and not prefill_chunk_tokens:
+            raise ValueError("prefill_token_budget requires "
+                             "prefill_chunk_tokens > 0 (the budget is spent "
+                             "in whole chunks)")
+        if prefill_chunk_tokens and not hasattr(model, "prefill_chunk"):
+            raise ValueError(f"chunked prefill needs a model with "
+                             f"prefill_chunk(); "
+                             f"{type(model).__name__} has none")
         import jax
 
         self.model = model
@@ -351,6 +390,16 @@ class ContinuousBatcher:
         # generation requests (a request whose deadline cannot even absorb
         # one step is hopeless) and the computed Retry-After
         self.step_ema = _qos.ServiceTimeEMA()
+        # chunked prefill (ISSUE 20): chunk_tokens > 0 routes EVERY prefill
+        # through the fixed-shape chunk executable, interleaved with decode
+        # under a per-loop-pass token budget (static YAML budget, or derived
+        # from the ITL SLO headroom when prefill_slo_itl_s is declared)
+        self.prefill_chunk_tokens = int(prefill_chunk_tokens)
+        self.prefill_token_budget = int(prefill_token_budget)
+        self.prefill_slo_itl_s = (float(prefill_slo_itl_s)
+                                  if prefill_slo_itl_s else None)
+        self.chunk_ema = _qos.ServiceTimeEMA()
+        self._last_budget: Optional[Dict[str, Any]] = None
         # uris cancelled while still queued (bounded: unknown uris age out)
         import collections
 
@@ -380,6 +429,8 @@ class ContinuousBatcher:
         self.loop_respawns = 0
         self.prefill_buckets: set = set()
         self.decode_shapes: set = set()
+        self.chunk_shapes: set = set()
+        self.prefill_chunks_total = 0
         # spec accounting (acceptance rate = accepted/drafted)
         self.spec_steps = 0
         self.spec_drafted = 0
@@ -418,6 +469,16 @@ class ContinuousBatcher:
             donate_argnums=donate)
         self._copy_page = jax.jit(
             copy_page, donate_argnums=(0,) if donate_cache else ())
+        # chunked prefill: ONE executable per chunk_tokens (B=1, fixed ids
+        # width, fixed WIDE table — pages_per_slot + chunk_tokens/page_size
+        # entries so the final chunk of a max-length prompt never indexes
+        # past the row; overflow entries are scratch, bit-neutral)
+        self._prefill_chunk = None
+        if self.prefill_chunk_tokens:
+            self._prefill_chunk = jax.jit(
+                lambda p, c, ids, nd, nv, tb: model.prefill_chunk(
+                    p, c, ids, nd, nv, tb, page_size=cfg.page_size),
+                donate_argnums=donate)
         # one compiled verify executable per k ever used (lazily jitted; a
         # spec-schedule hot-swap to a new k compiles exactly one more — the
         # per-(k, slot-count) executable invariant the lint gate asserts)
@@ -577,6 +638,12 @@ class ContinuousBatcher:
                 try:
                     self._apply_pending_swap()
                     self._admit()
+                    if self.prefill_chunk_tokens:
+                        # spend at most one budget of prefill chunks, THEN
+                        # decode: running streams advance every loop pass no
+                        # matter how deep the prefill backlog (starvation-
+                        # free by construction)
+                        self._prefill_chunks()
                     if self.active_slots() == 0:
                         if (self._pending.empty() and not self._backlog
                                 and not self._preempted):
@@ -815,6 +882,11 @@ class ContinuousBatcher:
             self.peak_pages_in_use = used
 
     def _prefill_into_slot(self, req: _Request):
+        if self.prefill_chunk_tokens:
+            # chunked mode routes EVERY prefill through the chunk executable
+            # (short prompts take one chunk) — one code path, one identity
+            return self._begin_chunked_prefill(req)
+        t_admit = time.perf_counter()
         slot_idx = self._slots.index(None)
         cfg = self.cfg
         n_prompt = int(req.prompt.size)
@@ -921,6 +993,7 @@ class ContinuousBatcher:
         slot = _Slot(req, n_prompt, tok, list(row),
                      history=req.prompt.tolist() + [tok],
                      prefix_keys=keys)
+        slot.admitted_t = t_admit
         if self.spec_k >= 2:
             from ..ops.speculative import propose_kgram
 
@@ -932,6 +1005,228 @@ class ContinuousBatcher:
             self._slots[slot_idx] = slot
         self._emit(slot, [tok])
         self._maybe_finish(slot_idx)
+
+    # chunked prefill (ISSUE 20) ----------------------------------------------
+
+    def _begin_chunked_prefill(self, req: _Request):
+        """Admit a request into the ``prefilling`` phase: claim its pages
+        (warm prefix blocks arrive from the cache first, so a warm stream
+        skips straight to its suffix chunks), install the slot MASKED out of
+        every decode dispatch, and let :meth:`_prefill_chunks` fill the
+        prompt chunk by chunk under the loop's token budget. Nothing is
+        dispatched here — admission stays O(host work).
+
+        Error contract (same as whole-prompt prefill): any failure before
+        the slot installs hands back every page and prefix reference this
+        request acquired; after install, :meth:`_retire_locked` owns that
+        release exactly once."""
+        slot_idx = self._slots.index(None)
+        cfg = self.cfg
+        n_prompt = int(req.prompt.size)
+        n_pg = -(-n_prompt // cfg.page_size)
+        match = None
+        if self.prefix_cache is not None:
+            match = self.prefix_cache.lookup(req.prompt)
+            if match is None:
+                _GEN_PREFIX_MISSES.inc()
+            else:
+                _GEN_PREFIX_HITS.inc()
+        keys: List[str] = [] if match is None else match.keys
+        row: List[int] = [] if match is None else list(match.pages)
+        held: List[int] = list(row)     # pages this stream holds refs on
+        start = 0 if match is None else match.n_tokens
+        try:
+            if match is not None and start >= n_prompt:
+                # whole (block-aligned) prompt cached: only the last token
+                # needs recomputing for its logits — copy-on-write the
+                # boundary page so the chunk's K/V write never lands in a
+                # shared page, then prefill a single 1-token chunk
+                start = n_prompt - 1
+                bp = start // cfg.page_size
+                (cow,) = self._alloc_pages(1)
+                held.append(cow)
+                self.cache = self._copy_page(
+                    self.cache, np.int32(row[bp]), np.int32(cow))
+                self.pool.release([row[bp]])
+                held.remove(row[bp])
+                row[bp] = cow
+            if len(row) < n_pg:
+                fresh = self._alloc_pages(n_pg - len(row))
+                row.extend(fresh)
+                held.extend(fresh)
+            self._note_pool_peak()
+            if start:
+                # refcount-aliasing write isolation: every page the suffix
+                # chunks can write must be exclusively this stream's
+                from ..analysis.rules.decode import lint_prefix_write_isolation
+
+                findings = lint_prefix_write_isolation(
+                    self.pool, row, start, page_size=cfg.page_size)
+                if findings:
+                    raise RuntimeError(
+                        "prefix-share write isolation violated: "
+                        + "; ".join(f.message for f in findings))
+        except BaseException:
+            # a failed admission must hand back EVERYTHING it acquired —
+            # shared-page references included — or repeated failures would
+            # drain the pool permanently
+            if keys and self.prefix_cache is not None:
+                self.prefix_cache.release_stream(keys)
+            self.pool.release(held)
+            raise
+        if start:
+            req.cached_prefix_tokens = start
+            self.prefix_tokens_saved += start
+            _GEN_PREFIX_TOKENS_SAVED.inc(start)
+        slot = _Slot(req, n_prompt, -1, list(row), prefix_keys=keys)
+        slot.generated = 0              # token 0 samples at finalize
+        slot.prefilling = True
+        slot.prefill_done = start
+        with self._lock:
+            self._table[slot_idx, :] = SCRATCH_PAGE
+            self._table[slot_idx, :n_pg] = row
+            self._slots[slot_idx] = slot
+
+    def _prefill_budget(self) -> int:
+        """Tokens this loop pass may spend on prefill chunks, through the
+        pure decision function (recorded on the flight recorder whenever the
+        verdict changes — live and replay stay identical)."""
+        inputs = {"chunk_tokens": self.prefill_chunk_tokens,
+                  "static_budget": self.prefill_token_budget,
+                  "itl_target_s": self.prefill_slo_itl_s,
+                  "decode_ema_s": round(self.step_ema.value(), 6),
+                  "chunk_ema_s": round(self.chunk_ema.value(), 6)}
+        decision = _qos.prefill_budget_decision(inputs)
+        if decision != self._last_budget:
+            rec = _flight.get()
+            if rec is not None:
+                rec.record("gen.prefill.budget", inputs, decision)
+            _events.emit("gen.prefill.budget", severity="info",
+                         budget_tokens=decision["budget_tokens"],
+                         chunks=decision["chunks"],
+                         source=decision["source"])
+            self._last_budget = decision
+        return int(decision["budget_tokens"])
+
+    def _prefill_chunks(self):
+        """Spend at most one token budget on pending prefill chunks, in
+        (priority, deadline) order. The FIRST chunk always runs (progress
+        floor: a prefilling stream must advance even when the budget is
+        below one chunk), then chunks run while they fit."""
+        budget: Optional[int] = None
+        spent = 0
+        while True:
+            with self._lock:
+                cands = [(s.request.order_key, i)
+                         for i, s in enumerate(self._slots)
+                         if s is not None and s.prefilling]
+            if not cands:
+                return
+            if budget is None:
+                budget = self._prefill_budget()
+            if spent and spent + self.prefill_chunk_tokens > budget:
+                return
+            _, idx = min(cands)
+            spent += self._prefill_one_chunk(idx)
+
+    def _prefill_one_chunk(self, idx: int) -> int:
+        """Run ONE chunk of slot ``idx``'s prompt through the fixed-shape
+        chunk executable; finalize the stream when the prompt completes.
+        Returns the chunk tokens spent (0 when the slot retired instead)."""
+        cfg = self.cfg
+        ct = self.prefill_chunk_tokens
+        fin = None
+        with self._lock:
+            slot = self._slots[idx]
+            if slot is None or not slot.prefilling:
+                return 0
+            if slot.request.cancelled:
+                fin = self._retire_locked(idx, "cancelled")
+        if fin is not None:
+            self._finish_cb(*fin)
+            return 0
+        req = slot.request
+        n_prompt = int(req.prompt.size)
+        n_done = slot.prefill_done
+        n_valid = min(ct, n_prompt - n_done)
+        # deterministic fault site BEFORE the dispatch: a kill here leaves
+        # the slot's state untouched, so the respawned loop re-runs exactly
+        # this chunk — idempotent (same K/V rewritten into exclusively-owned
+        # pages; the token sample happens only once, at finalize)
+        chaos_point("prefill.chunk")
+        try:
+            with _tm.span("serving.gen.prefill.chunk", remote=req.ctx,
+                          uri=req.uri, n_done=n_done, n_valid=n_valid):
+                ids = np.zeros((1, ct), np.int32)
+                ids[0, :n_valid] = req.prompt[n_done:n_done + n_valid]
+                # WIDE table: a chunk ending at position n_done+ct-1 can
+                # index page (pages_per_slot - 1) + ct/page_size; overflow
+                # entries stay scratch (masked lanes, bit-neutral)
+                wide = cfg.pages_per_slot + ct // cfg.page_size
+                table = np.full((1, wide), SCRATCH_PAGE, np.int32)
+                table[0, :len(slot.pages)] = slot.pages
+                t0 = time.monotonic()
+                logits, self.cache = self._prefill_chunk(
+                    self.params, self.cache, ids,
+                    np.array([n_done], np.int32),
+                    np.array([n_valid], np.int32), table)
+                self.chunk_ema.observe(time.monotonic() - t0)
+        except Exception as e:
+            # a deterministic chunk failure (bad state, XLA error) fails
+            # THIS stream, not the loop; WorkerKilled (BaseException)
+            # still propagates to the supervisor with slot state intact
+            logger.exception("prefill chunk failed for %s", req.uri)
+            with self._lock:
+                if self._slots[idx] is slot:
+                    fin = self._retire_locked(
+                        idx, "error", error=f"prefill chunk failed: {e}")
+            if fin is not None:
+                self._finish_cb(*fin)
+            return ct
+        slot.prefill_done = n_done + n_valid
+        slot.chunks += 1
+        self.prefill_chunks_total += 1
+        self.chunk_shapes.add((ct, wide))
+        _GEN_PREFILL_CHUNKS.inc()
+        _GEN_TOKENS.labels(phase="prefill").inc(n_valid)
+        if slot.prefill_done >= n_prompt:
+            self._finalize_prefill(idx, slot, logits)
+        return ct
+
+    def _finalize_prefill(self, idx: int, slot: _Slot, logits) -> None:
+        """Flip a fully-prefilled slot live: sample token 0 (same seed,
+        same ordinal-0 sample whole-prompt prefill takes — chunking never
+        changes a stream's tokens), THEN publish to the prefix cache. The
+        order matters: a chaos kill at the publish site leaves a clean
+        decoding slot that merely never published — nothing to unwind."""
+        req = slot.request
+        first = self._sample(
+            logits, np.array([req.seed], np.uint32),
+            np.array([0], np.uint32),
+            np.array([req.temperature], np.float32))
+        tok = int(np.asarray(first)[0])
+        slot.last_token = tok
+        slot.generated = 1
+        slot.history = req.prompt.tolist() + [tok]
+        slot.prefilling = False
+        if self.spec_k >= 2:
+            from ..ops.speculative import propose_kgram
+
+            slot.pending_drafts = propose_kgram(
+                slot.history, self.spec_k - 1, self.spec_ngram)
+        if self.prefix_cache is not None:
+            chaos_point("prefix.publish")
+            self.prefix_cache.publish(req.prompt, int(req.prompt.size),
+                                      slot.pages)
+            sweep = self.prefix_cache.evict_to_budget()
+            if sweep["pages"]:
+                _GEN_PREFIX_EVICTED.inc(sweep["pages"])
+                _events.emit("gen.prefix.evicted", severity="info",
+                             reason="budget", entries=sweep["entries"],
+                             pages=sweep["pages"],
+                             held_pages=sweep["held_pages"])
+        self._emit(slot, [tok])
+        self._maybe_finish(idx)
 
     # decode ------------------------------------------------------------------
 
@@ -1008,6 +1303,7 @@ class ContinuousBatcher:
         temps = np.zeros(b, np.float32)
         finishes = []
         live: List[int] = []
+        prefilling: List[int] = []
         with self._lock:
             for i in (range(b) if rows is None else rows):
                 slot = self._slots[i]
@@ -1015,6 +1311,12 @@ class ContinuousBatcher:
                     continue
                 if slot.request.cancelled:
                     finishes.append(self._retire_locked(i, "cancelled"))
+                    continue
+                if slot.prefilling:
+                    # mid-prefill: masked out of the dispatch below — an
+                    # unmasked row would take a position-0 K/V write into
+                    # its REAL first page (silent prompt corruption)
+                    prefilling.append(i)
                     continue
                 # grow: the position being written this step needs its page
                 p = slot.length // cfg.page_size
@@ -1039,6 +1341,9 @@ class ContinuousBatcher:
             for i in range(b):
                 if i not in live:  # mask non-members (incl. spec-active)
                     table[i, :] = SCRATCH_PAGE
+        else:
+            for i in prefilling:
+                table[i, :] = SCRATCH_PAGE
         for fin in finishes:       # final-frame callbacks OUTSIDE the lock
             self._finish_cb(*fin)
         if not live:
@@ -1092,12 +1397,18 @@ class ContinuousBatcher:
         temps = np.zeros(b, np.float32)
         finishes = []
         tail: List[int] = []
+        prefilling: List[int] = []
         with self._lock:
             for i, slot in enumerate(self._slots):
                 if slot is None:
                     continue
                 if slot.request.cancelled:
                     finishes.append(self._retire_locked(i, "cancelled"))
+                    continue
+                if slot.prefilling:
+                    # mid-prefill: masked out of the verify dispatch (and
+                    # NOT a tail row — nothing decodes until finalize)
+                    prefilling.append(i)
                     continue
                 if slot.length + k > cfg.max_seq_len:
                     # tail regime: fewer than k positions remain (or a swap
@@ -1140,11 +1451,13 @@ class ContinuousBatcher:
                 tok_idx[i] = slot.generated
                 temps[i] = slot.request.temperature
             table = self._table.copy()
-            active = [i for i, s in enumerate(self._slots) if s is not None]
+            active = [i for i, s in enumerate(self._slots)
+                      if s is not None and not s.prefilling]
         spec_rows = [i for i in active if i not in tail]
-        for i in tail:
-            # scratch the tail rows' tables in the COPY: their verify-step
-            # writes land in scratch, never past their table's end
+        for i in tail + prefilling:
+            # scratch these rows' tables in the COPY: their verify-step
+            # writes land in scratch, never past their table's end (tail)
+            # and never into a half-prefilled prompt (prefilling)
             table[i, :] = SCRATCH_PAGE
         for fin in finishes:       # final-frame callbacks OUTSIDE the lock
             self._finish_cb(*fin)
@@ -1210,18 +1523,30 @@ class ContinuousBatcher:
 
     def _emit(self, slot: _Slot, tokens: List[int]):
         now = time.perf_counter()
-        if slot.request.last_emit_t is not None:
-            _GEN_ITL.observe(now - slot.request.last_emit_t)
-        slot.request.last_emit_t = now
+        req = slot.request
+        meta: Dict[str, Any] = {"uri": req.uri}
+        if req.last_emit_t is not None:
+            _GEN_ITL.observe(now - req.last_emit_t)
+        else:
+            # first token of the stream: TTFT (submit -> first emit) plus
+            # the prefill accounting the bench's drive() reads off the
+            # first frame (chunks spent, admission -> first-token wait)
+            _GEN_TTFT.labels(priority=req.priority).observe(
+                now - req.submitted_t)
+            meta["ttft_s"] = round(now - req.submitted_t, 6)
+            meta["chunks"] = slot.chunks
+            meta["prefill_wait_ms"] = round(
+                (now - slot.admitted_t) * 1e3, 3)
+        req.last_emit_t = now
         self.tokens_generated += len(tokens)
         _GEN_TOKENS.labels(phase="decode").inc(len(tokens))
-        cb = slot.request.on_chunk
+        cb = req.on_chunk
         if cb is not None:
             try:
-                cb(tokens, False, {"uri": slot.request.uri})
+                cb(tokens, False, meta)
             except Exception:   # a consumer bug must not poison the loop
                 logger.exception("token-chunk callback failed for %s",
-                                 slot.request.uri)
+                                 req.uri)
 
     def _maybe_finish(self, slot_idx: int):
         fin = None
@@ -1344,6 +1669,7 @@ class ContinuousBatcher:
         findings = lint_decode_stability(
             self.model, self.params, self.cfg, self.cache,
             top_k=self.top_k, spec_k=self.spec_k,
+            chunk_tokens=self.prefill_chunk_tokens,
             where="serving.generation",
             donate_cache=self.donate_cache, hbm_budget_bytes=budget,
             note_static_site="serving.decode")
@@ -1398,10 +1724,13 @@ class ContinuousBatcher:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             active = sum(s is not None for s in self._slots)
+            prefilling = sum(s is not None and s.prefilling
+                             for s in self._slots)
             preempted = len(self._preempted)
         out = {
             "slots": self.n_slots,
             "active_slots": active,
+            "prefilling": prefilling,
             "preempted_parked": preempted,
             "backlog": len(self._backlog),
             "step_ema_s": round(self.step_ema.value(), 6),
@@ -1437,6 +1766,18 @@ class ContinuousBatcher:
             out["prefix"] = dict(self.prefix_cache.stats(),
                                  tokens_saved=self.prefix_tokens_saved,
                                  shared_pages=self.pool.shared_count())
+        if self.prefill_chunk_tokens:
+            out["prefill"] = {
+                "chunk_tokens": self.prefill_chunk_tokens,
+                "chunks": self.prefill_chunks_total,
+                # chunk-shape invariant: ONE compiled chunk executable per
+                # (chunk_tokens, slot) — the bench/lint gate's counterpart
+                # of distinct_decode_shapes
+                "distinct_chunk_shapes": len(self.chunk_shapes),
+                "chunk_ema_s": round(self.chunk_ema.value(), 6),
+                "budget": (dict(self._last_budget)
+                           if self._last_budget else None),
+            }
         if self.spec_k >= 2 or self.spec_steps:
             out["spec"] = {
                 "k": self.spec_k,
@@ -1457,6 +1798,17 @@ class ContinuousBatcher:
 # ---------------------------------------------------------------------------
 # broker-facing engine + client
 # ---------------------------------------------------------------------------
+
+def _itl_objective_target_s(cfg) -> Optional[float]:
+    """The declared inter-token-latency objective's threshold (seconds), if
+    any: a latency-type SLO objective whose name mentions ``itl`` arms the
+    SLO-derived prefill budget (``qos.prefill_budget_from_slo``)."""
+    for obj in getattr(cfg, "slo_objectives", ()) or ():
+        if (str(obj.get("type", "")).lower() == "latency"
+                and "itl" in str(obj.get("name", "")).lower()):
+            return float(obj.get("threshold_ms", 1000.0)) / 1e3
+    return None
+
 
 class GenerationEngine:
     """Streaming generation job over the broker fabric.
@@ -1502,6 +1854,11 @@ class GenerationEngine:
                 prefix_cache_pages=getattr(cfg, "gen_prefix_cache_pages", 0),
                 prefix_block_tokens=getattr(cfg, "gen_prefix_block_tokens",
                                             0),
+                prefill_chunk_tokens=getattr(cfg, "gen_prefill_chunk_tokens",
+                                             0),
+                prefill_token_budget=getattr(cfg,
+                                             "gen_prefill_token_budget", 0),
+                prefill_slo_itl_s=_itl_objective_target_s(cfg),
                 hbm_budget_bytes=int(budget_mb * 2 ** 20) if budget_mb
                 else None,
                 graph_checks=None, autostart=False)

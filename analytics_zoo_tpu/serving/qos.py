@@ -296,9 +296,65 @@ def autoscale_decision(obs: Dict[str, Any],
     return {"action": "hold", "reason": "steady", "load": load}
 
 
+def prefill_budget_from_slo(itl_target_s: float, decode_ema_s: float,
+                            chunk_ema_s: float, chunk_tokens: int) -> int:
+    """Per-loop-iteration prefill token budget derived from an ITL
+    objective: the headroom an interleaved decode step leaves under the
+    target, divided into whole chunks.
+
+    ``itl_target_s``: the ITL SLO target (seconds between tokens of a
+    running stream — each loop iteration emits one decode step, so the
+    prefill work squeezed in front of it is exactly the ITL inflation);
+    ``decode_ema_s``: measured decode-step EMA; ``chunk_ema_s``: measured
+    per-chunk prefill EMA; ``chunk_tokens``: tokens per chunk. No evidence
+    yet (either EMA unobserved) or no headroom → ONE chunk (the progress
+    floor: a prefilling stream must always advance, else a saturated decode
+    loop starves prefill forever). Pure: no clocks, no globals.
+    """
+    chunk_tokens = max(1, int(chunk_tokens))
+    if chunk_ema_s <= 0.0 or decode_ema_s <= 0.0:
+        return chunk_tokens                       # cold: floor of one chunk
+    headroom = float(itl_target_s) - float(decode_ema_s)
+    if headroom <= 0.0:
+        return chunk_tokens                       # saturated: floor
+    return max(1, int(headroom / float(chunk_ema_s))) * chunk_tokens
+
+
+def prefill_budget_decision(inputs: Dict[str, Any]) -> Dict[str, Any]:
+    """One prefill-budget verdict for the decode loop (the ``gen.prefill.
+    budget`` recorder site).
+
+    ``inputs``: ``chunk_tokens``, ``static_budget`` (YAML
+    ``prefill_token_budget``; 0 = unset), ``itl_target_s`` (SLO target or
+    None), ``decode_ema_s``, ``chunk_ema_s``. Extra keys are ignored.
+
+    Returns ``{"budget_tokens", "chunks", "source"}`` where ``source`` is
+    ``"slo"`` (headroom-derived), ``"static"`` (YAML budget), or
+    ``"floor"`` (no signal → one chunk). Deterministic and timestamp-free,
+    so live records replay exactly (:class:`~..observability.replay.
+    IncumbentPolicy`).
+    """
+    chunk_tokens = max(1, int(inputs.get("chunk_tokens", 1)))
+    itl = inputs.get("itl_target_s")
+    if itl is not None and float(itl) > 0.0:
+        budget = prefill_budget_from_slo(
+            float(itl), float(inputs.get("decode_ema_s", 0.0)),
+            float(inputs.get("chunk_ema_s", 0.0)), chunk_tokens)
+        source = "slo"
+    elif int(inputs.get("static_budget", 0)) > 0:
+        budget = max(chunk_tokens, int(inputs["static_budget"]))
+        source = "static"
+    else:
+        budget = chunk_tokens
+        source = "floor"
+    return {"budget_tokens": int(budget),
+            "chunks": int(budget) // chunk_tokens, "source": source}
+
+
 __all__ = ["DEFAULT_PRIORITY", "MIN_RETRY_AFTER_S", "PRIORITIES",
            "PRIORITY_RANK", "ServiceTimeEMA", "ShedError",
            "admission_decision", "autoscale_decision", "cannot_meet",
            "deadline_from_ms", "estimated_wait_s", "normalize_deadline",
-           "normalize_priority", "order_key", "priority_rank",
-           "retry_after_s", "shed_error_from_payload", "shed_payload"]
+           "normalize_priority", "order_key", "prefill_budget_decision",
+           "prefill_budget_from_slo", "priority_rank", "retry_after_s",
+           "shed_error_from_payload", "shed_payload"]

@@ -72,10 +72,18 @@ if [[ "${1:-}" == "--quick" ]]; then
     # disabled baseline across concurrent same-prefix streams (prefix
     # pages mapped once, not copied per stream), measured hit rate 1.0,
     # and warm streams token-identical to the cold baseline
+    # --longprompt (ISSUE 20): chunked-prefill gates — a long prompt
+    # injected into 8 running short streams inflates short-stream ITL p95
+    # <= 1.5x the no-long-prompt baseline (whole-prompt prefill stalls
+    # them an order of magnitude harder), chunked end-to-end long-prompt
+    # latency >= 0.8x whole-prompt, ONE compiled chunk shape, the long
+    # stream's tokens bit-identical across whole-prompt / chunked-idle /
+    # chunked-interleaved arms, and a chaos kill mid-chunk replays the
+    # chunk idempotently (same tokens, pool conserved)
     MEM_WITNESS="$(mktemp -t zoo_mem_witness.XXXXXX.jsonl)"
     timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
         ZOO_TPU_MEM_WITNESS="$MEM_WITNESS" \
-        python bench.py --generation --spec --prefix --quick
+        python bench.py --generation --spec --prefix --longprompt --quick
     timeout -k 10 120 env JAX_PLATFORMS=cpu \
         python -m analytics_zoo_tpu.analysis --mem-witness "$MEM_WITNESS"
     # replica-fleet gate: zero lost requests with one of 4 replicas chaos-
